@@ -1,0 +1,213 @@
+"""Columnar event batch model.
+
+TPU-native replacement for the reference's event model
+(siddhi-core event/: Event.java, ComplexEvent.java, StreamEvent.java,
+StateEvent.java, ComplexEventChunk.java, StreamEventPool.java).
+
+The reference represents in-flight events as pooled, linked-list node objects
+(`StreamEvent.next`) walked one at a time.  Here an event micro-batch is a
+struct-of-arrays `EventChunk`: one numpy/JAX column per attribute + a timestamp
+column + an event-type lane implementing the CURRENT/EXPIRED/TIMER/RESET
+temporal algebra (reference ComplexEvent.Type, docs/siddhi-architecture.md:243-259).
+Chunks are what processors exchange; device kernels consume the numeric columns
+directly (strings are dictionary-encoded before shipping to device).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..query_api.definition import AbstractDefinition, AttrType
+
+# ComplexEvent.Type lanes
+CURRENT = 0
+EXPIRED = 1
+TIMER = 2
+RESET = 3
+
+TYPE_NAMES = {CURRENT: "CURRENT", EXPIRED: "EXPIRED", TIMER: "TIMER",
+              RESET: "RESET"}
+
+_DTYPES = {
+    AttrType.INT: np.int32,
+    AttrType.LONG: np.int64,
+    AttrType.FLOAT: np.float32,
+    AttrType.DOUBLE: np.float64,
+    AttrType.BOOL: np.bool_,
+    AttrType.STRING: object,
+    AttrType.OBJECT: object,
+}
+
+
+def dtype_for(t: AttrType):
+    return _DTYPES[t]
+
+
+def zero_for(t: AttrType):
+    if t in (AttrType.STRING, AttrType.OBJECT):
+        return None
+    return dtype_for(t)(0)
+
+
+@dataclass
+class Event:
+    """User-facing event (reference event/Event.java: timestamp + Object[])."""
+    timestamp: int
+    data: List[Any]
+
+    def __iter__(self):
+        return iter(self.data)
+
+
+class EventChunk:
+    """A columnar micro-batch of events flowing through a query pipeline."""
+
+    __slots__ = ("timestamps", "types", "columns", "names")
+
+    def __init__(self, names: Sequence[str], timestamps: np.ndarray,
+                 types: np.ndarray, columns: Dict[str, np.ndarray]):
+        self.names = list(names)
+        self.timestamps = timestamps
+        self.types = types
+        self.columns = columns
+
+    # ------------------------------------------------------------ constructors
+
+    @staticmethod
+    def empty(names: Sequence[str]) -> "EventChunk":
+        return EventChunk(names, np.empty(0, np.int64), np.empty(0, np.int8),
+                          {n: np.empty(0, object) for n in names})
+
+    @staticmethod
+    def from_rows(definition: AbstractDefinition, rows: Sequence[Sequence[Any]],
+                  timestamps: Sequence[int],
+                  types: Optional[Sequence[int]] = None) -> "EventChunk":
+        n = len(rows)
+        names = definition.attribute_names
+        cols: Dict[str, np.ndarray] = {}
+        for j, attr in enumerate(definition.attributes):
+            dt = dtype_for(attr.type)
+            if dt is object:
+                arr = np.empty(n, object)
+                for i, r in enumerate(rows):
+                    arr[i] = r[j]
+            else:
+                try:
+                    arr = np.asarray([r[j] for r in rows], dtype=dt)
+                except (TypeError, ValueError):
+                    # None payloads fall back to zeros (null lane not modelled
+                    # per column; Siddhi nulls only arise from outer joins /
+                    # absent captures which are handled there)
+                    arr = np.asarray(
+                        [0 if r[j] is None else r[j] for r in rows], dtype=dt)
+            cols[attr.name] = arr
+        ts = np.asarray(timestamps, np.int64)
+        tp = (np.asarray(types, np.int8) if types is not None
+              else np.zeros(n, np.int8))
+        return EventChunk(names, ts, tp, cols)
+
+    @staticmethod
+    def from_columns(names: Sequence[str], timestamps: np.ndarray,
+                     columns: Dict[str, np.ndarray],
+                     types: Optional[np.ndarray] = None) -> "EventChunk":
+        if types is None:
+            types = np.zeros(len(timestamps), np.int8)
+        return EventChunk(names, np.asarray(timestamps, np.int64), types,
+                          columns)
+
+    # ------------------------------------------------------------ accessors
+
+    def __len__(self) -> int:
+        return len(self.timestamps)
+
+    @property
+    def is_empty(self) -> bool:
+        return len(self.timestamps) == 0
+
+    def col(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    def row(self, i: int) -> Tuple[int, List[Any]]:
+        return int(self.timestamps[i]), [_to_py(self.columns[n][i])
+                                         for n in self.names]
+
+    def to_events(self) -> List[Event]:
+        out = []
+        for i in range(len(self)):
+            ts, data = self.row(i)
+            out.append(Event(ts, data))
+        return out
+
+    # ------------------------------------------------------------ transforms
+
+    def mask(self, m: np.ndarray) -> "EventChunk":
+        return EventChunk(self.names, self.timestamps[m], self.types[m],
+                          {k: v[m] for k, v in self.columns.items()})
+
+    def take(self, idx: np.ndarray) -> "EventChunk":
+        return EventChunk(self.names, self.timestamps[idx], self.types[idx],
+                          {k: v[idx] for k, v in self.columns.items()})
+
+    def slice(self, start: int, stop: int) -> "EventChunk":
+        return EventChunk(self.names, self.timestamps[start:stop],
+                          self.types[start:stop],
+                          {k: v[start:stop] for k, v in self.columns.items()})
+
+    def with_types(self, t: int) -> "EventChunk":
+        return EventChunk(self.names, self.timestamps,
+                          np.full(len(self), t, np.int8), self.columns)
+
+    def with_timestamps(self, ts: np.ndarray) -> "EventChunk":
+        return EventChunk(self.names, np.asarray(ts, np.int64), self.types,
+                          self.columns)
+
+    def rename(self, names: Sequence[str]) -> "EventChunk":
+        assert len(names) == len(self.names)
+        return EventChunk(list(names), self.timestamps, self.types,
+                          {new: self.columns[old]
+                           for old, new in zip(self.names, names)})
+
+    def only(self, *event_types: int) -> "EventChunk":
+        m = np.isin(self.types, event_types)
+        return self.mask(m)
+
+    def copy(self) -> "EventChunk":
+        return EventChunk(self.names, self.timestamps.copy(), self.types.copy(),
+                          {k: v.copy() for k, v in self.columns.items()})
+
+    @staticmethod
+    def concat(chunks: Sequence["EventChunk"]) -> "EventChunk":
+        chunks = [c for c in chunks if c is not None and not c.is_empty]
+        if not chunks:
+            return EventChunk.empty([])
+        if len(chunks) == 1:
+            return chunks[0]
+        names = chunks[0].names
+        return EventChunk(
+            names,
+            np.concatenate([c.timestamps for c in chunks]),
+            np.concatenate([c.types for c in chunks]),
+            {n: np.concatenate([c.columns[n] for c in chunks]) for n in names})
+
+    def __repr__(self):
+        return (f"EventChunk(n={len(self)}, names={self.names}, "
+                f"types={[TYPE_NAMES.get(int(t), t) for t in self.types[:8]]})")
+
+
+def _to_py(v):
+    """numpy scalar → python scalar for user-facing Event payloads."""
+    if isinstance(v, np.generic):
+        return v.item()
+    return v
+
+
+def timer_chunk(names: Sequence[str], timestamp: int) -> EventChunk:
+    """A single TIMER event (reference: Scheduler-injected timer StreamEvents,
+    util/Scheduler.java:180-211).  Data columns are empty placeholders."""
+    cols = {}
+    for n in names:
+        cols[n] = np.array([None], object)
+    return EventChunk(names, np.asarray([timestamp], np.int64),
+                      np.asarray([TIMER], np.int8), cols)

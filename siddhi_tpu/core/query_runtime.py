@@ -1,0 +1,218 @@
+"""QueryRuntime: wires input → handler chain → selector → rate limiter → output.
+
+(reference: query/QueryRuntime.java + util/parser/QueryParser.java:83-249 —
+input-stream runtime construction, selector, lock strategy, rate limiter and
+output callback; query/input/ProcessStreamReceiver.java junction entry.)
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..plan.expr_compiler import EvalCtx, ExprCompiler, Scope
+from ..query_api import (Filter, InsertIntoStream, JoinInputStream, Query,
+                         ReturnStream, SingleInputStream, StateInputStream,
+                         StreamFunctionHandler, WindowHandler)
+from ..query_api.definition import StreamDefinition
+from ..query_api.query import (DeleteStream, OutputEventsFor, UpdateOrInsertStream,
+                               UpdateStream)
+from ..utils.errors import SiddhiAppCreationError
+from .event import EventChunk
+from .output import (DeleteTableCallback, InsertIntoStreamCallback,
+                     InsertIntoTableCallback, InsertIntoWindowCallback,
+                     OutputCallbackProcessor, ReturnCallback,
+                     UpdateOrInsertTableCallback, UpdateTableCallback)
+from .processor import FilterProcessor, LogStreamProcessor, Processor
+from .ratelimit import build_rate_limiter
+from .selector import QuerySelector
+from .window import WindowProcessor, create_window_processor
+
+
+class ProcessStreamReceiver:
+    """Junction entry point for a query; holds the query lock
+    (reference query/input/ProcessStreamReceiver.java)."""
+
+    def __init__(self, first: Processor, lock: threading.RLock,
+                 latency_tracker=None):
+        self.first = first
+        self.lock = lock
+        self.latency_tracker = latency_tracker
+
+    def receive_chunk(self, chunk: EventChunk):
+        with self.lock:
+            if self.latency_tracker is not None:
+                self.latency_tracker.mark_in()
+            try:
+                self.first.process(chunk)
+            finally:
+                if self.latency_tracker is not None:
+                    self.latency_tracker.mark_out()
+
+
+class QueryRuntime:
+    def __init__(self, query: Query, app_runtime, query_name: str,
+                 partition_key: Optional[str] = None):
+        self.query = query
+        self.app_runtime = app_runtime
+        self.name = query_name
+        self.partition_key = partition_key
+        self.lock = threading.RLock()
+        self.output_processor: Optional[OutputCallbackProcessor] = None
+        self.selector: Optional[QuerySelector] = None
+        self.windows: List[WindowProcessor] = []
+        self.receivers: Dict[str, ProcessStreamReceiver] = {}
+        self.state_runtime = None          # set for pattern/sequence queries
+        self.join_runtime = None
+        self.output_definition: Optional[StreamDefinition] = None
+        self._build()
+
+    # ------------------------------------------------------------ build
+
+    def _expr_compiler_factory(self) -> Callable[[Scope], ExprCompiler]:
+        app = self.app_runtime
+        return lambda scope: ExprCompiler(
+            scope, np, app.app_ctx.script_functions, app.extension_registry)
+
+    def _build(self):
+        q = self.query
+        app = self.app_runtime
+        factory = self._expr_compiler_factory()
+
+        if isinstance(q.input_stream, SingleInputStream):
+            self._build_single(q.input_stream, factory)
+        elif isinstance(q.input_stream, JoinInputStream):
+            from .join import JoinRuntime
+            self.join_runtime = JoinRuntime(self, q.input_stream, factory)
+        elif isinstance(q.input_stream, StateInputStream):
+            from .pattern import StateStreamRuntime
+            self.state_runtime = StateStreamRuntime(self, q.input_stream,
+                                                    factory)
+        else:
+            raise SiddhiAppCreationError(
+                f"Unsupported input stream {type(q.input_stream).__name__}")
+
+    def _build_single(self, s: SingleInputStream, factory):
+        app = self.app_runtime
+        definition = app.definition_of(s.stream_id, s.is_inner, s.is_fault)
+        scope = Scope()
+        scope.add_primary(s.stream_id, s.stream_ref, definition)
+
+        chain: List[Processor] = []
+        compiler = factory(scope)
+        for h in s.handlers:
+            if isinstance(h, Filter):
+                chain.append(FilterProcessor(compiler.compile(h.expr)))
+            elif isinstance(h, WindowHandler):
+                wp = create_window_processor(
+                    h.name, h.params, app.app_ctx, definition.attribute_names,
+                    lambda e: compiler.compile(e))
+                wp.lock = self.lock
+                self.windows.append(wp)
+                chain.append(wp)
+            elif isinstance(h, StreamFunctionHandler):
+                chain.append(self._make_stream_function(h, compiler))
+        self._finish_chain(chain, scope, definition, factory)
+        receiver = ProcessStreamReceiver(
+            self._chain_head(chain), self.lock,
+            app.latency_tracker_for(self.name))
+        if app.has_named_window(s.stream_id):
+            app.named_window_of(s.stream_id).subscribe(receiver)
+        else:
+            junction = app.junction_of(s.stream_id, s.is_inner, s.is_fault,
+                                       self.partition_key)
+            junction.subscribe(receiver)
+        self.receivers[s.stream_id] = receiver
+
+    def _make_stream_function(self, h: StreamFunctionHandler, compiler):
+        app = self.app_runtime
+        low = h.name.lower()
+        params = [compiler.compile(p) for p in h.params]
+        if (h.namespace or "") == "" and low == "log":
+            return LogStreamProcessor(params)
+        ext = app.extension_registry.find_stream_processor(
+            h.namespace or "", h.name) if app.extension_registry else None
+        if ext is not None:
+            return ext(params)
+        raise SiddhiAppCreationError(
+            f"Unknown stream function '#{h.name}'")
+
+    def _chain_head(self, chain: List[Processor]) -> Processor:
+        """Link chain → selector → rate limiter → output; return head."""
+        full = chain + [self.selector, self.rate_limiter, self.output_processor]
+        for a, b in zip(full, full[1:]):
+            a.next = b
+        return full[0]
+
+    def _finish_chain(self, chain, scope, input_definition, factory):
+        """Create selector / rate limiter / output (shared by all input kinds).
+        Must be called before _chain_head."""
+        q = self.query
+        app = self.app_runtime
+        target = getattr(q.output_stream, "target_id", "") or self.name
+        self.selector = QuerySelector(q.selector, scope, input_definition,
+                                      factory, output_id=target)
+        self.output_definition = self.selector.output_definition
+        group_names = [v.attribute for v in q.selector.group_by]
+        self.rate_limiter = build_rate_limiter(q.output_rate, app.app_ctx,
+                                               group_names)
+        self.output_processor = self._make_output(q, factory)
+
+    def _make_output(self, q: Query, factory) -> OutputCallbackProcessor:
+        app = self.app_runtime
+        out = q.output_stream
+        ef = out.events_for
+        if isinstance(out, (DeleteStream, UpdateStream, UpdateOrInsertStream)) \
+                and app.has_table(out.target_id):
+            table = app.table_of(out.target_id)
+            cc = table.compile_condition(out.on, self.output_definition,
+                                         factory)
+            if isinstance(out, DeleteStream):
+                return DeleteTableCallback(table, cc, ef)
+            cset = table.compile_set(out.set_assignments,
+                                     self.output_definition, factory)
+            if isinstance(out, UpdateOrInsertStream):
+                return UpdateOrInsertTableCallback(table, cc, cset, ef)
+            return UpdateTableCallback(table, cc, cset, ef)
+        if isinstance(out, InsertIntoStream):
+            if app.has_table(out.target_id):
+                return InsertIntoTableCallback(app.table_of(out.target_id), ef)
+            if app.has_named_window(out.target_id):
+                return InsertIntoWindowCallback(
+                    app.named_window_of(out.target_id), ef)
+            junction = app.junction_of(out.target_id, out.is_inner,
+                                       out.is_fault, self.partition_key,
+                                       create_with=self.output_definition)
+            target_def = junction.definition
+            self._validate_output(target_def)
+            return InsertIntoStreamCallback(junction, target_def, ef)
+        return ReturnCallback(ef)
+
+    def _validate_output(self, target_def: StreamDefinition):
+        out_names = self.output_definition.attribute_names
+        if len(out_names) != len(target_def.attributes):
+            raise SiddhiAppCreationError(
+                f"Query '{self.name}' output ({out_names}) does not match "
+                f"stream '{target_def.id}' ({target_def.attribute_names})")
+
+    # ------------------------------------------------------------ callbacks
+
+    def add_callback(self, cb):
+        self.output_processor.query_callbacks.append(cb)
+
+    # ------------------------------------------------------------ state
+
+    def stateful_elements(self):
+        """(element_id, obj) pairs registered with the snapshot service."""
+        out = []
+        if self.selector is not None:
+            out.append((f"{self.name}:selector", self.selector))
+        for i, w in enumerate(self.windows):
+            out.append((f"{self.name}:window:{i}", w))
+        if self.state_runtime is not None:
+            out.append((f"{self.name}:state", self.state_runtime))
+        if self.join_runtime is not None:
+            for i, w in enumerate(self.join_runtime.windows):
+                out.append((f"{self.name}:join:{i}", w))
+        return out

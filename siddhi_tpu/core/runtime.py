@@ -1,0 +1,461 @@
+"""SiddhiManager + SiddhiAppRuntime — the top-level API.
+
+(reference: SiddhiManager.java:46-253 — create/validate runtimes, persistence
+stores, extensions; SiddhiAppRuntime.java:93-804 — per-app isolate: definition
+maps, junctions, queries, partitions, lifecycle, persist/restore, store
+queries, playback; util/SiddhiAppRuntimeBuilder.java — junction/table/window/
+trigger wiring; util/parser/SiddhiAppParser.java — @app annotations.)
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from ..compiler import SiddhiCompiler
+from ..plan.expr_compiler import EvalCtx, ExprCompiler, Scope
+from ..query_api import (Annotation, AttrType, Partition, Query, SiddhiApp,
+                         StreamDefinition, find_annotation)
+from ..query_api.definition import TableDefinition
+from ..utils.errors import (DefinitionNotExistError, NoPersistenceStoreError,
+                            SiddhiAppCreationError)
+from ..utils.extension import ExtensionRegistry
+from .context import SiddhiAppContext, SiddhiContext
+from .event import CURRENT, EventChunk
+from .named_window import NamedWindow
+from .query_runtime import QueryRuntime
+from .snapshot import (InMemoryPersistenceStore, PersistenceStore,
+                       SnapshotService)
+from .statistics import StatisticsManager
+from .stream import InputHandler, QueryCallback, StreamCallback, StreamJunction
+from .table import InMemoryTable
+from .trigger import TriggerRuntime, trigger_stream_definition
+
+
+class ScriptFunction:
+    """`define function f[python] return T { body }` — compiled python script
+    (reference: function/Script SPI via JSR-223; here native python)."""
+
+    def __init__(self, fn_def):
+        self.fn_def = fn_def
+        body = fn_def.body.strip()
+        if fn_def.language not in ("python", "py"):
+            raise SiddhiAppCreationError(
+                f"Unsupported script language '{fn_def.language}' "
+                f"(python only)")
+        ns: Dict[str, Any] = {}
+        if "\n" in body or body.startswith("return"):
+            lines = body.split("\n")
+            src = "def __fn__(data):\n" + "\n".join(
+                "    " + ln for ln in lines)
+        else:
+            src = f"def __fn__(data):\n    return ({body})"
+        exec(src, ns)  # noqa: S102 — user-defined function body, like the
+        # reference's JSR-223 script engines
+        self._fn = ns["__fn__"]
+
+    def compile_call(self, compiled_args):
+        from ..plan.expr_compiler import CompiledExpr
+        from .event import dtype_for
+        rt = self.fn_def.return_type or AttrType.OBJECT
+        dt = dtype_for(rt)
+        fn_ = self._fn
+
+        def fn(ctx):
+            n = ctx.n
+            vals = []
+            for a in compiled_args:
+                v = a.fn(ctx)
+                if isinstance(v, np.ndarray) and v.ndim > 0:
+                    vals.append(v)
+                else:
+                    vals.append(np.full(n, v))
+            out = np.empty(n, dt if dt is object else dt)
+            for i in range(n):
+                out[i] = fn_([v[i] for v in vals])
+            return out
+        from ..plan.expr_compiler import CompiledExpr
+        return CompiledExpr(fn, rt)
+
+
+class SiddhiAppRuntime:
+    def __init__(self, app: SiddhiApp, siddhi_context: SiddhiContext,
+                 app_string: Optional[str] = None):
+        self.app = app
+        self.siddhi_context = siddhi_context
+        name = app.name or f"app_{id(app) & 0xffffff:x}"
+        self.name = name
+        self.app_ctx = SiddhiAppContext(siddhi_context, name)
+        self.app_ctx.runtime = self
+        self.extension_registry: ExtensionRegistry = getattr(
+            siddhi_context, "extension_registry", None) or ExtensionRegistry()
+        for k, v in siddhi_context.extensions.items():
+            self.extension_registry.register(k, v)
+
+        self.stream_definitions: Dict[str, StreamDefinition] = {}
+        self.junctions: Dict[str, StreamJunction] = {}
+        self.tables: Dict[str, InMemoryTable] = {}
+        self.named_windows: Dict[str, NamedWindow] = {}
+        self.aggregations: Dict[str, Any] = {}
+        self.triggers: List[TriggerRuntime] = []
+        self.query_runtimes: Dict[str, QueryRuntime] = {}
+        self.partition_runtimes: List[Any] = []
+        self.input_handlers: Dict[str, InputHandler] = {}
+        self.sources: List[Any] = []
+        self.sinks: List[Any] = []
+        self._started = False
+        self._store_query_cache: Dict[str, Any] = {}
+
+        self.snapshot_service = SnapshotService(self.app_ctx)
+        self.app_ctx.snapshot_service = self.snapshot_service
+        self._parse_app_annotations()
+        self._build()
+
+    # ------------------------------------------------------------ build
+
+    def _parse_app_annotations(self):
+        ann = find_annotation(self.app.annotations, "app:playback")
+        if ann is None:
+            ann = find_annotation(self.app.annotations, "playback")
+        if ann is not None:
+            idle = ann.get("idle.time")
+            inc = ann.get("increment")
+            self.app_ctx.playback = True
+            self.app_ctx.timestamp_generator.enable_playback(
+                _parse_time_str(idle) if idle else None,
+                _parse_time_str(inc) if inc else None)
+        stats = find_annotation(self.app.annotations, "app:statistics")
+        if stats is None:
+            stats = find_annotation(self.app.annotations, "statistics")
+        reporter, interval, enabled = "console", 60, False
+        if stats is not None:
+            reporter = stats.get("reporter", "console")
+            interval = int(stats.get("interval", "60"))
+            enable_attr = stats.get("enable")
+            pos = stats.positional()
+            enabled = True
+            if enable_attr is not None:
+                enabled = str(enable_attr).lower() == "true"
+            elif pos and str(pos[0]).lower() == "false":
+                enabled = False
+        self.app_ctx.statistics_manager = StatisticsManager(
+            self.name, reporter, interval)
+        self.app_ctx.stats_enabled = enabled
+
+    def _build(self):
+        from .source_sink import attach_sources_and_sinks
+
+        app = self.app
+        # 1. streams → junctions
+        for sid, d in app.stream_definitions.items():
+            self.stream_definitions[sid] = d
+            self._make_junction(sid, d)
+        # 2. tables
+        for tid, td in app.table_definitions.items():
+            store_ann = find_annotation(td.annotations, "store")
+            table = None
+            if store_ann is not None and self.extension_registry is not None:
+                store_cls = self.extension_registry.find_store(
+                    store_ann.get("type", ""))
+                if store_cls is not None:
+                    table = store_cls(td, store_ann)
+            self.tables[tid] = table or InMemoryTable(td)
+            self.snapshot_service.register(f"table:{tid}", self.tables[tid])
+        # 3. named windows
+        for wid, wd in app.window_definitions.items():
+            scope = Scope()
+            scope.add_primary(wid, None, wd)
+            compiler = ExprCompiler(scope, np, self.app_ctx.script_functions,
+                                    self.extension_registry)
+            nw = NamedWindow(wd, self.app_ctx, lambda e: compiler.compile(e))
+            self.named_windows[wid] = nw
+            self.snapshot_service.register(f"window:{wid}", nw)
+        # 4. triggers
+        for tid, td in app.trigger_definitions.items():
+            d = trigger_stream_definition(td)
+            self.stream_definitions[tid] = d
+            junction = self._make_junction(tid, d)
+            self.triggers.append(TriggerRuntime(td, junction, self.app_ctx))
+        # 5. script functions
+        for fid, fd in app.function_definitions.items():
+            self.app_ctx.script_functions[fid] = ScriptFunction(fd)
+        # 6. aggregations
+        for aid, ad in app.aggregation_definitions.items():
+            from .aggregation import AggregationRuntime
+            ar = AggregationRuntime(ad, self)
+            self.aggregations[aid] = ar
+            self.snapshot_service.register(f"aggregation:{aid}", ar)
+        # 7. queries + partitions
+        qcount = 0
+        for el in app.execution_elements:
+            if isinstance(el, Query):
+                qname = el.name or f"query_{qcount}"
+                qr = QueryRuntime(el, self, qname)
+                self.query_runtimes[qname] = qr
+                for eid, obj in qr.stateful_elements():
+                    self.snapshot_service.register(eid, obj)
+            else:
+                from .partition import PartitionRuntime
+                pr = PartitionRuntime(el, self, f"partition_{qcount}")
+                self.partition_runtimes.append(pr)
+            qcount += 1
+        # 8. sources & sinks from stream annotations
+        attach_sources_and_sinks(self)
+        # 9. statistics wiring
+        if self.app_ctx.stats_enabled:
+            sm = self.app_ctx.statistics_manager
+            for sid, j in self.junctions.items():
+                j.throughput_tracker = sm.throughput_tracker("Streams", sid)
+
+    def _make_junction(self, sid: str, d: StreamDefinition) -> StreamJunction:
+        fault_junction = None
+        on_err = find_annotation(d.annotations, "onerror")
+        if on_err is not None and \
+                (on_err.get("action", "LOG") or "").upper() == "STREAM":
+            fd = StreamDefinition("!" + sid,
+                                  [a for a in d.attributes])
+            fd.attribute("_error", AttrType.OBJECT)
+            self.stream_definitions["!" + sid] = fd
+            fault_junction = StreamJunction(fd, self.app_ctx)
+            self.junctions["!" + sid] = fault_junction
+        j = StreamJunction(d, self.app_ctx, fault_junction)
+        self.junctions[sid] = j
+        return j
+
+    # ------------------------------------------------------------ lookups
+    # (used by QueryRuntime wiring)
+
+    def definition_of(self, stream_id: str, is_inner=False, is_fault=False):
+        key = ("#" if is_inner else "!" if is_fault else "") + stream_id
+        if is_fault:
+            key = "!" + stream_id
+        d = self.stream_definitions.get(key if not is_inner else stream_id)
+        if d is None and stream_id in self.named_windows:
+            return self.named_windows[stream_id].definition
+        if d is None and stream_id in self.tables:
+            return self.tables[stream_id].definition
+        if d is None:
+            raise DefinitionNotExistError(
+                f"No stream/window/table '{stream_id}' defined")
+        return d
+
+    def junction_of(self, stream_id: str, is_inner=False, is_fault=False,
+                    partition_key: Optional[str] = None,
+                    create_with: Optional[StreamDefinition] = None
+                    ) -> StreamJunction:
+        key = ("!" + stream_id) if is_fault else stream_id
+        j = self.junctions.get(key)
+        if j is None:
+            if create_with is None:
+                raise DefinitionNotExistError(f"No stream '{key}' defined")
+            d = StreamDefinition(stream_id, list(create_with.attributes))
+            self.stream_definitions[stream_id] = d
+            j = self._make_junction(stream_id, d)
+        return j
+
+    def has_table(self, tid: str) -> bool:
+        return tid in self.tables
+
+    def table_of(self, tid: str) -> InMemoryTable:
+        return self.tables[tid]
+
+    def has_named_window(self, wid: str) -> bool:
+        return wid in self.named_windows
+
+    def named_window_of(self, wid: str) -> NamedWindow:
+        return self.named_windows[wid]
+
+    def latency_tracker_for(self, query_name: str):
+        if self.app_ctx.stats_enabled and self.app_ctx.statistics_manager:
+            return self.app_ctx.statistics_manager.latency_tracker(
+                "Queries", query_name)
+        return None
+
+    # ------------------------------------------------------------ public API
+    # (reference SiddhiAppRuntime public surface)
+
+    def get_input_handler(self, stream_id: str) -> InputHandler:
+        h = self.input_handlers.get(stream_id)
+        if h is None:
+            j = self.junctions.get(stream_id)
+            if j is None:
+                raise DefinitionNotExistError(f"No stream '{stream_id}'")
+            h = InputHandler(j, self.app_ctx)
+            self.input_handlers[stream_id] = h
+        return h
+
+    def add_callback(self, target: str, callback) -> None:
+        """StreamCallback on a stream id, or QueryCallback on a query name
+        (reference SiddhiAppRuntime.addCallback overloads :251-270)."""
+        if isinstance(callback, QueryCallback):
+            qr = self.query_runtimes.get(target)
+            if qr is None:
+                for pr in self.partition_runtimes:
+                    qr = pr.query_runtime_by_name(target)
+                    if qr is not None:
+                        break
+            if qr is None:
+                raise DefinitionNotExistError(f"No query '{target}'")
+            qr.add_callback(callback)
+            return
+        j = self.junctions.get(target)
+        if j is None:
+            raise DefinitionNotExistError(f"No stream '{target}'")
+        callback.stream_definition = j.definition
+        j.subscribe(callback)
+
+    def start(self):
+        if self._started:
+            return
+        self._started = True
+        for j in self.junctions.values():
+            j.start()
+        for t in self.triggers:
+            t.start()
+        for s in self.sources:
+            s.connect_with_retry()
+        for s in self.sinks:
+            s.connect_with_retry()
+        if self.app_ctx.stats_enabled:
+            self.app_ctx.statistics_manager.start_reporting()
+
+    def start_without_sources(self):
+        self._started = True
+        for j in self.junctions.values():
+            j.start()
+        for t in self.triggers:
+            t.start()
+
+    def shutdown(self):
+        for s in self.sources:
+            s.shutdown()
+        for s in self.sinks:
+            s.shutdown()
+        for t in self.triggers:
+            t.stop()
+        for j in self.junctions.values():
+            j.stop()
+        self.app_ctx.scheduler.shutdown()
+        self.app_ctx.timestamp_generator.shutdown()
+        if self.app_ctx.statistics_manager:
+            self.app_ctx.statistics_manager.stop_reporting()
+        self._started = False
+
+    # ------------------------------------------------------------ persistence
+
+    def _store(self) -> PersistenceStore:
+        store = self.siddhi_context.persistence_store
+        if store is None:
+            raise NoPersistenceStoreError(
+                "No persistence store set on SiddhiManager")
+        return store
+
+    def persist(self) -> str:
+        return self.snapshot_service.persist(self.name, self._store())
+
+    def restore_revision(self, revision: str):
+        self.snapshot_service.restore_revision(self.name, self._store(),
+                                               revision)
+
+    def restore_last_revision(self) -> Optional[str]:
+        return self.snapshot_service.restore_last_revision(self.name,
+                                                           self._store())
+
+    def clear_all_revisions(self):
+        self._store().clear_all_revisions(self.name)
+
+    def snapshot(self) -> bytes:
+        return self.snapshot_service.full_snapshot()
+
+    def restore(self, snapshot: bytes):
+        self.snapshot_service.restore(snapshot)
+
+    # ------------------------------------------------------------ playback & stats
+
+    def enable_playback(self, idle_time_ms=None, increment_ms=None):
+        self.app_ctx.playback = True
+        self.app_ctx.timestamp_generator.enable_playback(idle_time_ms,
+                                                         increment_ms)
+
+    def enable_stats(self, enabled: bool = True):
+        self.app_ctx.stats_enabled = enabled
+        if enabled:
+            self.app_ctx.statistics_manager.start_reporting()
+        else:
+            self.app_ctx.statistics_manager.stop_reporting()
+
+    @property
+    def statistics(self) -> dict:
+        return self.app_ctx.statistics_manager.snapshot()
+
+    # ------------------------------------------------------------ store queries
+
+    def query(self, store_query: Union[str, Any]):
+        """On-demand query over tables/windows/aggregations
+        (reference SiddhiAppRuntime.query:280-316, LRU-cached runtimes)."""
+        from .store_query import StoreQueryRuntime
+        if isinstance(store_query, str):
+            rt = self._store_query_cache.get(store_query)
+            if rt is None:
+                sq = SiddhiCompiler.parse_store_query(store_query)
+                rt = StoreQueryRuntime(sq, self)
+                if len(self._store_query_cache) > 50:
+                    self._store_query_cache.clear()
+                self._store_query_cache[store_query] = rt
+        else:
+            rt = StoreQueryRuntime(store_query, self)
+        return rt.execute()
+
+
+def _parse_time_str(s: str) -> int:
+    """'100 millisec' / '2 sec' / bare int millis."""
+    from ..compiler.parser import Parser
+    p = Parser(s)
+    return p._parse_time_value()
+
+
+class SiddhiManager:
+    """Top-level factory (reference SiddhiManager.java)."""
+
+    def __init__(self):
+        self.siddhi_context = SiddhiContext()
+        self.siddhi_context.extension_registry = ExtensionRegistry()
+        self.runtimes: Dict[str, SiddhiAppRuntime] = {}
+
+    def create_siddhi_app_runtime(
+            self, app: Union[str, SiddhiApp]) -> SiddhiAppRuntime:
+        if isinstance(app, str):
+            app = SiddhiCompiler.parse(app)
+        rt = SiddhiAppRuntime(app, self.siddhi_context)
+        self.runtimes[rt.name] = rt
+        return rt
+
+    def validate_siddhi_app(self, app: Union[str, SiddhiApp]):
+        """Parse + build, then dispose (reference validateSiddhiApp)."""
+        rt = self.create_siddhi_app_runtime(app)
+        self.runtimes.pop(rt.name, None)
+        rt.shutdown()
+
+    def get_siddhi_app_runtime(self, name: str) -> Optional[SiddhiAppRuntime]:
+        return self.runtimes.get(name)
+
+    def set_extension(self, name: str, impl):
+        self.siddhi_context.set_extension(name, impl)
+        self.siddhi_context.extension_registry.register(name, impl)
+
+    def set_persistence_store(self, store: PersistenceStore):
+        self.siddhi_context.persistence_store = store
+
+    def persist(self):
+        for rt in self.runtimes.values():
+            rt.persist()
+
+    def restore_last_state(self):
+        for rt in self.runtimes.values():
+            rt.restore_last_revision()
+
+    def shutdown(self):
+        for rt in list(self.runtimes.values()):
+            rt.shutdown()
+        self.runtimes.clear()

@@ -1,0 +1,90 @@
+"""Timer-event scheduler.
+
+(reference: util/Scheduler.java — `notifyAt(t)` queue backed by a
+ScheduledExecutorService that injects TIMER StreamEvents into processor chains;
+playback-aware so virtual time drives expiry deterministically.)
+
+Each stateful processor that needs time-based wakeups (time windows, absent
+patterns, cron triggers, output rate timers) registers a target callable; the
+scheduler calls `target.on_timer(ts)` when wall clock (or playback virtual
+time) passes the requested instant.
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from .timestamp import TimestampGenerator
+
+
+class Scheduler:
+    def __init__(self, ts_gen: TimestampGenerator):
+        self._ts_gen = ts_gen
+        self._heap: List[Tuple[int, int, Callable[[int], None]]] = []
+        self._seq = 0
+        self._lock = threading.RLock()
+        self._timer: Optional[threading.Timer] = None
+        self._stopped = False
+        if ts_gen.in_playback:
+            ts_gen.add_time_change_listener(self._on_virtual_time)
+
+    def notify_at(self, ts: int, target: Callable[[int], None]):
+        with self._lock:
+            heapq.heappush(self._heap, (int(ts), self._seq, target))
+            self._seq += 1
+            if not self._ts_gen.in_playback:
+                self._arm()
+
+    # ------------------------------------------------------------ real time
+
+    def _arm(self):
+        if self._stopped or not self._heap:
+            return
+        next_ts = self._heap[0][0]
+        delay = max(0.0, (next_ts - self._ts_gen.current_time()) / 1000.0)
+        if self._timer is not None:
+            self._timer.cancel()
+        self._timer = threading.Timer(delay, self._fire)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _fire(self):
+        now = self._ts_gen.current_time()
+        due = []
+        with self._lock:
+            while self._heap and self._heap[0][0] <= now:
+                due.append(heapq.heappop(self._heap))
+        for ts, _, target in due:
+            try:
+                target(now)
+            except Exception:  # noqa: BLE001 — scheduler thread must survive
+                import logging
+                logging.getLogger(__name__).exception("timer target failed")
+        with self._lock:
+            self._arm()
+
+    # ------------------------------------------------------------ playback
+
+    def _on_virtual_time(self, now: int):
+        self.advance_to(now)
+
+    def advance_to(self, now: int):
+        """Fire all timers due at or before `now` (playback / test use)."""
+        while True:
+            due = []
+            with self._lock:
+                while self._heap and self._heap[0][0] <= now:
+                    due.append(heapq.heappop(self._heap))
+            if not due:
+                return
+            for ts, _, target in due:
+                target(ts)
+
+    def shutdown(self):
+        self._stopped = True
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            self._heap.clear()

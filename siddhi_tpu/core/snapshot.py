@@ -1,0 +1,145 @@
+"""Snapshot service + persistence stores.
+
+(reference: util/snapshot/SnapshotService.java — full/incremental snapshots of
+every registered Snapshotable under the ThreadBarrier; util/persistence/
+{InMemory,FileSystem,IncrementalFileSystem}PersistenceStore.java.)
+
+State here is JSON-serialisable dicts of columnar buffers (no Java object
+serialisation): each stateful element exposes current_state()/restore_state().
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class PersistenceStore:
+    def save(self, app_name: str, revision: str, snapshot: bytes):
+        raise NotImplementedError
+
+    def load(self, app_name: str, revision: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def last_revision(self, app_name: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def clear_all_revisions(self, app_name: str):
+        raise NotImplementedError
+
+
+class InMemoryPersistenceStore(PersistenceStore):
+    def __init__(self):
+        self._data: Dict[str, Dict[str, bytes]] = {}
+
+    def save(self, app_name, revision, snapshot):
+        self._data.setdefault(app_name, {})[revision] = snapshot
+
+    def load(self, app_name, revision):
+        return self._data.get(app_name, {}).get(revision)
+
+    def last_revision(self, app_name):
+        revs = sorted(self._data.get(app_name, {}).keys())
+        return revs[-1] if revs else None
+
+    def clear_all_revisions(self, app_name):
+        self._data.pop(app_name, None)
+
+
+class FileSystemPersistenceStore(PersistenceStore):
+    def __init__(self, base_dir: str):
+        self.base_dir = base_dir
+
+    def _dir(self, app_name):
+        d = os.path.join(self.base_dir, app_name)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def save(self, app_name, revision, snapshot):
+        with open(os.path.join(self._dir(app_name), revision), "wb") as f:
+            f.write(snapshot)
+
+    def load(self, app_name, revision):
+        p = os.path.join(self._dir(app_name), revision)
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return f.read()
+
+    def last_revision(self, app_name):
+        revs = sorted(os.listdir(self._dir(app_name)))
+        return revs[-1] if revs else None
+
+    def clear_all_revisions(self, app_name):
+        d = self._dir(app_name)
+        for f in os.listdir(d):
+            os.remove(os.path.join(d, f))
+
+
+class SnapshotService:
+    """Registry of stateful elements; produces/consumes revisions."""
+
+    def __init__(self, app_ctx):
+        self.app_ctx = app_ctx
+        self._elements: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def register(self, element_id: str, element):
+        self._elements[element_id] = element
+
+    def deregister(self, element_id: str):
+        self._elements.pop(element_id, None)
+
+    # ------------------------------------------------------------ snapshot
+
+    def full_snapshot(self) -> bytes:
+        """ThreadBarrier-locked capture of every element's state
+        (reference SnapshotService.fullSnapshot:97-158)."""
+        barrier = self.app_ctx.thread_barrier
+        barrier.lock()
+        try:
+            state = {}
+            for eid, el in self._elements.items():
+                s = el.current_state()
+                if s is not None:
+                    state[eid] = s
+            return pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+        finally:
+            barrier.unlock()
+
+    def restore(self, snapshot: bytes):
+        state = pickle.loads(snapshot)
+        barrier = self.app_ctx.thread_barrier
+        barrier.lock()
+        try:
+            for eid, s in state.items():
+                el = self._elements.get(eid)
+                if el is not None:
+                    el.restore_state(s)
+        finally:
+            barrier.unlock()
+
+    # ------------------------------------------------------------ revisions
+
+    def persist(self, app_name: str, store: PersistenceStore) -> str:
+        revision = f"{int(time.time() * 1000)}_{app_name}"
+        store.save(app_name, revision, self.full_snapshot())
+        return revision
+
+    def restore_revision(self, app_name: str, store: PersistenceStore,
+                         revision: str):
+        from ..utils.errors import CannotRestoreStateError
+        snap = store.load(app_name, revision)
+        if snap is None:
+            raise CannotRestoreStateError(f"No revision {revision}")
+        self.restore(snap)
+
+    def restore_last_revision(self, app_name: str,
+                              store: PersistenceStore) -> Optional[str]:
+        rev = store.last_revision(app_name)
+        if rev is not None:
+            self.restore_revision(app_name, store, rev)
+        return rev

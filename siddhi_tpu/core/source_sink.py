@@ -1,0 +1,435 @@
+"""Sources, sinks, mappers and the in-memory transport.
+
+(reference: stream/input/source/{Source,SourceMapper}.java lifecycle with
+backoff retry, stream/output/sink/{Sink,SinkMapper}.java, InMemory transport
+util/transport/InMemoryBroker.java, sink option {{templates}} via
+TemplateBuilder/OptionHolder, distributed sinks
+stream/output/sink/distributed/*.)
+
+Wired from `@source(type='inMemory', topic='t', @map(type='passThrough'))` /
+`@sink(...)` annotations on stream definitions.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..query_api.annotation import Annotation, find_all, find_annotation
+from ..utils.errors import (ConnectionUnavailableError, MappingFailedError,
+                            SiddhiAppCreationError)
+from .event import CURRENT, EXPIRED, Event, EventChunk
+
+log = logging.getLogger(__name__)
+
+
+# ===================================================================== broker
+
+class InMemoryBroker:
+    """Global topic bus (reference util/transport/InMemoryBroker.java)."""
+
+    _subscribers: Dict[str, List[Any]] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def subscribe(cls, subscriber):
+        """subscriber: object with .topic and .on_message(obj)."""
+        with cls._lock:
+            cls._subscribers.setdefault(subscriber.topic, []).append(subscriber)
+
+    @classmethod
+    def unsubscribe(cls, subscriber):
+        with cls._lock:
+            subs = cls._subscribers.get(subscriber.topic, [])
+            if subscriber in subs:
+                subs.remove(subscriber)
+
+    @classmethod
+    def publish(cls, topic: str, obj):
+        for s in list(cls._subscribers.get(topic, [])):
+            s.on_message(obj)
+
+
+# ===================================================================== mappers
+
+class SourceMapper:
+    """format → Event[] (reference stream/input/source/SourceMapper.java)."""
+
+    def __init__(self, definition, options: Dict[str, str]):
+        self.definition = definition
+        self.options = options
+
+    def map(self, obj) -> List[Event]:
+        raise NotImplementedError
+
+
+class PassThroughSourceMapper(SourceMapper):
+    def map(self, obj) -> List[Event]:
+        if isinstance(obj, Event):
+            return [obj]
+        if isinstance(obj, (list, tuple)):
+            if obj and isinstance(obj[0], Event):
+                return list(obj)
+            return [Event(int(time.time() * 1000), list(obj))]
+        raise MappingFailedError(f"passThrough cannot map {type(obj)}")
+
+
+class JsonSourceMapper(SourceMapper):
+    """{"event": {attr: value, ...}} or a list of such (reference
+    siddhi-map-json extension behaviour)."""
+
+    def map(self, obj) -> List[Event]:
+        data = json.loads(obj) if isinstance(obj, (str, bytes)) else obj
+        if isinstance(data, dict):
+            data = [data]
+        out = []
+        for item in data:
+            payload = item.get("event", item)
+            row = [payload.get(a.name) for a in self.definition.attributes]
+            out.append(Event(int(item.get("timestamp",
+                                          time.time() * 1000)), row))
+        return out
+
+
+class SinkMapper:
+    def __init__(self, definition, options: Dict[str, str]):
+        self.definition = definition
+        self.options = options
+
+    def map(self, events: List[Event]):
+        raise NotImplementedError
+
+
+class PassThroughSinkMapper(SinkMapper):
+    def map(self, events: List[Event]):
+        return events
+
+
+class JsonSinkMapper(SinkMapper):
+    def map(self, events: List[Event]):
+        names = [a.name for a in self.definition.attributes]
+        return json.dumps([{"event": dict(zip(names, e.data)),
+                            "timestamp": e.timestamp} for e in events])
+
+
+class TextSinkMapper(SinkMapper):
+    def map(self, events: List[Event]):
+        names = [a.name for a in self.definition.attributes]
+        return "\n".join(
+            ", ".join(f"{n}:{v}" for n, v in zip(names, e.data))
+            for e in events)
+
+
+SOURCE_MAPPERS = {"passthrough": PassThroughSourceMapper,
+                  "json": JsonSourceMapper}
+SINK_MAPPERS = {"passthrough": PassThroughSinkMapper,
+                "json": JsonSinkMapper, "text": TextSinkMapper}
+
+
+# ===================================================================== source
+
+class Source:
+    """Base source with connect-retry lifecycle
+    (reference Source.connectWithRetry:128-157 + BackoffRetryCounter)."""
+
+    RETRIES = [0.0, 0.05, 0.1, 0.5, 1.0, 2.0]
+
+    def __init__(self, stream_def, options: Dict[str, str],
+                 mapper: SourceMapper, input_handler):
+        self.stream_def = stream_def
+        self.options = options
+        self.mapper = mapper
+        self.input_handler = input_handler
+        self.connected = False
+
+    def connect(self):
+        raise NotImplementedError
+
+    def disconnect(self):
+        pass
+
+    def connect_with_retry(self):
+        for i, delay in enumerate(self.RETRIES):
+            if delay:
+                time.sleep(delay)
+            try:
+                self.connect()
+                self.connected = True
+                return
+            except ConnectionUnavailableError as e:
+                log.warning("source connect failed (attempt %d): %s", i + 1, e)
+        log.error("source for %s could not connect", self.stream_def.id)
+
+    def shutdown(self):
+        try:
+            self.disconnect()
+        finally:
+            self.connected = False
+
+    def deliver(self, obj):
+        try:
+            events = self.mapper.map(obj)
+        except MappingFailedError as e:
+            log.error("mapping failed on %s: %s", self.stream_def.id, e)
+            return
+        if events:
+            self.input_handler.send(events)
+
+
+class InMemorySource(Source):
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.topic = self.options.get("topic", self.stream_def.id)
+
+    def connect(self):
+        InMemoryBroker.subscribe(self)
+
+    def disconnect(self):
+        InMemoryBroker.unsubscribe(self)
+
+    def on_message(self, obj):
+        self.deliver(obj)
+
+
+# ===================================================================== sink
+
+_TEMPLATE_RE = re.compile(r"\{\{(\w+)\}\}")
+
+
+class Sink:
+    """Base sink; junction subscriber publishing mapped events
+    (reference Sink.java:49-167)."""
+
+    RETRIES = Source.RETRIES
+
+    def __init__(self, stream_def, options: Dict[str, str], mapper: SinkMapper):
+        self.stream_def = stream_def
+        self.options = options
+        self.mapper = mapper
+        self.connected = False
+
+    # dynamic option templating: topic='{{symbol}}' resolved per event
+    def resolve_option(self, key: str, event: Event) -> Optional[str]:
+        raw = self.options.get(key)
+        if raw is None:
+            return None
+        names = [a.name for a in self.stream_def.attributes]
+
+        def sub(m):
+            try:
+                return str(event.data[names.index(m.group(1))])
+            except ValueError:
+                return m.group(0)
+        return _TEMPLATE_RE.sub(sub, raw)
+
+    def connect(self):
+        pass
+
+    def disconnect(self):
+        pass
+
+    def connect_with_retry(self):
+        for i, delay in enumerate(self.RETRIES):
+            if delay:
+                time.sleep(delay)
+            try:
+                self.connect()
+                self.connected = True
+                return
+            except ConnectionUnavailableError as e:
+                log.warning("sink connect failed (attempt %d): %s", i + 1, e)
+
+    def shutdown(self):
+        try:
+            self.disconnect()
+        finally:
+            self.connected = False
+
+    def publish(self, payload, event: Event):
+        raise NotImplementedError
+
+    # junction-facing
+    def receive_chunk(self, chunk: EventChunk):
+        events = chunk.only(CURRENT).to_events()
+        if not events:
+            return
+        if self._is_dynamic():
+            for e in events:
+                self._publish_with_retry(self.mapper.map([e]), e)
+        else:
+            self._publish_with_retry(self.mapper.map(events), events[0])
+
+    def _is_dynamic(self) -> bool:
+        return any(isinstance(v, str) and _TEMPLATE_RE.search(v)
+                   for v in self.options.values())
+
+    def _publish_with_retry(self, payload, event):
+        for i, delay in enumerate(self.RETRIES):
+            if delay:
+                time.sleep(delay)
+            try:
+                self.publish(payload, event)
+                return
+            except ConnectionUnavailableError as e:
+                self.connected = False
+                log.warning("sink publish failed (attempt %d): %s", i + 1, e)
+        log.error("sink for %s dropped events after retries",
+                  self.stream_def.id)
+
+
+class InMemorySink(Sink):
+    def publish(self, payload, event: Event):
+        topic = self.resolve_option("topic", event) or self.stream_def.id
+        InMemoryBroker.publish(topic, payload)
+
+
+class LogSink(Sink):
+    """@sink(type='log') (reference LogSink.java)."""
+
+    def publish(self, payload, event: Event):
+        prefix = self.options.get("prefix", self.stream_def.id)
+        log.info("%s : %s", prefix, payload)
+
+
+SOURCES = {"inmemory": InMemorySource}
+SINKS = {"inmemory": InMemorySink, "log": LogSink}
+
+
+# ============================================================ distributed sinks
+
+class DistributionStrategy:
+    """(reference stream/output/sink/distributed/DistributionStrategy.java +
+    RoundRobin/Broadcast/Partitioned implementations)."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def destinations_for(self, event: Event, key=None) -> List[int]:
+        raise NotImplementedError
+
+
+class RoundRobinStrategy(DistributionStrategy):
+    def __init__(self, n):
+        super().__init__(n)
+        self._i = 0
+
+    def destinations_for(self, event, key=None):
+        d = self._i % self.n
+        self._i += 1
+        return [d]
+
+
+class BroadcastStrategy(DistributionStrategy):
+    def destinations_for(self, event, key=None):
+        return list(range(self.n))
+
+
+class PartitionedStrategy(DistributionStrategy):
+    def __init__(self, n, key_index: int):
+        super().__init__(n)
+        self.key_index = key_index
+
+    def destinations_for(self, event, key=None):
+        return [hash(event.data[self.key_index]) % self.n]
+
+
+class DistributedSink(Sink):
+    """Multi-destination sink wrapper (reference
+    util/transport/{Single,Multi}ClientDistributedSink.java)."""
+
+    def __init__(self, stream_def, options, mapper, destinations: List[Sink],
+                 strategy: DistributionStrategy):
+        super().__init__(stream_def, options, mapper)
+        self.destinations = destinations
+        self.strategy = strategy
+
+    def connect(self):
+        for d in self.destinations:
+            d.connect_with_retry()
+
+    def disconnect(self):
+        for d in self.destinations:
+            d.disconnect()
+
+    def receive_chunk(self, chunk: EventChunk):
+        events = chunk.only(CURRENT).to_events()
+        for e in events:
+            for di in self.strategy.destinations_for(e):
+                self.destinations[di]._publish_with_retry(
+                    self.destinations[di].mapper.map([e]), e)
+
+
+# ===================================================================== wiring
+
+def attach_sources_and_sinks(app_runtime):
+    """Scan stream definitions for @source/@sink annotations."""
+    for sid, d in list(app_runtime.stream_definitions.items()):
+        for ann in find_all(d.annotations, "source"):
+            src = _build_source(app_runtime, d, ann)
+            app_runtime.sources.append(src)
+        for ann in find_all(d.annotations, "sink"):
+            sink = _build_sink(app_runtime, d, ann)
+            app_runtime.sinks.append(sink)
+            app_runtime.junctions[sid].subscribe(sink)
+
+
+def _map_options(ann: Annotation) -> (str, Dict[str, str]):
+    m = find_annotation(ann.annotations, "map")
+    if m is None:
+        return "passthrough", {}
+    return (m.get("type", "passThrough") or "passThrough").lower(), m.as_dict()
+
+
+def _build_source(app_runtime, d, ann: Annotation) -> Source:
+    stype = (ann.get("type", "inMemory") or "inMemory").lower()
+    opts = ann.as_dict()
+    map_type, map_opts = _map_options(ann)
+    mapper_cls = SOURCE_MAPPERS.get(map_type)
+    if mapper_cls is None:
+        raise SiddhiAppCreationError(f"Unknown source mapper '{map_type}'")
+    mapper = mapper_cls(d, map_opts)
+    handler = app_runtime.get_input_handler(d.id)
+    cls = SOURCES.get(stype)
+    if cls is None and app_runtime.extension_registry is not None:
+        cls = app_runtime.extension_registry.find_source(stype)
+    if cls is None:
+        raise SiddhiAppCreationError(f"Unknown source type '{stype}'")
+    return cls(d, opts, mapper, handler)
+
+
+def _build_sink(app_runtime, d, ann: Annotation) -> Sink:
+    stype = (ann.get("type", "inMemory") or "inMemory").lower()
+    opts = ann.as_dict()
+    map_type, map_opts = _map_options(ann)
+    mapper_cls = SINK_MAPPERS.get(map_type)
+    if mapper_cls is None:
+        raise SiddhiAppCreationError(f"Unknown sink mapper '{map_type}'")
+    mapper = mapper_cls(d, map_opts)
+    dist = find_annotation(ann.annotations, "distribution")
+    cls = SINKS.get(stype)
+    if cls is None and app_runtime.extension_registry is not None:
+        cls = app_runtime.extension_registry.find_sink(stype)
+    if cls is None:
+        raise SiddhiAppCreationError(f"Unknown sink type '{stype}'")
+    if dist is not None:
+        dests = []
+        for dest_ann in find_all(dist.annotations, "destination"):
+            dopts = dict(opts)
+            dopts.update(dest_ann.as_dict())
+            dests.append(cls(d, dopts, mapper_cls(d, map_opts)))
+        strategy_name = (dist.get("strategy", "roundRobin") or "").lower()
+        if strategy_name == "broadcast":
+            strategy = BroadcastStrategy(len(dests))
+        elif strategy_name == "partitioned":
+            key = dist.get("partitionKey", d.attributes[0].name)
+            idx = d.index_of(key)
+            strategy = PartitionedStrategy(len(dests), max(idx, 0))
+        else:
+            strategy = RoundRobinStrategy(len(dests))
+        return DistributedSink(d, opts, mapper, dests, strategy)
+    return cls(d, opts, mapper)

@@ -1,0 +1,146 @@
+"""Statistics / metrics subsystem.
+
+(reference: util/statistics/** — Codahale metrics-core trackers behind
+StatisticsManager / StatisticsTrackerFactory SPIs; throughput per junction,
+latency per query, memory gauges; console/JMX reporters configured by
+`@app:statistics(reporter='console', interval='5')`.)
+
+Here: lightweight in-process counters with an optional periodic console/JSON
+reporter thread.  The memory gauge reports numpy buffer footprints of
+registered state holders instead of walking a Java object graph.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class ThroughputTracker:
+    __slots__ = ("name", "count", "_t0")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self._t0 = time.time()
+
+    def event_in(self, n: int = 1):
+        self.count += n
+
+    def rate(self) -> float:
+        dt = time.time() - self._t0
+        return self.count / dt if dt > 0 else 0.0
+
+
+class LatencyTracker:
+    __slots__ = ("name", "total_ns", "count", "_mark")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.total_ns = 0
+        self.count = 0
+        self._mark = 0
+
+    def mark_in(self):
+        self._mark = time.perf_counter_ns()
+
+    def mark_out(self):
+        if self._mark:
+            self.total_ns += time.perf_counter_ns() - self._mark
+            self.count += 1
+            self._mark = 0
+
+    def avg_ms(self) -> float:
+        return (self.total_ns / self.count) / 1e6 if self.count else 0.0
+
+
+class MemoryTracker:
+    """Gauge over registered state holders exposing `memory_bytes()`."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._holders: List[Callable[[], int]] = []
+
+    def register(self, fn: Callable[[], int]):
+        self._holders.append(fn)
+
+    def bytes(self) -> int:
+        return sum(f() for f in self._holders)
+
+
+class BufferedEventsTracker:
+    def __init__(self, name: str):
+        self.name = name
+        self.buffered = 0
+
+
+class StatisticsManager:
+    """Registry + reporter.  Metric naming mirrors the reference:
+    io.siddhi.SiddhiApps.<app>.Siddhi.<kind>.<name>
+    (reference SiddhiAppRuntime.java:720-727)."""
+
+    def __init__(self, app_name: str, reporter: str = "console",
+                 interval_s: int = 60):
+        self.app_name = app_name
+        self.reporter = reporter
+        self.interval_s = interval_s
+        self.throughput: Dict[str, ThroughputTracker] = {}
+        self.latency: Dict[str, LatencyTracker] = {}
+        self.memory: Dict[str, MemoryTracker] = {}
+        self.buffered: Dict[str, BufferedEventsTracker] = {}
+        self.enabled = False
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def _metric(self, kind: str, name: str) -> str:
+        return f"io.siddhi.SiddhiApps.{self.app_name}.Siddhi.{kind}.{name}"
+
+    def throughput_tracker(self, kind: str, name: str) -> ThroughputTracker:
+        key = self._metric(kind, name)
+        return self.throughput.setdefault(key, ThroughputTracker(key))
+
+    def latency_tracker(self, kind: str, name: str) -> LatencyTracker:
+        key = self._metric(kind, name)
+        return self.latency.setdefault(key, LatencyTracker(key))
+
+    def memory_tracker(self, kind: str, name: str) -> MemoryTracker:
+        key = self._metric(kind, name)
+        return self.memory.setdefault(key, MemoryTracker(key))
+
+    def buffered_tracker(self, kind: str, name: str) -> BufferedEventsTracker:
+        key = self._metric(kind, name)
+        return self.buffered.setdefault(key, BufferedEventsTracker(key))
+
+    def snapshot(self) -> dict:
+        return {
+            "throughput": {k: {"count": t.count, "rate_eps": t.rate()}
+                           for k, t in self.throughput.items()},
+            "latency_ms": {k: t.avg_ms() for k, t in self.latency.items()},
+            "memory_bytes": {k: m.bytes() for k, m in self.memory.items()},
+            "buffered": {k: b.buffered for k, b in self.buffered.items()},
+        }
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start_reporting(self):
+        self.enabled = True
+        if self.reporter not in ("console", "json") or self.interval_s <= 0:
+            return
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                if self.enabled:
+                    print(json.dumps({"siddhi_stats": self.snapshot()}),
+                          file=sys.stderr)
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop_reporting(self):
+        self.enabled = False
+        self._stop.set()
+        self._thread = None

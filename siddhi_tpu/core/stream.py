@@ -1,0 +1,259 @@
+"""Stream junctions, input handlers and callbacks.
+
+(reference: stream/StreamJunction.java — per-stream pub/sub hub with sync mode
+and @Async disruptor ring-buffer mode, @OnError fault-stream routing;
+stream/input/{InputManager,InputHandler,InputEntryValve,InputDistributor}.java;
+stream/output/StreamCallback.java; query/output/callback/QueryCallback.java.)
+
+TPU-native shape: receivers exchange columnar EventChunks, so one `send` can
+carry a whole micro-batch.  @Async mode replaces the LMAX disruptor with a
+bounded queue + worker thread that re-batches pending events into larger chunks
+(the host-side analogue of double-buffered device feeding).
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import traceback
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..query_api.annotation import find_annotation
+from ..query_api.definition import StreamDefinition
+from ..utils.errors import SiddhiAppRuntimeException
+from .context import SiddhiAppContext
+from .event import CURRENT, EXPIRED, Event, EventChunk
+
+log = logging.getLogger(__name__)
+
+FAULT_PREFIX = "!"
+
+
+class StreamCallback:
+    """User callback attached to a stream (reference
+    stream/output/StreamCallback.java).  Subclass and override `receive`."""
+
+    def __init__(self, fn: Optional[Callable[[List[Event]], None]] = None):
+        self._fn = fn
+        self.stream_definition: Optional[StreamDefinition] = None
+
+    def receive(self, events: List[Event]):
+        if self._fn is not None:
+            self._fn(events)
+
+    # junction-facing
+    def receive_chunk(self, chunk: EventChunk):
+        ev = chunk.only(CURRENT, EXPIRED).to_events()
+        if ev:
+            self.receive(ev)
+
+
+class QueryCallback:
+    """Per-query callback with (timestamp, current[], expired[]) signature
+    (reference query/output/callback/QueryCallback.java)."""
+
+    def __init__(self, fn: Optional[Callable[[int, Optional[List[Event]],
+                                              Optional[List[Event]]], None]] = None):
+        self._fn = fn
+
+    def receive(self, timestamp: int, current: Optional[List[Event]],
+                expired: Optional[List[Event]]):
+        if self._fn is not None:
+            self._fn(timestamp, current, expired)
+
+    def receive_chunk(self, chunk: EventChunk):
+        if chunk.is_empty:
+            return
+        cur = chunk.only(CURRENT).to_events()
+        exp = chunk.only(EXPIRED).to_events()
+        if not cur and not exp:
+            return
+        ts = int(chunk.timestamps[-1])
+        self.receive(ts, cur or None, exp or None)
+
+
+class StreamJunction:
+    """Pub/sub hub for one stream."""
+
+    def __init__(self, definition: StreamDefinition,
+                 app_ctx: SiddhiAppContext, fault_junction=None):
+        self.definition = definition
+        self.app_ctx = app_ctx
+        self.receivers: List[Any] = []   # objects with receive_chunk(chunk)
+        self.fault_junction: Optional[StreamJunction] = fault_junction
+        self.on_error_action = "LOG"
+        self.throughput_tracker = None
+        # async config (reference @Async(buffer.size, workers, batch.size.max))
+        self.is_async = False
+        self.buffer_size = 1024
+        self.workers = 1
+        self.batch_size_max = 256
+        self._queue: Optional[queue.Queue] = None
+        self._worker_threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._configure_from_annotations()
+
+    def _configure_from_annotations(self):
+        ann = find_annotation(self.definition.annotations, "async")
+        if ann is not None:
+            self.is_async = True
+            self.buffer_size = int(ann.get("buffer.size", "1024"))
+            self.workers = int(ann.get("workers", "1"))
+            self.batch_size_max = int(ann.get("batch.size.max", "256"))
+        on_err = find_annotation(self.definition.annotations, "onerror")
+        if on_err is not None:
+            self.on_error_action = (on_err.get("action", "LOG") or "LOG").upper()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self):
+        if self.is_async and self._queue is None:
+            self._queue = queue.Queue(maxsize=self.buffer_size)
+            self._stop.clear()
+            for i in range(self.workers):
+                t = threading.Thread(target=self._worker_loop, daemon=True,
+                                     name=f"junction-{self.definition.id}-{i}")
+                t.start()
+                self._worker_threads.append(t)
+
+    def stop(self):
+        self._stop.set()
+        if self._queue is not None:
+            for _ in self._worker_threads:
+                try:
+                    self._queue.put_nowait(None)
+                except queue.Full:
+                    pass
+        for t in self._worker_threads:
+            t.join(timeout=2.0)
+        self._worker_threads.clear()
+        self._queue = None
+
+    def _worker_loop(self):
+        """Re-batches queued chunks up to batch_size_max before delivery
+        (reference util/event/handler/StreamHandler.java re-batching)."""
+        while not self._stop.is_set():
+            try:
+                item = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is None:
+                break
+            batch = [item]
+            n = len(item)
+            while n < self.batch_size_max:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._stop.set()
+                    break
+                batch.append(nxt)
+                n += len(nxt)
+            merged = EventChunk.concat(batch) if len(batch) > 1 else batch[0]
+            self._deliver(merged)
+
+    # ------------------------------------------------------------ sending
+
+    def subscribe(self, receiver):
+        if receiver not in self.receivers:
+            self.receivers.append(receiver)
+
+    def unsubscribe(self, receiver):
+        if receiver in self.receivers:
+            self.receivers.remove(receiver)
+
+    def send(self, chunk: EventChunk):
+        if chunk.is_empty:
+            return
+        if self.throughput_tracker is not None:
+            self.throughput_tracker.event_in(len(chunk))
+        if self.is_async and self._queue is not None:
+            self._queue.put(chunk)
+        else:
+            self._deliver(chunk)
+
+    def _deliver(self, chunk: EventChunk):
+        for r in list(self.receivers):
+            try:
+                r.receive_chunk(chunk)
+            except Exception as e:  # noqa: BLE001 — @OnError boundary
+                self._handle_error(chunk, e)
+
+    def _handle_error(self, chunk: EventChunk, e: Exception):
+        if self.on_error_action == "STREAM" and self.fault_junction is not None:
+            # route into !stream with an extra _error attribute
+            fault_def = self.fault_junction.definition
+            cols = dict(chunk.columns)
+            cols["_error"] = np.asarray([repr(e)] * len(chunk), object)
+            fchunk = EventChunk(fault_def.attribute_names, chunk.timestamps,
+                                chunk.types, cols)
+            self.fault_junction.send(fchunk)
+        else:
+            log.error("Error processing stream '%s': %s\n%s",
+                      self.definition.id, e, traceback.format_exc())
+            for listener in self.app_ctx.exception_listeners:
+                listener(e)
+
+
+class InputHandler:
+    """User-facing ingestion for one stream (reference
+    stream/input/InputHandler.java:51-85: send(Object[]), send(Event),
+    send(Event[]) — here additionally columnar `send_batch`)."""
+
+    def __init__(self, junction: StreamJunction, app_ctx: SiddhiAppContext):
+        self.junction = junction
+        self.app_ctx = app_ctx
+        self.definition = junction.definition
+
+    def send(self, data, timestamp: Optional[int] = None):
+        """send(Object[]) / send(Event) / send([Event,...]) /
+        send([Object[],...])."""
+        barrier = self.app_ctx.thread_barrier
+        barrier.pass_through()
+        rows: List[Sequence[Any]]
+        stamps: List[int]
+        if isinstance(data, Event):
+            rows, stamps = [data.data], [data.timestamp]
+        elif isinstance(data, (list, tuple)) and data and \
+                isinstance(data[0], Event):
+            rows = [e.data for e in data]
+            stamps = [e.timestamp for e in data]
+        else:
+            now = timestamp if timestamp is not None \
+                else self.app_ctx.current_time()
+            rows, stamps = [list(data)], [now]
+        if timestamp is not None:
+            stamps = [timestamp] * len(rows)
+        width = len(self.definition.attributes)
+        for r in rows:
+            if len(r) != width:
+                raise SiddhiAppRuntimeException(
+                    f"Stream '{self.definition.id}' expects {width} "
+                    f"attributes {self.definition.attribute_names}, got "
+                    f"{len(r)}: {list(r)!r}")
+        for ts in stamps:
+            self.app_ctx.timestamp_generator.observe_event_time(ts)
+        chunk = EventChunk.from_rows(self.definition, rows, stamps)
+        self.junction.send(chunk)
+        if self.app_ctx.timestamp_generator.in_playback:
+            self.app_ctx.scheduler.advance_to(max(stamps))
+
+    def send_batch(self, columns, timestamps=None):
+        """Columnar fast path: dict name→array (+ optional int64 timestamps)."""
+        self.app_ctx.thread_barrier.pass_through()
+        names = self.definition.attribute_names
+        n = len(next(iter(columns.values())))
+        if timestamps is None:
+            timestamps = np.full(n, self.app_ctx.current_time(), np.int64)
+        ts_arr = np.asarray(timestamps, np.int64)
+        if len(ts_arr) > 0:
+            self.app_ctx.timestamp_generator.observe_event_time(
+                int(ts_arr.max()))
+        chunk = EventChunk.from_columns(names, ts_arr, dict(columns))
+        self.junction.send(chunk)
+        if self.app_ctx.timestamp_generator.in_playback and len(ts_arr) > 0:
+            self.app_ctx.scheduler.advance_to(int(ts_arr.max()))

@@ -1,0 +1,72 @@
+"""Timestamp generation + playback virtual time.
+
+(reference: util/timestamp/TimestampGeneratorImpl.java — wall clock by default;
+in @app:playback mode currentTime() returns the last seen event timestamp,
+optionally advanced by an idle-time heartbeat.)
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+
+class TimestampGenerator:
+    def __init__(self):
+        self._playback = False
+        self._last_event_time = -1
+        self._idle_time_ms: Optional[int] = None
+        self._increment_ms: Optional[int] = None
+        self._listeners: List[Callable[[int], None]] = []
+        self._heartbeat: Optional[threading.Timer] = None
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ config
+    def enable_playback(self, idle_time_ms: Optional[int] = None,
+                        increment_ms: Optional[int] = None):
+        self._playback = True
+        self._idle_time_ms = idle_time_ms
+        self._increment_ms = increment_ms
+        self._arm_heartbeat()
+
+    @property
+    def in_playback(self) -> bool:
+        return self._playback
+
+    # ------------------------------------------------------------ use
+    def current_time(self) -> int:
+        if self._playback:
+            return self._last_event_time
+        return int(time.time() * 1000)
+
+    def observe_event_time(self, ts: int):
+        if self._playback:
+            with self._lock:
+                if ts > self._last_event_time:
+                    self._last_event_time = ts
+            self._arm_heartbeat()
+
+    def add_time_change_listener(self, fn: Callable[[int], None]):
+        self._listeners.append(fn)
+
+    def _arm_heartbeat(self):
+        if not self._playback or self._idle_time_ms is None:
+            return
+        if self._heartbeat is not None:
+            self._heartbeat.cancel()
+
+        def tick():
+            with self._lock:
+                self._last_event_time += (self._increment_ms or 0)
+                now = self._last_event_time
+            for fn in list(self._listeners):
+                fn(now)
+            self._arm_heartbeat()
+        self._heartbeat = threading.Timer(self._idle_time_ms / 1000.0, tick)
+        self._heartbeat.daemon = True
+        self._heartbeat.start()
+
+    def shutdown(self):
+        if self._heartbeat is not None:
+            self._heartbeat.cancel()
+            self._heartbeat = None

@@ -1,0 +1,69 @@
+"""Exception hierarchy (reference: siddhi-core exception/ — 17 types, plus
+query-compiler SiddhiParserException).  Parser errors carry line/column of the
+offending token, mirroring the reference's query-context indices."""
+from __future__ import annotations
+
+
+class SiddhiAppCreationError(Exception):
+    """App could not be planned/validated."""
+
+
+class SiddhiParserException(Exception):
+    def __init__(self, message: str, line: int = -1, col: int = -1):
+        self.line = line
+        self.col = col
+        if line >= 0:
+            message = f"{message} (line {line}, col {col})"
+        super().__init__(message)
+
+
+class SiddhiAppValidationException(SiddhiAppCreationError):
+    pass
+
+
+class DuplicateDefinitionError(SiddhiAppValidationException):
+    pass
+
+
+class DuplicateAttributeError(SiddhiAppValidationException):
+    pass
+
+
+class AttributeNotExistError(SiddhiAppValidationException):
+    pass
+
+
+class DefinitionNotExistError(SiddhiAppValidationException):
+    pass
+
+
+class OperationNotSupportedError(Exception):
+    pass
+
+
+class ExtensionNotFoundError(SiddhiAppCreationError):
+    pass
+
+
+class SiddhiAppRuntimeException(Exception):
+    """Runtime event-processing failure (routed to @OnError handling)."""
+
+
+class StoreQueryCreationError(SiddhiAppCreationError):
+    pass
+
+
+class CannotRestoreStateError(Exception):
+    pass
+
+
+class NoPersistenceStoreError(Exception):
+    pass
+
+
+class ConnectionUnavailableError(Exception):
+    """Raised by sources/sinks when the transport is down; triggers backoff retry."""
+
+
+class MappingFailedError(Exception):
+    pass

@@ -1,0 +1,100 @@
+"""Extension registry — the plugin SPI.
+
+(reference: util/SiddhiExtensionLoader.java classpath scanning of @Extension
+annotation index + util/extension/holder/*ExtensionHolder typed lookups +
+siddhi-annotations module.)
+
+Python-native shape: extensions register programmatically
+(`SiddhiManager.set_extension("ns:name", impl)`) or via
+`importlib.metadata` entry points in the ``siddhi_tpu.extensions`` group.
+Supported kinds: scalar functions, attribute aggregators, windows, stream
+processors, sources, sinks, mappers, stores.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+
+class FunctionExtension:
+    """Scalar function extension.  Subclass and implement apply(*cols) →
+    column; declare return_type (AttrType)."""
+
+    return_type = None
+
+    def apply(self, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def compile_call(cls, compiled_args, compiler):
+        from ..plan.expr_compiler import CompiledExpr
+        inst = cls()
+
+        def fn(ctx):
+            return inst.apply(*[a.fn(ctx) for a in compiled_args])
+        return CompiledExpr(fn, cls.return_type or compiled_args[0].type
+                            if compiled_args else cls.return_type)
+
+
+class ExtensionRegistry:
+    def __init__(self):
+        self._by_name: Dict[str, Any] = {}
+        self._loaded_entry_points = False
+
+    @staticmethod
+    def _key(ns: str, name: str) -> str:
+        ns = (ns or "").lower()
+        return f"{ns}:{name.lower()}" if ns else name.lower()
+
+    def register(self, name: str, impl):
+        """name is 'ns:name' or plain 'name'."""
+        self._by_name[name.lower()] = impl
+
+    def _load_entry_points(self):
+        if self._loaded_entry_points:
+            return
+        self._loaded_entry_points = True
+        try:
+            from importlib.metadata import entry_points
+            for ep in entry_points(group="siddhi_tpu.extensions"):
+                try:
+                    self._by_name.setdefault(ep.name.lower(), ep.load())
+                except Exception:  # noqa: BLE001 — bad plugin must not kill app
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "failed loading extension %s", ep.name)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _find(self, ns: str, name: str, kind) -> Optional[Any]:
+        self._load_entry_points()
+        impl = self._by_name.get(self._key(ns, name))
+        if impl is None:
+            return None
+        if kind is not None and isinstance(impl, type) and \
+                not issubclass(impl, kind):
+            return None
+        return impl
+
+    def find_function(self, ns: str, name: str):
+        return self._find(ns, name, None)
+
+    def find_stream_processor(self, ns: str, name: str):
+        return self._find(ns, name, None)
+
+    def find_window(self, ns: str, name: str):
+        return self._find(ns, name, None)
+
+    def find_source(self, type_name: str):
+        return self._find("source", type_name, None)
+
+    def find_sink(self, type_name: str):
+        return self._find("sink", type_name, None)
+
+    def find_source_mapper(self, type_name: str):
+        return self._find("sourcemapper", type_name, None)
+
+    def find_sink_mapper(self, type_name: str):
+        return self._find("sinkmapper", type_name, None)
+
+    def find_store(self, type_name: str):
+        return self._find("store", type_name, None)

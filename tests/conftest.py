@@ -1,0 +1,9 @@
+"""Test configuration: force a virtual 8-device CPU mesh so sharding tests run
+without TPU hardware (the driver separately dry-runs multi-chip compilation)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    (os.environ.get("XLA_FLAGS", "") +
+     " --xla_force_host_platform_device_count=8").strip())

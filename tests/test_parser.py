@@ -1,0 +1,199 @@
+"""SiddhiQL parser tests (reference model: siddhi-query-compiler test suite —
+grammar round-trips into the object model)."""
+import pytest
+
+from siddhi_tpu.compiler import SiddhiCompiler
+from siddhi_tpu.query_api import (AttrType, Compare, CompareOp, Constant,
+                                  CountStateElement, EveryStateElement,
+                                  InsertIntoStream, JoinInputStream,
+                                  LogicalStateElement, MathExpr,
+                                  NextStateElement, Partition, Query,
+                                  SingleInputStream, StateInputStream,
+                                  StateType, StreamStateElement, TimeConstant,
+                                  Variable)
+from siddhi_tpu.utils.errors import SiddhiParserException
+
+
+def test_stream_definition():
+    app = SiddhiCompiler.parse(
+        "define stream StockStream (symbol string, price float, volume long);")
+    d = app.stream_definitions["StockStream"]
+    assert [a.name for a in d.attributes] == ["symbol", "price", "volume"]
+    assert d.attributes[1].type == AttrType.FLOAT
+
+
+def test_filter_query():
+    app = SiddhiCompiler.parse("""
+        define stream S (a string, b int);
+        @info(name='q1')
+        from S[b > 10 and a == 'x']
+        select a, b * 2 as b2
+        insert into Out;
+    """)
+    q = app.execution_elements[0]
+    assert isinstance(q, Query)
+    assert q.name == "q1"
+    s = q.input_stream
+    assert isinstance(s, SingleInputStream)
+    assert len(s.handlers) == 1
+    assert isinstance(q.output_stream, InsertIntoStream)
+    assert q.output_stream.target_id == "Out"
+    assert q.selector.attributes[1].rename == "b2"
+
+
+def test_window_and_groupby():
+    app = SiddhiCompiler.parse("""
+        define stream S (sym string, p double);
+        from S#window.time(5 sec)
+        select sym, avg(p) as ap
+        group by sym having ap > 10.0
+        order by ap desc limit 5 offset 1
+        insert expired events into Out;
+    """)
+    q = app.execution_elements[0]
+    w = q.input_stream.window_handler
+    assert w.name == "time"
+    assert isinstance(w.params[0], TimeConstant)
+    assert w.params[0].value == 5000
+    assert q.selector.group_by[0].attribute == "sym"
+    assert q.selector.having is not None
+    assert q.selector.limit == 5 and q.selector.offset == 1
+    assert not q.selector.order_by[0].ascending
+
+
+def test_time_constants():
+    e = SiddhiCompiler.parse_expression("1 min 30 sec")
+    assert isinstance(e, TimeConstant) and e.value == 90_000
+
+
+def test_pattern_query():
+    app = SiddhiCompiler.parse("""
+        define stream A (x int); define stream B (x int);
+        from every e1=A[x > 5] -> e2=B[x > e1.x] within 2 sec
+        select e1.x as a, e2.x as b insert into Out;
+    """)
+    q = app.execution_elements[0]
+    st = q.input_stream
+    assert isinstance(st, StateInputStream)
+    assert st.state_type == StateType.PATTERN
+    assert st.within_ms == 2000
+    nxt = st.state
+    assert isinstance(nxt, NextStateElement)
+    assert isinstance(nxt.state, EveryStateElement)
+    inner = nxt.state.state
+    assert isinstance(inner, StreamStateElement)
+    assert inner.stream.stream_ref == "e1"
+
+
+def test_sequence_and_count():
+    app = SiddhiCompiler.parse("""
+        define stream A (x int);
+        from e1=A[x>1]<2:5>, e2=A[x>10]
+        select e1[0].x as first, e2.x as last insert into Out;
+    """)
+    st = app.execution_elements[0].input_stream
+    assert st.state_type == StateType.SEQUENCE
+    cnt = st.state.state
+    assert isinstance(cnt, CountStateElement)
+    assert cnt.min_count == 2 and cnt.max_count == 5
+    v = app.execution_elements[0].selector.attributes[0].expr
+    assert isinstance(v, Variable) and v.stream_index == 0
+
+
+def test_logical_and_absent():
+    app = SiddhiCompiler.parse("""
+        define stream A (x int); define stream B (y int);
+        from every (e1=A and e2=B) -> not A for 1 sec
+        select e1.x as x insert into Out;
+    """)
+    st = app.execution_elements[0].input_stream
+    nxt = st.state
+    logical = nxt.state.state
+    assert isinstance(logical, LogicalStateElement)
+    absent = nxt.next
+    from siddhi_tpu.query_api import AbsentStreamStateElement
+    assert isinstance(absent, AbsentStreamStateElement)
+    assert absent.waiting_time_ms == 1000
+
+
+def test_join_query():
+    app = SiddhiCompiler.parse("""
+        define stream L (a string, x int);
+        define stream R (b string, y int);
+        from L#window.length(5) as l join R#window.length(3) as r
+            on l.a == r.b
+        select l.a, r.y insert into Out;
+    """)
+    j = app.execution_elements[0].input_stream
+    assert isinstance(j, JoinInputStream)
+    assert j.left.stream_ref == "l"
+    assert isinstance(j.on, Compare)
+
+
+def test_partition():
+    app = SiddhiCompiler.parse("""
+        define stream S (sym string, p double);
+        partition with (sym of S)
+        begin
+            @info(name='pq')
+            from S select sym, sum(p) as total insert into Out;
+        end;
+    """)
+    p = app.execution_elements[0]
+    assert isinstance(p, Partition)
+    assert len(p.queries) == 1
+
+
+def test_annotations():
+    app = SiddhiCompiler.parse("""
+        @app:name('TestApp')
+        @source(type='inMemory', topic='t1', @map(type='passThrough'))
+        define stream S (a int);
+    """)
+    assert app.name == "TestApp"
+    src = app.stream_definitions["S"].annotations[0]
+    assert src.name == "source"
+    assert src.get("topic") == "t1"
+    assert src.annotations[0].name == "map"
+
+
+def test_table_and_window_defs():
+    app = SiddhiCompiler.parse("""
+        @PrimaryKey('id')
+        define table T (id string, v int);
+        define window W (a int) length(5) output all events;
+        define trigger Trig at every 5 sec;
+    """)
+    assert "T" in app.table_definitions
+    w = app.window_definitions["W"]
+    assert w.window_name == "length"
+    assert app.trigger_definitions["Trig"].at_every_ms == 5000
+
+
+def test_store_query_parse():
+    sq = SiddhiCompiler.parse_store_query(
+        "from T on v > 5 select id, v order by v desc limit 3")
+    assert sq.input_store.store_id == "T"
+    assert sq.selector.limit == 3
+
+
+def test_syntax_error_has_location():
+    with pytest.raises(SiddhiParserException):
+        SiddhiCompiler.parse("define stream S (a in);"
+                             " from S select insert into O;")
+
+
+def test_math_precedence():
+    e = SiddhiCompiler.parse_expression("1 + 2 * 3")
+    assert isinstance(e, MathExpr)
+    assert isinstance(e.right, MathExpr)  # 2*3 binds tighter
+
+
+def test_function_definition():
+    app = SiddhiCompiler.parse("""
+        define function double_it[python] return int { data[0] * 2 };
+        define stream S (x int);
+        from S select double_it(x) as y insert into Out;
+    """)
+    assert "double_it" in app.function_definitions
+    assert app.function_definitions["double_it"].body.strip() == "data[0] * 2"

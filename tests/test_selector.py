@@ -1,0 +1,129 @@
+"""Selector tests: group-by / having / order-by / limit + all 12 aggregators
+(reference model: query/GroupByTestCase, OrderByLimitTestCase,
+AggregationFunction tests)."""
+import pytest
+
+from siddhi_tpu import QueryCallback, SiddhiManager, StreamCallback
+
+
+def collect(app, sends, stream="S", out="Out"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback(out, StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    h = rt.get_input_handler(stream)
+    for s in sends:
+        h.send(s)
+    rt.shutdown()
+    return got
+
+
+def test_group_by_sum():
+    got = collect("""
+        define stream S (sym string, p double);
+        from S select sym, sum(p) as t group by sym insert into Out;
+    """, [["A", 1.0], ["B", 10.0], ["A", 2.0], ["B", 20.0]])
+    assert [e.data for e in got] == [
+        ["A", 1.0], ["B", 10.0], ["A", 3.0], ["B", 30.0]]
+
+
+def test_avg_count_min_max():
+    got = collect("""
+        define stream S (p double);
+        from S select avg(p) as a, count() as c, min(p) as mn, max(p) as mx
+        insert into Out;
+    """, [[4.0], [8.0], [6.0]])
+    assert got[-1].data == [6.0, 3, 4.0, 8.0]
+
+
+def test_distinct_count_stddev():
+    got = collect("""
+        define stream S (x int);
+        from S select distinctCount(x) as dc, stdDev(x) as sd insert into Out;
+    """, [[1], [1], [2]])
+    assert got[-1].data[0] == 2
+    assert got[-1].data[1] == pytest.approx(0.4714, abs=1e-3)
+
+
+def test_minforever_maxforever():
+    got = collect("""
+        define stream S (x long);
+        from S select minForever(x) as mn, maxForever(x) as mx insert into Out;
+    """, [[5], [2], [9]])
+    assert [e.data for e in got] == [[5, 5], [2, 5], [2, 9]]
+
+
+def test_bool_and_or_aggregators():
+    got = collect("""
+        define stream S (ok bool);
+        from S select and(ok) as allok, or(ok) as anyok insert into Out;
+    """, [[True], [False], [True]])
+    assert [e.data for e in got] == [[True, True], [False, True],
+                                     [False, True]]
+
+
+def test_having():
+    got = collect("""
+        define stream S (sym string, p double);
+        from S select sym, sum(p) as t group by sym having t > 10.0
+        insert into Out;
+    """, [["A", 5.0], ["A", 7.0], ["B", 1.0]])
+    assert [e.data for e in got] == [["A", 12.0]]
+
+
+def test_order_by_limit_on_batch():
+    got = collect("""
+        define stream S (x int);
+        from S#window.lengthBatch(4)
+        select x order by x desc limit 2 insert into Out;
+    """, [[3], [9], [1], [7]])
+    assert [e.data[0] for e in got] == [9, 7]
+
+
+def test_select_star():
+    got = collect("""
+        define stream S (a int, b string);
+        from S select * insert into Out;
+    """, [[1, "x"]])
+    assert got[0].data == [1, "x"]
+
+
+def test_unionset_and_sizeofset():
+    got = collect("""
+        define stream S (x int);
+        from S select sizeOfSet(unionSet(createSet(x))) as n insert into Out;
+    """, [[1], [2], [1]])
+    assert [e.data[0] for e in got] == [1, 2, 2]
+
+
+def test_output_rate_events():
+    got = collect("""
+        define stream S (x int);
+        from S select x output every 3 events insert into Out;
+    """, [[i] for i in range(7)])
+    # flushed at 3 and 6 events
+    assert [e.data[0] for e in got] == [0, 1, 2, 3, 4, 5]
+
+
+def test_output_rate_last():
+    got = collect("""
+        define stream S (x int);
+        from S select x output last every 3 events insert into Out;
+    """, [[i] for i in range(6)])
+    assert [e.data[0] for e in got] == [2, 5]
+
+
+def test_eventtimestamp_function():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:playback
+        define stream S (x int);
+        from S select eventTimestamp() as ts insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    rt.get_input_handler("S").send([1], timestamp=12345)
+    rt.shutdown()
+    assert got[0].data == [12345]

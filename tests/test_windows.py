@@ -1,0 +1,241 @@
+"""Window processor behavioural tests (reference model: siddhi-core
+query/window/* — 15 test classes over the window taxonomy; playback used for
+deterministic time windows as in managment/PlaybackTestCase)."""
+import numpy as np
+import pytest
+
+from siddhi_tpu import QueryCallback, SiddhiManager, StreamCallback
+
+
+def playback_app(app):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("@app:playback\n" + app)
+    return rt
+
+
+def test_length_window_expiry():
+    rt = playback_app("""
+        define stream S (sym string, p double);
+        @info(name='q')
+        from S#window.length(2) select sym, sum(p) as total
+        insert all events into Out;
+    """)
+    rows = []
+    rt.add_callback("q", QueryCallback(lambda ts, c, e: rows.append((c, e))))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i, p in enumerate([10.0, 20.0, 30.0, 40.0]):
+        h.send(["A", p], timestamp=1000 + i)
+    rt.shutdown()
+    # running sums: 10, 30, (expire 10) 50, (expire 20) 70
+    currents = [c[0].data[1] for c, e in rows if c]
+    assert currents == [10.0, 30.0, 50.0, 70.0]
+
+
+def test_length_batch():
+    rt = playback_app("""
+        define stream S (p long);
+        from S#window.lengthBatch(2) select sum(p) as t insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(5):
+        h.send([i + 1], timestamp=1000 + i)
+    rt.shutdown()
+    # batches [1,2] and [3,4]; 5 pending. running sums per batch: 1,3 | 3,7
+    assert [e.data[0] for e in got] == [1, 3, 3, 7]
+
+
+def test_time_window():
+    rt = playback_app("""
+        define stream S (p double);
+        @info(name='q')
+        from S#window.time(1 sec) select sum(p) as t
+        insert all events into Out;
+    """)
+    rows = []
+    rt.add_callback("q", QueryCallback(lambda ts, c, e: rows.append((ts, c, e))))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([10.0], timestamp=1000)
+    h.send([20.0], timestamp=1800)
+    h.send([1.0], timestamp=2500)   # 10.0 expired at 2000
+    rt.shutdown()
+    ts, cur, exp = rows[-1]
+    assert cur[0].data == [21.0]
+
+
+def test_time_batch():
+    rt = playback_app("""
+        define stream S (p double);
+        from S#window.timeBatch(1 sec) select sum(p) as t insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([1.0], timestamp=1000)
+    h.send([2.0], timestamp=1500)
+    h.send([5.0], timestamp=2100)   # flush of [1,2] happens at 2000
+    rt.shutdown()
+    assert [e.data[0] for e in got] == [1.0, 3.0]
+
+
+def test_external_time_window():
+    rt = playback_app("""
+        define stream S (ts long, p double);
+        @info(name='q')
+        from S#window.externalTime(ts, 1 sec) select sum(p) as t
+        insert all events into Out;
+    """)
+    rows = []
+    rt.add_callback("q", QueryCallback(lambda ts, c, e: rows.append((c, e))))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([1000, 10.0], timestamp=1000)
+    h.send([1500, 20.0], timestamp=1500)
+    h.send([2300, 5.0], timestamp=2300)
+    rt.shutdown()
+    currents = [c[0].data[0] for c, e in rows if c]
+    assert currents[-1] == 25.0  # 10 expired (1000 <= 2300-1000)
+
+
+def test_external_time_batch():
+    rt = playback_app("""
+        define stream S (ts long, p double);
+        from S#window.externalTimeBatch(ts, 1 sec) select sum(p) as t
+        insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([1000, 1.0])
+    h.send([1200, 2.0])
+    h.send([2100, 4.0])   # flushes [1,2]
+    rt.shutdown()
+    assert [e.data[0] for e in got] == [1.0, 3.0]
+
+
+def test_batch_window():
+    rt = playback_app("""
+        define stream S (p double);
+        from S#window.batch() select sum(p) as t insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([[1.0], [2.0]][0])
+    rt.get_input_handler("S").send_batch({"p": np.asarray([3.0, 4.0])})
+    rt.shutdown()
+    # first batch sum=1; second batch resets: 3, 7
+    assert [e.data[0] for e in got] == [1.0, 3.0, 7.0]
+
+
+def test_sort_window():
+    rt = playback_app("""
+        define stream S (p long);
+        @info(name='q')
+        from S#window.sort(2, p) select p insert all events into Out;
+    """)
+    rows = []
+    rt.add_callback("q", QueryCallback(lambda ts, c, e: rows.append((c, e))))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for v in [5, 1, 9, 3]:
+        h.send([v])
+    rt.shutdown()
+    expired = [e[0].data[0] for c, e in rows if e]
+    # keeps the 2 smallest; evicts largest each overflow: 9 then 5
+    assert expired == [9, 5]
+
+
+def test_session_window():
+    rt = playback_app("""
+        define stream S (user string, p double);
+        @info(name='q')
+        from S#window.session(1 sec, user) select user, sum(p) as t
+        group by user insert all events into Out;
+    """)
+    rows = []
+    rt.add_callback("q", QueryCallback(lambda ts, c, e: rows.append((c, e))))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["u1", 1.0], timestamp=1000)
+    h.send(["u1", 2.0], timestamp=1400)
+    h.send(["u1", 10.0], timestamp=3000)  # gap > 1s: previous session expires
+    rt.shutdown()
+    expired_totals = [e[-1].data[1] for c, e in rows if e]
+    assert expired_totals and expired_totals[-1] == 0.0  # both removed
+
+
+def test_delay_window():
+    rt = playback_app("""
+        define stream S (p long);
+        from S#window.delay(1 sec) select p insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([1], timestamp=1000)
+    h.send([2], timestamp=1100)
+    assert got == []            # nothing emitted yet
+    h.send([3], timestamp=2500)  # 1 and 2 now due
+    rt.shutdown()
+    assert [e.data[0] for e in got] == [1, 2]
+
+
+def test_frequent_window():
+    rt = playback_app("""
+        define stream S (sym string);
+        from S#window.frequent(1, sym) select sym insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for s in ["A", "A", "B", "A"]:
+        h.send([s])
+    rt.shutdown()
+    assert len(got) == 4
+
+
+def test_timelength_window():
+    rt = playback_app("""
+        define stream S (p long);
+        @info(name='q')
+        from S#window.timeLength(10 sec, 2) select sum(p) as t
+        insert all events into Out;
+    """)
+    rows = []
+    rt.add_callback("q", QueryCallback(lambda ts, c, e: rows.append((c, e))))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i, v in enumerate([1, 2, 4]):
+        h.send([v], timestamp=1000 + i)
+    rt.shutdown()
+    currents = [c[0].data[0] for c, e in rows if c]
+    assert currents == [1, 3, 6]  # length-2 eviction: 2+4
+
+
+def test_named_window_shared():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (p long);
+        define window W (p long) length(3) output all events;
+        from S select p insert into W;
+        @info(name='q')
+        from W select sum(p) as t insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for v in [1, 2, 3]:
+        h.send([v])
+    rt.shutdown()
+    assert [e.data[0] for e in got] == [1, 3, 6]
