@@ -62,16 +62,22 @@ class Event:
 
 
 class EventChunk:
-    """A columnar micro-batch of events flowing through a query pipeline."""
+    """A columnar micro-batch of events flowing through a query pipeline.
 
-    __slots__ = ("timestamps", "types", "columns", "names")
+    `qualified` (optional) carries per-(stream_ref, index) attribute columns
+    for multi-stream events — the columnar analogue of the reference's
+    StateEvent (join/pattern output rows, event/state/StateEvent.java)."""
+
+    __slots__ = ("timestamps", "types", "columns", "names", "qualified")
 
     def __init__(self, names: Sequence[str], timestamps: np.ndarray,
-                 types: np.ndarray, columns: Dict[str, np.ndarray]):
+                 types: np.ndarray, columns: Dict[str, np.ndarray],
+                 qualified: Optional[Dict] = None):
         self.names = list(names)
         self.timestamps = timestamps
         self.types = types
         self.columns = columns
+        self.qualified = qualified
 
     # ------------------------------------------------------------ constructors
 
@@ -144,30 +150,35 @@ class EventChunk:
 
     def mask(self, m: np.ndarray) -> "EventChunk":
         return EventChunk(self.names, self.timestamps[m], self.types[m],
-                          {k: v[m] for k, v in self.columns.items()})
+                          {k: v[m] for k, v in self.columns.items()},
+                          _sel_qualified(self.qualified, m))
 
     def take(self, idx: np.ndarray) -> "EventChunk":
         return EventChunk(self.names, self.timestamps[idx], self.types[idx],
-                          {k: v[idx] for k, v in self.columns.items()})
+                          {k: v[idx] for k, v in self.columns.items()},
+                          _sel_qualified(self.qualified, idx))
 
     def slice(self, start: int, stop: int) -> "EventChunk":
         return EventChunk(self.names, self.timestamps[start:stop],
                           self.types[start:stop],
-                          {k: v[start:stop] for k, v in self.columns.items()})
+                          {k: v[start:stop] for k, v in self.columns.items()},
+                          _sel_qualified(self.qualified, slice(start, stop)))
 
     def with_types(self, t: int) -> "EventChunk":
         return EventChunk(self.names, self.timestamps,
-                          np.full(len(self), t, np.int8), self.columns)
+                          np.full(len(self), t, np.int8), self.columns,
+                          self.qualified)
 
     def with_timestamps(self, ts: np.ndarray) -> "EventChunk":
         return EventChunk(self.names, np.asarray(ts, np.int64), self.types,
-                          self.columns)
+                          self.columns, self.qualified)
 
     def rename(self, names: Sequence[str]) -> "EventChunk":
         assert len(names) == len(self.names)
         return EventChunk(list(names), self.timestamps, self.types,
                           {new: self.columns[old]
-                           for old, new in zip(self.names, names)})
+                           for old, new in zip(self.names, names)},
+                          self.qualified)
 
     def only(self, *event_types: int) -> "EventChunk":
         m = np.isin(self.types, event_types)
@@ -175,7 +186,8 @@ class EventChunk:
 
     def copy(self) -> "EventChunk":
         return EventChunk(self.names, self.timestamps.copy(), self.types.copy(),
-                          {k: v.copy() for k, v in self.columns.items()})
+                          {k: v.copy() for k, v in self.columns.items()},
+                          _sel_qualified(self.qualified, slice(None)))
 
     @staticmethod
     def concat(chunks: Sequence["EventChunk"]) -> "EventChunk":
@@ -185,15 +197,38 @@ class EventChunk:
         if len(chunks) == 1:
             return chunks[0]
         names = chunks[0].names
+        qualified = None
+        if any(c.qualified is not None for c in chunks):
+            qualified = {}
+            keys = set()
+            for c in chunks:
+                keys |= set((c.qualified or {}).keys())
+            for key in keys:
+                attrs = set()
+                for c in chunks:
+                    attrs |= set((c.qualified or {}).get(key, {}).keys())
+                qualified[key] = {
+                    a: np.concatenate([
+                        (c.qualified or {}).get(key, {}).get(
+                            a, np.full(len(c), None, object))
+                        for c in chunks])
+                    for a in attrs}
         return EventChunk(
             names,
             np.concatenate([c.timestamps for c in chunks]),
             np.concatenate([c.types for c in chunks]),
-            {n: np.concatenate([c.columns[n] for c in chunks]) for n in names})
+            {n: np.concatenate([c.columns[n] for c in chunks]) for n in names},
+            qualified)
 
     def __repr__(self):
         return (f"EventChunk(n={len(self)}, names={self.names}, "
                 f"types={[TYPE_NAMES.get(int(t), t) for t in self.types[:8]]})")
+
+
+def _sel_qualified(q, sel):
+    if q is None:
+        return None
+    return {key: {a: col[sel] for a, col in d.items()} for key, d in q.items()}
 
 
 def _to_py(v):

@@ -196,6 +196,12 @@ class QueryRuntime:
                 f"Query '{self.name}' output ({out_names}) does not match "
                 f"stream '{target_def.id}' ({target_def.attribute_names})")
 
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self):
+        if self.state_runtime is not None:
+            self.state_runtime.start()
+
     # ------------------------------------------------------------ callbacks
 
     def add_callback(self, cb):

@@ -311,6 +311,8 @@ class SiddhiAppRuntime:
         self._started = True
         for j in self.junctions.values():
             j.start()
+        for qr in self.query_runtimes.values():
+            qr.start()
         for t in self.triggers:
             t.start()
         for s in self.sources:
@@ -324,6 +326,8 @@ class SiddhiAppRuntime:
         self._started = True
         for j in self.junctions.values():
             j.start()
+        for qr in self.query_runtimes.values():
+            qr.start()
         for t in self.triggers:
             t.start()
 

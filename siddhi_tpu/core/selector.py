@@ -131,7 +131,8 @@ class QuerySelector(Processor):
         if not data_mask.any() and not reset_mask.any():
             return  # pure TIMER chunk
 
-        ctx = EvalCtx(dict(chunk.columns), chunk.timestamps, n)
+        ctx = EvalCtx(dict(chunk.columns), chunk.timestamps, n,
+                      qualified=chunk.qualified)
 
         if self.agg_specs:
             self._run_aggregators(chunk, ctx, data_mask, reset_mask)
@@ -139,6 +140,8 @@ class QuerySelector(Processor):
         out_cols: Dict[str, np.ndarray] = {}
         for name, ce in zip(self.out_names, self.out_exprs):
             v = ce.fn(ctx)
+            if v is None:
+                v = np.full(n, None, object)
             if not isinstance(v, np.ndarray) or v.ndim == 0:
                 from .event import dtype_for
                 arr = np.empty(n, dtype_for(ce.type))

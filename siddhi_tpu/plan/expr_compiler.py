@@ -237,24 +237,36 @@ class ExprCompiler:
         integer = out_t in (AttrType.INT, AttrType.LONG)
         dt = np_dtype(out_t)
         if m.op == MathOp.ADD:
-            fn = lambda ctx: xp.asarray(l.fn(ctx) + r.fn(ctx), dt)
+            g = lambda a, b: xp.asarray(a + b, dt)
+            py = lambda a, b: a + b
         elif m.op == MathOp.SUB:
-            fn = lambda ctx: xp.asarray(l.fn(ctx) - r.fn(ctx), dt)
+            g = lambda a, b: xp.asarray(a - b, dt)
+            py = lambda a, b: a - b
         elif m.op == MathOp.MUL:
-            fn = lambda ctx: xp.asarray(l.fn(ctx) * r.fn(ctx), dt)
+            g = lambda a, b: xp.asarray(a * b, dt)
+            py = lambda a, b: a * b
         elif m.op == MathOp.DIV:
             if integer:
                 # Java integer division truncates toward zero
-                def fn(ctx):
-                    a, b = l.fn(ctx), r.fn(ctx)
-                    return xp.asarray(xp.trunc(a / b), dt)
+                g = lambda a, b: xp.asarray(xp.trunc(a / b), dt)
+                py = lambda a, b: int(a / b)
             else:
-                fn = lambda ctx: xp.asarray(l.fn(ctx) / r.fn(ctx), dt)
+                g = lambda a, b: xp.asarray(a / b, dt)
+                py = lambda a, b: a / b
         elif m.op == MathOp.MOD:
             # Java % = fmod (sign of dividend)
-            fn = lambda ctx: xp.asarray(xp.fmod(l.fn(ctx), r.fn(ctx)), dt)
+            g = lambda a, b: xp.asarray(xp.fmod(a, b), dt)
+            py = lambda a, b: float(np.fmod(a, b))
         else:
             raise SiddhiAppValidationException(f"Unknown math op {m.op}")
+
+        def fn(ctx):
+            a, b = l.fn(ctx), r.fn(ctx)
+            if _maybe_null(a) or _maybe_null(b):
+                # null operand → null result (reference math executors
+                # return null when either side is null)
+                return _null_binop(a, b, py)
+            return g(a, b)
         return CompiledExpr(fn, out_t)
 
     def _compile_compare(self, c: Compare) -> CompiledExpr:
@@ -280,8 +292,15 @@ class ExprCompiler:
                CompareOp.GTE: lambda a, b: a >= b,
                CompareOp.EQ: lambda a, b: a == b,
                CompareOp.NEQ: lambda a, b: a != b}[op]
-        return CompiledExpr(lambda ctx: opf(l.fn(ctx), r.fn(ctx)),
-                            AttrType.BOOL)
+
+        def fn(ctx):
+            a, b = l.fn(ctx), r.fn(ctx)
+            if _maybe_null(a) or _maybe_null(b):
+                # null operands compare false (reference per-type compare
+                # executors skip null data)
+                return _obj_compare(a, b, opf)
+            return opf(a, b)
+        return CompiledExpr(fn, AttrType.BOOL)
 
     def _compile_is_null(self, e: IsNull) -> CompiledExpr:
         xp = self.xp
@@ -294,15 +313,19 @@ class ExprCompiler:
                 return xp.full(ctx.n, absent, bool)
             return CompiledExpr(fn, AttrType.BOOL)
         inner = self.compile(e.expr)
-        if inner.type in (AttrType.STRING, AttrType.OBJECT):
-            def fn(ctx):
-                v = inner.fn(ctx)
-                if not isinstance(v, np.ndarray):
-                    return np.full(ctx.n, v is None, bool)
+
+        def fn(ctx):
+            # numeric columns normally carry no null lane, but absent
+            # pattern/outer-join captures surface as None / object arrays
+            v = inner.fn(ctx)
+            if v is None:
+                return np.ones(ctx.n, bool)
+            if isinstance(v, np.ndarray) and v.dtype == object:
                 return np.asarray([x is None for x in v], bool)
-            return CompiledExpr(fn, AttrType.BOOL)
-        # numeric columns carry no null lane
-        return CompiledExpr(lambda ctx: xp.zeros(ctx.n, bool), AttrType.BOOL)
+            if not isinstance(v, np.ndarray):
+                return np.full(ctx.n, v is None, bool)
+            return np.zeros(ctx.n, bool)
+        return CompiledExpr(fn, AttrType.BOOL)
 
     def _compile_in(self, e: In) -> CompiledExpr:
         inner = self.compile(e.expr)
@@ -551,6 +574,30 @@ def _str_binop(a, b, g):
     out = np.empty(n, object)
     for i in range(n):
         out[i] = g(aa[i], bb[i])
+    return out
+
+
+def _maybe_null(v):
+    if v is None:
+        return True
+    return isinstance(v, np.ndarray) and v.dtype == object
+
+
+def _null_binop(a, b, py):
+    """Elementwise binary op over possibly-null object operands; null in →
+    null out."""
+    aa = np.asarray(a, object)
+    bb = np.asarray(b, object)
+    if aa.ndim == 0 and bb.ndim == 0:
+        x, y = aa.item(), bb.item()
+        return None if x is None or y is None else py(x, y)
+    n = max(aa.size if aa.ndim else 1, bb.size if bb.ndim else 1)
+    aa = np.broadcast_to(aa if aa.ndim else aa.reshape(1), (n,))
+    bb = np.broadcast_to(bb if bb.ndim else bb.reshape(1), (n,))
+    out = np.empty(n, object)
+    for i in range(n):
+        x, y = aa[i], bb[i]
+        out[i] = None if x is None or y is None else py(x, y)
     return out
 
 

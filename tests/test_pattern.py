@@ -1,0 +1,293 @@
+"""Pattern query behavioural tests.
+
+Modeled on the reference conformance suites (siddhi-core
+query/pattern/: PatternTestCase, EveryPatternTestCase, CountPatternTestCase,
+LogicalPatternTestCase, WithinPatternTestCase, absent/*TestCase) — app string,
+callbacks, send, assert exact match payloads and counts.
+"""
+import pytest
+
+from siddhi_tpu import QueryCallback, SiddhiManager, StreamCallback
+
+
+def make(app):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback("query1", QueryCallback(
+        lambda ts, cur, exp: got.extend(e.data for e in (cur or []))))
+    rt.start()
+    return m, rt, got
+
+
+STREAMS = """
+define stream Stream1 (symbol string, price float, volume int);
+define stream Stream2 (symbol string, price float, volume int);
+"""
+
+
+def test_simple_pattern_followed_by():
+    m, rt, got = make(STREAMS + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["WSO2", 55.6, 100])
+    s2.send(["IBM", 55.7, 100])
+    # non-every: only the first match fires
+    s1.send(["GOOG", 56.0, 100])
+    s2.send(["MSFT", 57.0, 100])
+    rt.shutdown()
+    assert got == [["WSO2", "IBM"]]
+
+
+def test_pattern_ignores_non_matching_intermediates():
+    m, rt, got = make(STREAMS + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20] -> e2=Stream1[price > e1.price]
+        select e1.price as p1, e2.price as p2
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s1.send(["A", 25.0, 1])
+    s1.send(["B", 10.0, 1])   # does not match e2, pattern is non-strict
+    s1.send(["C", 30.0, 1])
+    rt.shutdown()
+    assert got == [[25.0, 30.0]]
+
+
+def test_every_pattern_restarts():
+    m, rt, got = make(STREAMS + """
+        @info(name = 'query1')
+        from every e1=Stream1[price > 20] -> e2=Stream2[price > e1.price]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["A1", 25.0, 1])
+    s2.send(["B1", 26.0, 1])
+    s1.send(["A2", 30.0, 1])
+    s2.send(["B2", 31.0, 1])
+    rt.shutdown()
+    assert got == [["A1", "B1"], ["A2", "B2"]]
+
+
+def test_every_overlapping_matches():
+    m, rt, got = make(STREAMS + """
+        @info(name = 'query1')
+        from every e1=Stream1[price > 20] -> e2=Stream2[price > 20]
+        select e1.price as p1, e2.price as p2
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["A1", 21.0, 1])
+    s1.send(["A2", 22.0, 1])
+    s2.send(["B", 23.0, 1])   # completes both armed partials
+    rt.shutdown()
+    assert sorted(got) == [[21.0, 23.0], [22.0, 23.0]]
+
+
+def test_logical_and_pattern():
+    m, rt, got = make(STREAMS + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20] and e2=Stream2[price > 30]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s2.send(["IBM", 35.0, 1])    # e2 first — AND is order-free
+    s1.send(["WSO2", 25.0, 1])
+    rt.shutdown()
+    assert got == [["WSO2", "IBM"]]
+
+
+def test_logical_or_pattern():
+    m, rt, got = make(STREAMS + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20] or e2=Stream2[price > 30]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;
+    """)
+    s2 = rt.get_input_handler("Stream2")
+    s2.send(["IBM", 35.0, 1])
+    rt.shutdown()
+    assert got == [[None, "IBM"]]
+
+
+def test_logical_and_then_next():
+    m, rt, got = make(STREAMS + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20] and e2=Stream2[price > 30] -> e3=Stream1[price > 40]
+        select e1.price as p1, e2.price as p2, e3.price as p3
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["A", 25.0, 1])
+    s2.send(["B", 35.0, 1])
+    s1.send(["C", 45.0, 1])
+    rt.shutdown()
+    assert got == [[25.0, 35.0, 45.0]]
+
+
+def test_count_pattern_min_reached():
+    m, rt, got = make(STREAMS + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20]<2:5> -> e2=Stream2[price > e1[0].price]
+        select e1[0].price as p0, e1[1].price as p1, e2.price as p2
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["A", 25.0, 1])
+    s1.send(["B", 30.0, 1])
+    s1.send(["C", 35.0, 1])
+    s2.send(["D", 45.0, 1])
+    rt.shutdown()
+    # all three Stream1 events accumulate into the same partial
+    assert got == [[25.0, 30.0, 45.0]]
+
+
+def test_count_pattern_exact_counts_accumulate():
+    m, rt, got = make(STREAMS + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20]<2:5> -> e2=Stream2[price > e1[0].price]
+        select e1[0].price as p0, e1[2].price as p2x, e2.price as p2
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["A", 25.0, 1])
+    s1.send(["B", 30.0, 1])
+    s2.send(["D", 45.0, 1])
+    rt.shutdown()
+    # only two e1 events: e1[2] is null
+    assert got == [[25.0, None, 45.0]]
+
+
+def test_count_optional_zero():
+    m, rt, got = make(STREAMS + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 100]<0:1> -> e2=Stream2[price > 20]
+        select e1.price as p1, e2.price as p2
+        insert into OutputStream;
+    """)
+    s2 = rt.get_input_handler("Stream2")
+    s2.send(["B", 25.0, 1])
+    rt.shutdown()
+    assert got == [[None, 25.0]]
+
+
+def test_within_expires_partials():
+    m, rt, got = make("@app:playback " + STREAMS + """
+        @info(name = 'query1')
+        from every e1=Stream1[price > 20] -> e2=Stream2[price > e1.price]
+            within 1 sec
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["A", 25.0, 1], timestamp=1000)
+    s2.send(["B", 30.0, 1], timestamp=2500)   # > 1s later: expired, no match
+    s1.send(["C", 25.0, 1], timestamp=3000)
+    s2.send(["D", 30.0, 1], timestamp=3500)   # within 1s: match
+    rt.shutdown()
+    assert got == [["C", "D"]]
+
+
+def test_pattern_group_by_output():
+    m, rt, got = make(STREAMS + """
+        @info(name = 'query1')
+        from every e1=Stream1[price > 20] -> e2=Stream2[price > e1.price]
+        select e1.symbol as symbol1, e2.price as price2
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["X", 21.0, 1])
+    s2.send(["Y", 22.0, 1])
+    rt.shutdown()
+    assert got == [["X", 22.0]]
+
+
+# --------------------------------------------------------------- absent (not)
+
+def playback_make(app):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback("query1", QueryCallback(
+        lambda ts, cur, exp: got.extend(e.data for e in (cur or []))))
+    rt.start()
+    return m, rt, got
+
+
+def advance(rt, ts):
+    """Advance playback virtual time so scheduler timers fire."""
+    rt.app_ctx.timestamp_generator.observe_event_time(ts)
+    rt.app_ctx.scheduler.advance_to(ts)
+
+
+def test_absent_not_for_fires_after_wait():
+    m, rt, got = playback_make("@app:playback " + STREAMS + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20] -> not Stream2[price > e1.price] for 1 sec
+        select e1.symbol as symbol1
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s1.send(["WSO2", 25.0, 1], timestamp=1000)
+    advance(rt, 2100)
+    rt.shutdown()
+    assert got == [["WSO2"]]
+
+
+def test_absent_not_for_suppressed_by_arrival():
+    m, rt, got = playback_make("@app:playback " + STREAMS + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20] -> not Stream2[price > e1.price] for 1 sec
+        select e1.symbol as symbol1
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["WSO2", 25.0, 1], timestamp=1000)
+    s2.send(["IBM", 30.0, 1], timestamp=1500)   # arrival kills the absence
+    advance(rt, 2100)
+    rt.shutdown()
+    assert got == []
+
+
+def test_absent_and_logical():
+    m, rt, got = playback_make("@app:playback " + STREAMS + """
+        @info(name = 'query1')
+        from not Stream1[price > 20] and e2=Stream2[price > 30]
+        select e2.symbol as symbol2
+        insert into OutputStream;
+    """)
+    s2 = rt.get_input_handler("Stream2")
+    s2.send(["IBM", 35.0, 1], timestamp=1000)
+    rt.shutdown()
+    assert got == [["IBM"]]
+
+
+def test_absent_and_logical_poisoned():
+    m, rt, got = playback_make("@app:playback " + STREAMS + """
+        @info(name = 'query1')
+        from not Stream1[price > 20] and e2=Stream2[price > 30]
+        select e2.symbol as symbol2
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["BAD", 25.0, 1], timestamp=500)    # absence violated first
+    s2.send(["IBM", 35.0, 1], timestamp=1000)
+    rt.shutdown()
+    assert got == []

@@ -1,0 +1,114 @@
+"""Sequence query behavioural tests (strict contiguity).
+
+Modeled on the reference suites (siddhi-core query/sequence/:
+SequenceTestCase, EverySequenceTestCase, CountSequenceTestCase,
+LogicalSequenceTestCase).
+"""
+from siddhi_tpu import QueryCallback, SiddhiManager
+
+STREAMS = """
+define stream Stream1 (symbol string, price float, volume int);
+define stream Stream2 (symbol string, price float, volume int);
+"""
+
+
+def make(app):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback("query1", QueryCallback(
+        lambda ts, cur, exp: got.extend(e.data for e in (cur or []))))
+    rt.start()
+    return m, rt, got
+
+
+def test_simple_sequence():
+    m, rt, got = make(STREAMS + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20], e2=Stream2[price > e1.price]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;
+    """)
+    rt.get_input_handler("Stream1").send(["WSO2", 55.6, 100])
+    rt.get_input_handler("Stream2").send(["IBM", 55.7, 100])
+    rt.shutdown()
+    assert got == [["WSO2", "IBM"]]
+
+
+def test_sequence_strictness_broken_by_intermediate():
+    m, rt, got = make(STREAMS + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20], e2=Stream1[price > e1.price]
+        select e1.price as p1, e2.price as p2
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s1.send(["A", 25.0, 1])
+    s1.send(["B", 10.0, 1])   # breaks the sequence (strict next must match)
+    s1.send(["C", 30.0, 1])
+    rt.shutdown()
+    assert got == []
+
+
+def test_every_sequence():
+    m, rt, got = make(STREAMS + """
+        @info(name = 'query1')
+        from every e1=Stream1[price > 20], e2=Stream1[price > e1.price]
+        select e1.price as p1, e2.price as p2
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s1.send(["A", 25.0, 1])
+    s1.send(["B", 30.0, 1])    # match (25, 30); every re-arms: B starts new
+    s1.send(["C", 40.0, 1])    # match (30, 40)
+    rt.shutdown()
+    assert got == [[25.0, 30.0], [30.0, 40.0]]
+
+
+def test_sequence_with_kleene_plus():
+    m, rt, got = make(STREAMS + """
+        @info(name = 'query1')
+        from every e1=Stream2[price > 20]+, e2=Stream1[price > e1[0].price]
+        select e1[0].price as price1, e1[1].price as price2, e2.price as price3
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s2.send(["A", 25.0, 1])
+    s2.send(["B", 30.0, 1])
+    s1.send(["C", 35.0, 1])
+    rt.shutdown()
+    assert got == [[25.0, 30.0, 35.0]]
+
+
+def test_sequence_kleene_star():
+    # reference SequenceTestCase.testQuery4 scenario
+    m, rt, got = make(STREAMS + """
+        @info(name = 'query1')
+        from every e1=Stream2[price > 20]*, e2=Stream1[price > e1[0].price]
+        select e1[0].price as price1, e1[1].price as price2, e2.price as price3
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s2 = rt.get_input_handler("Stream2")
+    s1.send(["WSO2", 59.6, 100])    # e1 empty → e1[0].price null → no match
+    s2.send(["WSO2", 55.6, 100])
+    s2.send(["IBM", 55.7, 100])
+    s1.send(["WSO2", 57.6, 100])
+    rt.shutdown()
+    import pytest
+    assert got == [pytest.approx([55.6, 55.7, 57.6])]
+
+
+def test_logical_or_sequence():
+    m, rt, got = make(STREAMS + """
+        @info(name = 'query1')
+        from every e1=Stream1[price > 20] or e2=Stream2[price > 30], e3=Stream1[price > 40]
+        select e1.price as p1, e2.price as p2, e3.price as p3
+        insert into OutputStream;
+    """)
+    s1 = rt.get_input_handler("Stream1")
+    s1.send(["A", 25.0, 1])
+    s1.send(["B", 45.0, 1])
+    rt.shutdown()
+    assert got == [[25.0, None, 45.0]]
