@@ -199,6 +199,7 @@ class SiddhiAppRuntime:
                 from .partition import PartitionRuntime
                 pr = PartitionRuntime(el, self, f"partition_{qcount}")
                 self.partition_runtimes.append(pr)
+                self.snapshot_service.register(f"partition:{pr.name}", pr)
             qcount += 1
         # 8. sources & sinks from stream annotations
         attach_sources_and_sinks(self)

@@ -1,0 +1,158 @@
+"""Partition behavioural tests (reference model: siddhi-core
+query/partition/PartitionTestCase1/2, PatternPartitionTestCase —
+per-key isolated state, value and range partitions, inner streams)."""
+import pytest
+
+from siddhi_tpu import QueryCallback, SiddhiManager, StreamCallback
+
+
+def make(app, cb_stream="Out"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback(cb_stream, StreamCallback(
+        lambda evs: got.extend(e.data for e in evs)))
+    rt.start()
+    return m, rt, got
+
+
+def test_value_partition_isolated_state():
+    m, rt, got = make("""
+        define stream S (symbol string, price float);
+        partition with (symbol of S)
+        begin
+            from S select symbol, count() as c insert into Out;
+        end;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["IBM", 1.0])
+    h.send(["WSO2", 2.0])
+    h.send(["IBM", 3.0])      # IBM's counter independent of WSO2's
+    rt.shutdown()
+    assert got == [["IBM", 1], ["WSO2", 1], ["IBM", 2]]
+
+
+def test_value_partition_windows_per_key():
+    m, rt, got = make("""
+        define stream S (symbol string, price float);
+        partition with (symbol of S)
+        begin
+            from S#window.length(2) select symbol, sum(price) as total
+            insert into Out;
+        end;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["A", 10.0])
+    h.send(["B", 100.0])
+    h.send(["A", 20.0])
+    h.send(["A", 30.0])   # A's length-2 window slides: 20+30
+    rt.shutdown()
+    totals = [(g[0], g[1]) for g in got]
+    assert totals[-1] == ("A", pytest.approx(50.0))
+    assert ("B", pytest.approx(100.0)) in totals
+
+
+def test_range_partition():
+    m, rt, got = make("""
+        define stream S (symbol string, volume int);
+        partition with (volume < 100 as 'small' or volume >= 100 as 'large' of S)
+        begin
+            from S select symbol, count() as c insert into Out;
+        end;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["a", 50])
+    h.send(["b", 500])
+    h.send(["c", 70])     # same 'small' partition as a
+    rt.shutdown()
+    assert got == [["a", 1], ["b", 1], ["c", 2]]
+
+
+def test_partition_inner_stream():
+    m, rt, got = make("""
+        define stream S (symbol string, price float);
+        partition with (symbol of S)
+        begin
+            from S select symbol, price * 2.0 as doubled insert into #Mid;
+            from #Mid select symbol, doubled + 1.0 as val insert into Out;
+        end;
+    """)
+    h = rt.get_input_handler("S")
+    h.send(["IBM", 10.0])
+    rt.shutdown()
+    assert got == [["IBM", pytest.approx(21.0)]]
+
+
+def test_partition_query_callback():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, price float);
+        partition with (symbol of S)
+        begin
+            @info(name='pq')
+            from S select symbol, count() as c insert into Out;
+        end;
+    """)
+    got = []
+    rt.add_callback("pq", QueryCallback(
+        lambda ts, cur, exp: got.extend(e.data for e in (cur or []))))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["X", 1.0])
+    h.send(["X", 2.0])
+    rt.shutdown()
+    assert got == [["X", 1], ["X", 2]]
+
+
+def test_partitioned_pattern():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, price float);
+        partition with (symbol of S)
+        begin
+            @info(name='pq')
+            from every e1=S[price > 20] -> e2=S[price > e1.price]
+            select e1.symbol as symbol, e1.price as p1, e2.price as p2
+            insert into Out;
+        end;
+    """)
+    got = []
+    rt.add_callback("pq", QueryCallback(
+        lambda ts, cur, exp: got.extend(e.data for e in (cur or []))))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["A", 25.0])
+    h.send(["B", 26.0])    # different key — must NOT complete A's pattern
+    h.send(["A", 30.0])    # completes A's pattern
+    h.send(["B", 40.0])    # completes B's pattern
+    rt.shutdown()
+    assert got == [["A", 25.0, 30.0], ["B", 26.0, 40.0]]
+
+
+def test_partition_snapshot_restore():
+    app = """
+        define stream S (symbol string, price float);
+        partition with (symbol of S)
+        begin
+            from S select symbol, count() as c insert into Out;
+        end;
+    """
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["IBM", 1.0])
+    h.send(["IBM", 2.0])
+    snap = rt.snapshot()
+    rt.shutdown()
+
+    m2 = SiddhiManager()
+    rt2 = m2.create_siddhi_app_runtime(app)
+    got = []
+    rt2.add_callback("Out", StreamCallback(
+        lambda evs: got.extend(e.data for e in evs)))
+    rt2.restore(snap)
+    rt2.start()
+    rt2.get_input_handler("S").send(["IBM", 3.0])
+    rt2.shutdown()
+    assert got == [["IBM", 3]]
