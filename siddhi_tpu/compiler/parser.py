@@ -297,6 +297,7 @@ class Parser:
         stream = self.parse_single_stream()
         self.eat_kw("select")
         selector = self.parse_selector_body()
+        self._parse_selector_suffix(selector)
         self.eat_kw("aggregate")
         by_attr = None
         if self.try_kw("by"):
@@ -572,6 +573,8 @@ class Parser:
         per = None
         if self.try_kw("within"):
             within = self._parse_within_expr()
+            if self.try_op(","):
+                within = (within, self._parse_within_expr())
         if self.try_kw("per"):
             per = self.parse_expression()
         return JoinInputStream(left, jt, right, on, trigger, within, per)

@@ -236,6 +236,8 @@ class SiddhiAppRuntime:
             return self.named_windows[stream_id].definition
         if d is None and stream_id in self.tables:
             return self.tables[stream_id].definition
+        if d is None and stream_id in self.aggregations:
+            return self.aggregations[stream_id].output_definition
         if d is None:
             raise DefinitionNotExistError(
                 f"No stream/window/table '{stream_id}' defined")

@@ -1,0 +1,114 @@
+"""Incremental aggregation behavioural tests (reference model: siddhi-core
+aggregation/*TestCase — define aggregation, aggregate by time, query with
+within/per via store queries and joins)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+APP = """
+define stream TradeStream (symbol string, price double, volume long, ts long);
+define aggregation TradeAgg
+from TradeStream
+select symbol, avg(price) as avgPrice, sum(price) as total, count() as n
+group by symbol
+aggregate by ts every sec ... year;
+"""
+
+
+def setup():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(APP)
+    rt.start()
+    h = rt.get_input_handler("TradeStream")
+    # two events in the same second, one in the next minute
+    h.send(["WSO2", 50.0, 1, 1496289950000])
+    h.send(["WSO2", 70.0, 1, 1496289950500])
+    h.send(["WSO2", 60.0, 1, 1496290016000])
+    h.send(["IBM", 100.0, 1, 1496289950000])
+    return m, rt
+
+
+def test_store_query_per_seconds():
+    m, rt = setup()
+    events = rt.query("""
+        from TradeAgg within 1496289940000, 1496290020000 per 'seconds'
+        select AGG_TIMESTAMP, symbol, avgPrice, total, n
+    """)
+    rows = sorted([e.data for e in events], key=lambda r: (r[0], r[1]))
+    assert rows == [
+        [1496289950000, "IBM", 100.0, 100.0, 1],
+        [1496289950000, "WSO2", 60.0, 120.0, 2],
+        [1496290016000, "WSO2", 60.0, 60.0, 1],
+    ]
+    rt.shutdown()
+
+
+def test_store_query_per_minutes_rollup():
+    m, rt = setup()
+    events = rt.query("""
+        from TradeAgg within 1496289900000, 1496290100000 per 'minutes'
+        select AGG_TIMESTAMP, symbol, total, n
+    """)
+    rows = sorted([e.data for e in events], key=lambda r: (r[0], r[1]))
+    # minute buckets: 1496289900000 (events 1,2,IBM) and 1496289960000
+    assert rows == [
+        [1496289900000, "IBM", 100.0, 1],
+        [1496289900000, "WSO2", 120.0, 2],
+        [1496289960000, "WSO2", 60.0, 1],
+    ]
+    rt.shutdown()
+
+
+def test_store_query_on_filter():
+    m, rt = setup()
+    events = rt.query("""
+        from TradeAgg on symbol == 'WSO2'
+        within 1496289940000, 1496290020000 per 'seconds'
+        select symbol, total
+    """)
+    assert sorted(e.data for e in events) == [["WSO2", 60.0],
+                                              ["WSO2", 120.0]]
+    rt.shutdown()
+
+
+def test_aggregation_join():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(APP + """
+        define stream QueryStream (symbol string, start long, end long);
+        @info(name='query1')
+        from QueryStream as q join TradeAgg as a
+        on a.symbol == q.symbol
+        within 1496289940000, 1496290020000
+        per 'seconds'
+        select a.symbol as symbol, a.total as total, a.n as n
+        insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: got.extend(e.data for e in evs)))
+    rt.start()
+    h = rt.get_input_handler("TradeStream")
+    h.send(["WSO2", 50.0, 1, 1496289950000])
+    h.send(["WSO2", 70.0, 1, 1496289950500])
+    rt.get_input_handler("QueryStream").send(["WSO2", 0, 0])
+    rt.shutdown()
+    assert got == [["WSO2", 120.0, 2]]
+
+
+def test_aggregation_snapshot_restore():
+    m, rt = setup()
+    snap = rt.snapshot()
+    rt.shutdown()
+    m2 = SiddhiManager()
+    rt2 = m2.create_siddhi_app_runtime(APP)
+    rt2.restore(snap)
+    rt2.start()
+    rt2.get_input_handler("TradeStream").send(
+        ["WSO2", 40.0, 1, 1496289950800])
+    events = rt2.query("""
+        from TradeAgg within 1496289940000, 1496290020000 per 'seconds'
+        select symbol, total, n
+    """)
+    rows = sorted(e.data for e in events)
+    assert ["WSO2", 160.0, 3] in rows
+    rt2.shutdown()
