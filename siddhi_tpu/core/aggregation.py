@@ -49,15 +49,15 @@ _DECOMPOSE = {
 class _OutputSpec:
     """One select attribute of the aggregation definition."""
 
-    __slots__ = ("name", "kind", "bases", "arg", "out_type", "expr")
+    __slots__ = ("name", "kind", "bases", "arg", "out_type", "group_idx")
 
-    def __init__(self, name, kind, bases, arg, out_type, expr=None):
+    def __init__(self, name, kind, bases, arg, out_type, group_idx=None):
         self.name = name
         self.kind = kind          # 'agg' | 'last' | 'group'
-        self.bases = bases        # list of base slot indices (for 'agg')
-        self.arg = arg            # CompiledExpr (agg argument)
+        self.bases = bases        # base slot indices ('agg'/'last')
+        self.arg = arg            # CompiledExpr (agg argument / last expr)
         self.out_type = out_type
-        self.expr = expr          # CompiledExpr for 'last'/'group'
+        self.group_idx = group_idx  # index into group key tuple ('group')
 
 
 class AggregationRuntime:
@@ -114,11 +114,20 @@ class AggregationRuntime:
                 out_attrs.append(Attribute(oa.rename, t))
             else:
                 ce = compiler.compile(e)
-                kind = "group" if (oa.rename in self.group_names or
-                                   getattr(e, "attribute", None)
-                                   in self.group_names) else "last"
-                self.outputs.append(_OutputSpec(oa.rename, kind, None, None,
-                                                ce.type, ce))
+                gname = getattr(e, "attribute", None)
+                if gname in self.group_names:
+                    gi = self.group_names.index(gname)
+                    self.outputs.append(_OutputSpec(oa.rename, "group", None,
+                                                    None, ce.type,
+                                                    group_idx=gi))
+                else:
+                    # non-grouped passthrough: per-bucket last value
+                    # (reference incremental 'last' semantics)
+                    slot = len(self.base_fns)
+                    self.base_fns.append("last")
+                    self.base_args.append(ce)
+                    self.outputs.append(_OutputSpec(oa.rename, "last",
+                                                    [slot], ce, ce.type))
                 out_attrs.append(Attribute(oa.rename, ce.type))
         self.output_definition = StreamDefinition(ad.id, out_attrs)
 
@@ -131,7 +140,6 @@ class AggregationRuntime:
         # bucket stores: duration → {(bucket_ts, key): [base values]}
         self.buckets: Dict[str, Dict[Tuple[int, Tuple], List[Any]]] = {
             d: {} for d in self.durations}
-        self.last_values: Dict[Tuple, List[Any]] = {}
 
         junction = app_runtime.junction_of(self.stream_id)
         junction.subscribe(self)
@@ -169,8 +177,6 @@ class AggregationRuntime:
                 v = np.broadcast_to(np.asarray(v), (n,)) \
                     if np.asarray(v).ndim == 0 else np.asarray(v)
                 base_vals.append(v)
-        last_exprs = [(i, o.expr.fn(ctx)) for i, o in enumerate(self.outputs)
-                      if o.kind == "last"]
         for i in range(n):
             key = tuple(_py(kc[i]) for kc in key_cols)
             ts = int(ts_col[i])
@@ -186,11 +192,6 @@ class AggregationRuntime:
                     v = base_vals[si]
                     slots[si] = _update(fn, slots[si],
                                         None if v is None else _py(v[i]))
-            lv = self.last_values.setdefault(key,
-                                             [None] * len(self.outputs))
-            for oi, col in last_exprs:
-                c = np.asarray(col)
-                lv[oi] = _py(col if c.ndim == 0 else c[i])
 
     # ------------------------------------------------------------ query side
 
@@ -216,51 +217,20 @@ class AggregationRuntime:
             for i, r in enumerate(rows):
                 arr[i] = r[1][gi]
             cols[gname] = arr
-        for oi, o in enumerate(self.outputs):
+        for o in self.outputs:
             if o.name in cols:
                 continue
             arr = np.empty(k, object)
             for i, (b_ts, key, slots) in enumerate(rows):
-                if o.kind == "agg":
-                    arr[i] = _recombine(o, self.base_fns, slots)
+                if o.kind == "group":
+                    arr[i] = key[o.group_idx]
+                elif o.kind == "last":
+                    arr[i] = slots[o.bases[0]]
                 else:
-                    lv = self.last_values.get(key)
-                    arr[i] = lv[oi] if lv else None
+                    arr[i] = _recombine(o, self.base_fns, slots)
             cols[o.name] = arr
         ts = cols[AGG_TS]
         return EventChunk(names, ts, np.zeros(k, np.int8), cols)
-
-    def execute_store_query(self, sq, factory):
-        """`from Agg [on cond] within ... per ... select ...`"""
-        from .selector import QuerySelector
-
-        class _Collector:
-            def __init__(self):
-                self.chunks = []
-
-            def process(self, c):
-                self.chunks.append(c)
-
-        st = sq.input_store
-        chunk = self.find_chunk(st.within, st.per)
-        definition = self.output_definition
-        scope = Scope()
-        scope.add_primary(definition.id, st.store_ref, definition)
-        if st.on is not None:
-            ce = factory(scope).compile(st.on)
-            ctx = EvalCtx(chunk.columns, chunk.timestamps, len(chunk))
-            m = np.asarray(ce.fn(ctx), bool)
-            if m.ndim == 0:
-                m = np.full(len(chunk), bool(m))
-            chunk = chunk.mask(m)
-        sel = QuerySelector(sq.selector, scope, definition, factory,
-                            output_id="store")
-        col = _Collector()
-        sel.next = col
-        sel.process(chunk.with_types(CURRENT))
-        if not col.chunks:
-            return []
-        return EventChunk.concat(col.chunks).to_events()
 
     # ------------------------------------------------------------ snapshot
 
@@ -269,8 +239,6 @@ class AggregationRuntime:
             "buckets": {d: [[list(b), list(map(_jsonable, slots))]
                             for b, slots in store.items()]
                         for d, store in self.buckets.items()},
-            "last": [[list(k), list(map(_jsonable, v))]
-                     for k, v in self.last_values.items()],
         }
 
     def restore_state(self, s):
@@ -278,7 +246,6 @@ class AggregationRuntime:
             d: {(int(b[0]), tuple(b[1])): list(slots)
                 for b, slots in recs}
             for d, recs in s["buckets"].items()}
-        self.last_values = {tuple(k): list(v) for k, v in s["last"]}
 
 
 # ---------------------------------------------------------------- helpers
@@ -300,6 +267,8 @@ def _update(fn: str, acc, v):
         return (acc or 0) + 1
     if v is None:
         return acc
+    if fn == "last":
+        return v
     if fn == "sum":
         return (acc or 0) + v
     if fn == "sumsq":
@@ -324,7 +293,7 @@ def _recombine(o: _OutputSpec, base_fns, slots):
         if not n:
             return None
         mean = d["sum"] / n
-        return (d["sumsq"] / n - mean * mean) ** 0.5
+        return max(d["sumsq"] / n - mean * mean, 0.0) ** 0.5
     return vals[0]
 
 
@@ -379,10 +348,7 @@ def _eval_within(within) -> Tuple[int, int]:
     if isinstance(w, Constant) and isinstance(w.value, str) and \
             "**" in w.value:
         s = w.value.strip()
-        # replace wildcards with range endpoints
-        lo_s = (s.replace("**:**:**", "00:00:00").replace("**:**", "00:00")
-                .replace("**", "01", 1) if s.count("**") else s)
-        # conservative: year-level prefix before first wildcard
+        # the range comes from the date prefix before the first wildcard
         prefix = s.split("**")[0].rstrip("-: ")
         try:
             if len(prefix) == 4:            # "2014"

@@ -63,8 +63,10 @@ class StoreQueryRuntime:
             if chunk is None:
                 chunk = EventChunk.empty(definition.attribute_names)
             chunk = self._apply_on(chunk, definition)
-        else:  # aggregation
-            return src.execute_store_query(sq, self._factory())
+        else:  # aggregation: within/per bucket materialisation
+            definition = src.output_definition
+            chunk = src.find_chunk(sq.input_store.within, sq.input_store.per)
+            chunk = self._apply_on(chunk, definition)
 
         if sq.type == StoreQueryType.FIND:
             return self._select(chunk, definition)

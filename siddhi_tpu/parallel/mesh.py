@@ -1,0 +1,67 @@
+"""Partition-axis sharding over a TPU device mesh.
+
+The reference scales by cloning per-key processor graphs inside one JVM
+(partition/PartitionRuntime.java:255-308) and has no distributed backend
+(SURVEY.md §2.8/§5.8).  Here the partition axis of the NFA state tensors
+([P, K] slots, [P, K, S, C] captures) and the [P, T] event lanes shard over
+an ICI mesh: every device steps its own partition shard, no collectives on
+the hot path; global statistics (match counts, dropped counters) reduce with
+one psum at block end.  Multi-host scale-out uses the same program under
+jax.distributed over DCN.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.nfa import NfaSpec, build_block_step, make_carry
+
+
+def partition_mesh(devices: Optional[Sequence] = None,
+                   axis: str = "p") -> Mesh:
+    """1-D mesh over all (or given) devices; the partition axis maps onto it."""
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devs, (axis,))
+
+
+def shard_carry(carry: Dict[str, jnp.ndarray], mesh: Mesh,
+                axis: str = "p") -> Dict[str, jnp.ndarray]:
+    """Place NFA carry tensors with their leading partition dim sharded."""
+    out = {}
+    for k, v in carry.items():
+        spec = P(axis, *([None] * (v.ndim - 1)))
+        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+    return out
+
+
+def build_sharded_step(spec: NfaSpec, mesh: Mesh, axis: str = "p"):
+    """jit-compiled block step with partition-sharded inputs/outputs and a
+    psum'd per-block stats reduction (the only collective)."""
+    step = build_block_step(spec)
+
+    def stepped(carry, block):
+        new_carry, (mask, caps, ts) = step(carry, block)
+        # global per-block stats ride one reduction; with the leading axis
+        # sharded XLA lowers this to an all-reduce over ICI
+        stats = {
+            "matches": jnp.sum(mask.astype(jnp.int32)),
+            "dropped": jnp.sum(new_carry["dropped"]),
+        }
+        return new_carry, (mask, caps, ts), stats
+
+    def in_spec(v):
+        return NamedSharding(mesh, P(axis, *([None] * (v.ndim - 1))))
+
+    def shardings_like(tree):
+        return jax.tree_util.tree_map(in_spec, tree)
+
+    return jax.jit(stepped)
+
+
+def make_sharded_carry(spec: NfaSpec, n_partitions: int, mesh: Mesh,
+                       axis: str = "p") -> Dict[str, jnp.ndarray]:
+    return shard_carry(make_carry(spec, n_partitions), mesh, axis)

@@ -1,0 +1,297 @@
+"""Pattern query → batched TPU NFA (the north-star compilation path).
+
+Takes the same SiddhiQL the host oracle runs (compiler/ → query_api
+StateInputStream, reference grammar SiddhiQL.g4:200-345) and lowers an
+`every c0 -> c1 -> ... within t` PATTERN chain into an ops/nfa.py NfaSpec:
+per-state condition programs compiled by plan/expr_compiler.ExprCompiler with
+``xp=jax.numpy`` (so the same expression IR serves both paths), capture-lane
+allocation for cross-state references, and a host runtime that packs event
+batches into [P, T] partition lanes and decodes match buffers.
+
+Supported subset (v1, the BASELINE.json perf configs):
+  - PATTERN type with `every` chains: every e1=S[...] -> e2=S2[...] -> ...
+  - per-state filters referencing earlier captures (numeric attributes)
+  - top-level `within`
+  - select of captured attributes (`e1.price as p1`, `eN.x`)
+Everything else (logical/absent/kleene, strings in conditions) runs on the
+host oracle (core/pattern.py); the query planner picks per query.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compiler import SiddhiCompiler
+from ..ops.nfa import NfaSpec, build_block_step, make_carry, pack_blocks
+from ..query_api import (EveryStateElement, Filter, NextStateElement, Query,
+                         StateInputStream, StateType, StreamStateElement)
+from ..query_api.definition import AttrType
+from ..query_api.expression import Variable
+from ..utils.errors import SiddhiAppCreationError
+from .expr_compiler import EvalCtx, ExprCompiler, Scope
+
+_NUMERIC = (AttrType.INT, AttrType.LONG, AttrType.FLOAT, AttrType.DOUBLE)
+
+
+class _ChainState:
+    def __init__(self, idx: int, ref: str, stream_id: str, definition,
+                 filters):
+        self.idx = idx
+        self.ref = ref
+        self.stream_id = stream_id
+        self.definition = definition
+        self.filters = filters
+
+
+def _flatten_chain(sis: StateInputStream) -> List[StreamStateElement]:
+    """Next(Every(A), Next(B, C)) → [A, B, C]; rejects non-chain shapes."""
+    out: List[StreamStateElement] = []
+
+    def rec(el, first: bool):
+        if isinstance(el, NextStateElement):
+            rec(el.state, first)
+            rec(el.next, False)
+        elif isinstance(el, EveryStateElement):
+            if not first or not isinstance(el.state, StreamStateElement):
+                raise SiddhiAppCreationError(
+                    "TPU NFA path supports `every` only on the first chain "
+                    "element")
+            out.append(el.state)
+        elif isinstance(el, StreamStateElement):
+            if type(el) is not StreamStateElement:
+                raise SiddhiAppCreationError(
+                    "TPU NFA path: absent states not supported")
+            out.append(el)
+        else:
+            raise SiddhiAppCreationError(
+                f"TPU NFA path: unsupported state element "
+                f"{type(el).__name__}")
+    rec(sis.state, True)
+    return out
+
+
+class CompiledPatternNFA:
+    """One pattern query compiled for batched multi-partition execution."""
+
+    def __init__(self, app_string: str, n_partitions: int,
+                 n_slots: int = 8, query_name: Optional[str] = None):
+        app = SiddhiCompiler.parse(app_string)
+        self.app = app
+        query = self._pick_query(app, query_name)
+        sis = query.input_stream
+        if not isinstance(sis, StateInputStream) or \
+                sis.state_type != StateType.PATTERN:
+            raise SiddhiAppCreationError("TPU NFA path needs a PATTERN query")
+        elements = _flatten_chain(sis)
+        is_every = isinstance(
+            sis.state.state if isinstance(sis.state, NextStateElement)
+            else sis.state, EveryStateElement)
+
+        # stream codes: order of first appearance
+        self.stream_codes: Dict[str, int] = {}
+        states: List[_ChainState] = []
+        for i, el in enumerate(elements):
+            s = el.stream
+            sid = s.stream_id
+            if sid not in app.stream_definitions:
+                raise SiddhiAppCreationError(f"No stream '{sid}'")
+            if sid not in self.stream_codes:
+                self.stream_codes[sid] = len(self.stream_codes)
+            d = app.stream_definitions[sid]
+            filters = [h.expr for h in s.handlers if isinstance(h, Filter)]
+            if any(not isinstance(h, Filter) for h in s.handlers):
+                raise SiddhiAppCreationError(
+                    "TPU NFA path: only [filter] handlers in conditions")
+            states.append(_ChainState(i, s.stream_ref or f"e{i + 1}", sid, d,
+                                      filters))
+        self.states = states
+        S = len(states)
+
+        # attribute schema: union over referenced streams; numeric only
+        self.attr_names: List[str] = []
+        self.attr_types: Dict[str, AttrType] = {}
+        for st in states:
+            for a in st.definition.attributes:
+                if a.name not in self.attr_types:
+                    if a.type not in _NUMERIC:
+                        continue  # non-numeric attrs unavailable on TPU path
+                    self.attr_names.append(a.name)
+                    self.attr_types[a.name] = a.type
+
+        # capture lanes: (state, attr) pairs referenced by later filters or
+        # by the select clause
+        ref_to_idx = {st.ref: st.idx for st in states}
+        needed: List[set] = [set() for _ in range(S)]
+
+        def note(var: Variable, current_idx: Optional[int]):
+            if var.stream_id is None:
+                return
+            idx = ref_to_idx.get(var.stream_id)
+            if idx is None or idx == current_idx:
+                return
+            needed[idx].add(var.attribute)
+
+        def scan_expr(e, current_idx):
+            if isinstance(e, Variable):
+                note(e, current_idx)
+            for f in getattr(e, "__dataclass_fields__", {}):
+                v = getattr(e, f)
+                if isinstance(v, list):
+                    for x in v:
+                        if hasattr(x, "__dataclass_fields__"):
+                            scan_expr(x, current_idx)
+                elif hasattr(v, "__dataclass_fields__"):
+                    scan_expr(v, current_idx)
+
+        for st in states:
+            for fe in st.filters:
+                scan_expr(fe, st.idx)
+        self.select_outputs: List[Tuple[str, int, str]] = []
+        for oa in query.selector.attributes:
+            e = oa.expr
+            if not isinstance(e, Variable) or e.stream_id is None:
+                raise SiddhiAppCreationError(
+                    "TPU NFA path: select must be captured attributes "
+                    "(e1.attr as name)")
+            idx = ref_to_idx[e.stream_id]
+            needed[idx].add(e.attribute)
+            self.select_outputs.append((oa.rename, idx, e.attribute))
+
+        cap_cols = [sorted(n) for n in needed]
+        C = max((len(c) for c in cap_cols), default=0)
+        self.cap_lane: Dict[Tuple[int, str], int] = {}
+        for j, cols in enumerate(cap_cols):
+            for lane, a in enumerate(cols):
+                self.cap_lane[(j, a)] = lane
+
+        # compile per-state condition programs against jnp
+        cond_fns: List[Callable] = []
+        for st in states:
+            cond_fns.append(self._compile_condition(st, ref_to_idx))
+
+        self.spec = NfaSpec(
+            n_states=S, n_caps=C, n_slots=n_slots,
+            within_ms=sis.within_ms,
+            state_streams=np.asarray(
+                [self.stream_codes[st.stream_id] for st in states], np.int32),
+            cond_fns=cond_fns, cap_cols=cap_cols,
+            attr_names=self.attr_names, is_every=is_every)
+        self.n_partitions = n_partitions
+        self.carry = make_carry(self.spec, n_partitions)
+        self._step = jax.jit(build_block_step(self.spec), donate_argnums=0)
+        self.base_ts: Optional[int] = None
+
+    @staticmethod
+    def _pick_query(app, query_name) -> Query:
+        from ..query_api import find_annotation
+        for el in app.execution_elements:
+            if not isinstance(el, Query):
+                continue
+            if query_name is None or el.name == query_name:
+                return el
+        raise SiddhiAppCreationError(f"No query '{query_name}' in app")
+
+    def _compile_condition(self, st: _ChainState, ref_to_idx) -> Callable:
+        if not st.filters:
+            return lambda event, captures: jnp.ones(
+                (self.spec.n_slots,), bool)
+        from ..query_api.expression import And
+        expr = st.filters[0]
+        for fe in st.filters[1:]:
+            expr = And(expr, fe)
+
+        scope = Scope()
+        # current event attributes (scalars broadcast over K)
+        for a in st.definition.attributes:
+            if a.name not in self.attr_types:
+                continue
+
+            def g(ctx, _a=a.name):
+                return ctx.columns[_a]
+            scope.add(None, a.name, a.type, g)
+            scope.add(st.stream_id, a.name, a.type, g)
+            scope.add(st.ref, a.name, a.type, g)
+        # earlier captures: [K] lanes
+        for other in self.states:
+            if other.idx == st.idx:
+                continue
+            for a in other.definition.attributes:
+                def gq(ctx, _r=other.ref, _a=a.name):
+                    return ctx.qualified[(_r, 0)][_a]
+                scope.add(other.ref, a.name, a.type, gq, index=0)
+                scope.add(other.ref, a.name, a.type, gq, index=None)
+        compiled = ExprCompiler(scope, jnp).compile(expr)
+        cap_lane = self.cap_lane
+        K = None  # resolved at trace time from captures shape
+
+        def fn(event, captures, _c=compiled, _st=st):
+            k = captures.shape[0]
+            qualified = {}
+            for other in self.states:
+                if other.idx == _st.idx:
+                    continue
+                cols = {}
+                for (j, a), lane in cap_lane.items():
+                    if j == other.idx:
+                        cols[a] = captures[:, j, lane]
+                qualified[(other.ref, 0)] = cols
+            cols_now = {a: event[a] for a in self.attr_names}
+            ctx = EvalCtx(cols_now, jnp.full((k,), event["__ts"]), k,
+                          qualified=qualified)
+            out = _c.fn(ctx)
+            out = jnp.asarray(out, bool)
+            if out.ndim == 0:
+                out = jnp.broadcast_to(out, (k,))
+            return out
+        return fn
+
+    # ------------------------------------------------------------ execution
+
+    def process_block(self, block: Dict[str, np.ndarray]):
+        """Run one [P, T] packed block; returns decoded matches."""
+        self.carry, (mask, caps, ts) = self._step(self.carry, block)
+        return mask, caps, ts
+
+    def process_events(self, partition_ids: np.ndarray,
+                       columns: Dict[str, np.ndarray],
+                       timestamps: np.ndarray,
+                       stream_names: Optional[np.ndarray] = None):
+        """Flat event batch → packed lanes → device step → decoded matches.
+
+        Returns a list of (partition, match_ts, {out_name: value})."""
+        if self.base_ts is None:
+            self.base_ts = int(timestamps[0]) if len(timestamps) else 0
+        if stream_names is None:
+            codes = np.zeros(len(partition_ids), np.int32)
+        else:
+            codes = np.asarray([self.stream_codes[s] for s in stream_names],
+                               np.int32)
+        cols = {a: np.asarray(columns[a]) for a in self.attr_names}
+        block = pack_blocks(np.asarray(partition_ids), cols,
+                            np.asarray(timestamps), codes,
+                            self.n_partitions, base_ts=self.base_ts)
+        mask, caps, ts = self.process_block(block)
+        return self.decode_matches(mask, caps, ts)
+
+    def decode_matches(self, mask, caps, ts):
+        mask = np.asarray(mask)          # [P, T, K]
+        caps = np.asarray(caps)          # [P, T, K, S, C]
+        ts = np.asarray(ts)
+        out = []
+        ps, tts, ks = np.nonzero(mask)
+        for p, t, k in zip(ps, tts, ks):
+            vals = {}
+            for name, idx, attr in self.select_outputs:
+                lane = self.cap_lane[(idx, attr)]
+                v = float(caps[p, t, k, idx, lane])
+                at = self.attr_types.get(attr)
+                if at in (AttrType.INT, AttrType.LONG):
+                    v = int(round(v))
+                vals[name] = v
+            out.append((int(p), int(ts[p, t, k]) + (self.base_ts or 0),
+                        vals))
+        out.sort(key=lambda m: m[1])
+        return out

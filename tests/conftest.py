@@ -1,9 +1,14 @@
 """Test configuration: force a virtual 8-device CPU mesh so sharding tests run
-without TPU hardware (the driver separately dry-runs multi-chip compilation)."""
+without TPU hardware (the driver separately dry-runs multi-chip compilation).
+
+Must run before any jax import: the axon TPU plugin registers itself whenever
+PALLAS_AXON_POOL_IPS is set, regardless of JAX_PLATFORMS, so both are forced.
+"""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault(
-    "XLA_FLAGS",
-    (os.environ.get("XLA_FLAGS", "") +
-     " --xla_force_host_platform_device_count=8").strip())
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

@@ -112,3 +112,40 @@ def test_aggregation_snapshot_restore():
     rows = sorted(e.data for e in events)
     assert ["WSO2", 160.0, 3] in rows
     rt2.shutdown()
+
+
+def test_renamed_group_by_output():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream T (symbol string, price double, ts long);
+        define aggregation A
+        from T select symbol as sym, sum(price) as total
+        group by symbol
+        aggregate by ts every sec ... min;
+    """)
+    rt.start()
+    rt.get_input_handler("T").send(["WSO2", 10.0, 1496289950000])
+    events = rt.query("from A within 1496289940000, 1496290020000 "
+                      "per 'seconds' select sym, total")
+    assert [e.data for e in events] == [["WSO2", 10.0]]
+    rt.shutdown()
+
+
+def test_last_value_is_per_bucket():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream T (symbol string, price double, ts long);
+        define aggregation A
+        from T select symbol, price as lastPrice, sum(price) as total
+        group by symbol
+        aggregate by ts every sec ... min;
+    """)
+    rt.start()
+    h = rt.get_input_handler("T")
+    h.send(["WSO2", 10.0, 1496289950000])
+    h.send(["WSO2", 99.0, 1496289951000])   # next second bucket
+    events = rt.query("from A within 1496289940000, 1496290020000 "
+                      "per 'seconds' select AGG_TIMESTAMP, lastPrice")
+    rows = sorted(e.data for e in events)
+    assert rows == [[1496289950000, 10.0], [1496289951000, 99.0]]
+    rt.shutdown()

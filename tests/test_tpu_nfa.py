@@ -1,0 +1,131 @@
+"""Conformance: batched TPU NFA vs the host oracle pattern engine.
+
+The oracle (core/pattern.py) mirrors the reference semantics test-by-test;
+here the compiled NFA must produce the exact same match set on randomized
+event streams across many partitions (SURVEY.md §7.6 exact-match
+conformance).
+"""
+import numpy as np
+import pytest
+
+from siddhi_tpu import QueryCallback, SiddhiManager
+from siddhi_tpu.plan.nfa_compiler import CompiledPatternNFA
+
+APP = """
+define stream S (partition int, price float, kind int);
+@info(name='q')
+from every e1=S[kind == 0 and price > 50.0] -> e2=S[kind == 1 and price > e1.price]
+select e1.price as p1, e2.price as p2
+insert into Out;
+"""
+
+APP_WITHIN = """
+define stream S (partition int, price float, kind int);
+@info(name='q')
+from every e1=S[kind == 0 and price > 50.0] -> e2=S[kind == 1 and price > e1.price]
+    within 1 sec
+select e1.price as p1, e2.price as p2
+insert into Out;
+"""
+
+APP3 = """
+define stream S (partition int, price float, kind int);
+@info(name='q')
+from every e1=S[kind == 0] -> e2=S[kind == 1 and price > e1.price] -> e3=S[kind == 2 and price > e2.price]
+select e1.price as p1, e2.price as p2, e3.price as p3
+insert into Out;
+"""
+
+
+def oracle_matches(app, events_by_partition):
+    """Run the host oracle once per partition (partition isolation)."""
+    out = []
+    for p, events in events_by_partition.items():
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime("@app:playback " + app)
+        got = []
+        rt.add_callback("q", QueryCallback(
+            lambda ts, cur, exp: got.extend(
+                tuple(e.data) for e in (cur or []))))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for ts, row in events:
+            h.send(row, timestamp=ts)
+        rt.shutdown()
+        out.extend((p, g) for g in got)
+    return sorted(out, key=lambda x: (x[0], x[1]))
+
+
+def gen_events(seed, n, n_partitions, kinds=2):
+    rng = np.random.default_rng(seed)
+    pids = rng.integers(0, n_partitions, n)
+    prices = rng.uniform(0.0, 100.0, n).astype(np.float32)
+    kind = rng.integers(0, kinds, n).astype(np.int32)
+    ts = np.cumsum(rng.integers(1, 200, n)).astype(np.int64) + 1_000_000
+    return pids, prices, kind, ts
+
+
+def run_tpu(app, pids, prices, kind, ts, n_partitions, n_slots=16):
+    nfa = CompiledPatternNFA(app, n_partitions=n_partitions, n_slots=n_slots)
+    cols = {"partition": pids.astype(np.float32), "price": prices,
+            "kind": kind.astype(np.float32)}
+    return nfa.process_events(pids, cols, ts)
+
+
+def assert_equal_matches(app, seed, n, n_partitions, outputs, n_slots=16):
+    pids, prices, kind, ts = gen_events(seed, n, n_partitions,
+                                        kinds=len(outputs))
+    tpu = run_tpu(app, pids, prices, kind, ts, n_partitions, n_slots)
+    tpu_set = sorted((p, tuple(round(v[o], 3) for o in outputs))
+                     for p, _, v in tpu)
+    events_by_partition = {}
+    for i in range(n):
+        events_by_partition.setdefault(int(pids[i]), []).append(
+            (int(ts[i]), [int(pids[i]), float(prices[i]), int(kind[i])]))
+    oracle = oracle_matches(app, events_by_partition)
+    oracle_set = sorted((p, tuple(round(x, 3) for x in g))
+                        for p, g in oracle)
+    assert tpu_set == oracle_set
+
+
+def test_two_state_chain_conformance():
+    assert_equal_matches(APP, seed=1, n=400, n_partitions=8,
+                         outputs=["p1", "p2"])
+
+
+def test_two_state_chain_many_partitions():
+    assert_equal_matches(APP, seed=2, n=1000, n_partitions=32,
+                         outputs=["p1", "p2"])
+
+
+def test_within_conformance():
+    assert_equal_matches(APP_WITHIN, seed=3, n=500, n_partitions=8,
+                         outputs=["p1", "p2"])
+
+
+def test_three_state_chain_conformance():
+    assert_equal_matches(APP3, seed=4, n=400, n_partitions=8,
+                         outputs=["p1", "p2", "p3"], n_slots=32)
+
+
+def test_sharded_step_runs_on_virtual_mesh():
+    """Partition axis sharded over the 8 virtual CPU devices (conftest)."""
+    import jax
+    from siddhi_tpu.ops.nfa import pack_blocks
+    from siddhi_tpu.parallel.mesh import (build_sharded_step,
+                                          make_sharded_carry, partition_mesh)
+    n_partitions = 16
+    nfa = CompiledPatternNFA(APP, n_partitions=n_partitions, n_slots=8)
+    mesh = partition_mesh()
+    carry = make_sharded_carry(nfa.spec, n_partitions, mesh)
+    step = build_sharded_step(nfa.spec, mesh)
+    pids, prices, kind, ts = gen_events(7, 256, n_partitions)
+    cols = {"partition": pids.astype(np.float32), "price": prices,
+            "kind": kind.astype(np.float32)}
+    codes = np.zeros(len(pids), np.int32)
+    block = pack_blocks(pids, cols, ts, codes, n_partitions,
+                        base_ts=int(ts[0]))
+    carry, (mask, caps, mts), stats = step(carry, block)
+    # same events through the unsharded path must match exactly
+    tpu = nfa.process_events(pids, cols, ts)
+    assert int(stats["matches"]) == len(tpu)
