@@ -188,15 +188,9 @@ def pack_blocks(partition_ids: np.ndarray, columns: Dict[str, np.ndarray],
 
     This is the columnar replacement for the reference's per-key junction
     routing (partition/PartitionStreamReceiver.java:83-153)."""
+    from ..native_ext import assign_rows
     n = len(partition_ids)
-    counts = np.bincount(partition_ids, minlength=n_partitions)
-    T = max(int(counts.max()), 1) if n else 1
-    pos = np.zeros(n_partitions, np.int64)
-    row = np.empty(n, np.int64)
-    for i in range(n):            # cheap host loop; C++ path later
-        p = partition_ids[i]
-        row[i] = pos[p]
-        pos[p] += 1
+    row, _counts, T = assign_rows(partition_ids, n_partitions)
     block: Dict[str, np.ndarray] = {}
     for name, col in columns.items():
         out = np.zeros((n_partitions, T), np.float32)

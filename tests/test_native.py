@@ -1,0 +1,74 @@
+"""Native host data path: C++ bindings vs numpy fallbacks (native/
+eventpack.cpp via siddhi_tpu/native_ext.py)."""
+import threading
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import native_ext
+from siddhi_tpu.native_ext import ColumnarRing, assign_rows, have_native
+
+
+def test_assign_rows_matches_reference_loop():
+    rng = np.random.default_rng(0)
+    pids = rng.integers(0, 37, 5000).astype(np.int32)
+    rows, counts, T = assign_rows(pids, 37)
+    # reference semantics: running index per partition
+    pos = np.zeros(37, np.int64)
+    for i, p in enumerate(pids):
+        assert rows[i] == pos[p]
+        pos[p] += 1
+    assert (counts == np.bincount(pids, minlength=37)).all()
+    assert T == int(counts.max())
+
+
+def test_ring_roundtrip_and_overflow():
+    r = ColumnarRing(capacity=10, n_cols=3)
+    v = np.arange(36.0).reshape(12, 3)
+    pushed = r.push(v, np.arange(12), np.zeros(12, np.int32),
+                    np.arange(12, dtype=np.int32))
+    assert pushed == 10           # overflow → backpressure accounting
+    assert r.dropped == 2
+    assert len(r) == 10
+    out_v, out_t, out_s, out_p = r.drain(6)
+    assert out_v.shape == (6, 3)
+    assert (out_v == v[:6]).all()
+    assert len(r) == 4
+    out_v2, *_ = r.drain(100)
+    assert (out_v2 == v[6:10]).all()
+    assert len(r) == 0
+
+
+def test_ring_wraparound():
+    r = ColumnarRing(capacity=4, n_cols=1)
+    for k in range(5):   # repeatedly push 2 / drain 2 across the wrap point
+        vals = np.asarray([[float(2 * k)], [float(2 * k + 1)]])
+        assert r.push(vals, np.asarray([0, 0]), np.zeros(2, np.int32),
+                      np.zeros(2, np.int32)) == 2
+        out, *_ = r.drain(2)
+        assert out.reshape(-1).tolist() == [2.0 * k, 2.0 * k + 1]
+
+
+def test_ring_concurrent_producers():
+    r = ColumnarRing(capacity=100_000, n_cols=1)
+
+    def producer(tid):
+        for i in range(100):
+            r.push(np.full((10, 1), float(tid)), np.arange(10),
+                   np.zeros(10, np.int32), np.zeros(10, np.int32))
+    threads = [threading.Thread(target=producer, args=(t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = 0
+    while len(r):
+        v, *_ = r.drain(1000)
+        total += len(v)
+    assert total + r.dropped == 4 * 100 * 10
+
+
+@pytest.mark.skipif(not have_native(), reason="native .so not built")
+def test_native_lib_is_loaded():
+    assert native_ext.have_native()
