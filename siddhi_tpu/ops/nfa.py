@@ -180,6 +180,51 @@ def build_block_step(spec: NfaSpec):
     return block_step
 
 
+def build_bank_step(spec: NfaSpec):
+    """N structurally-identical patterns (constants differ) × P partitions.
+
+    Returns jittable fn(carry, block, params) → (carry, match_counts [N]):
+      carry:  NFA carry with a leading pattern axis [N, P, ...]
+      block:  one [P, T] event block, shared by every pattern
+      params: {param_name: [N]} per-pattern constant lanes
+    Match COUNTS only (the 1k-NFA fleet configs are alert-counting scale;
+    full capture decode stays on the single-pattern path) — summing inside
+    the scan keeps the [N, P, T, K] mask from materialising in HBM.
+    """
+
+    def per_partition(carry_p, events_p, prm):
+        def step(c, ev):
+            inner, acc = c
+            inner2, (mm, _mc, _mt) = _one_partition_step(spec, inner,
+                                                         {**ev, **prm})
+            # accumulate in-carry: avoids a [N, P, T] stacked ys buffer
+            return (inner2, acc + jnp.sum(mm.astype(jnp.int32))), None
+        (c2, acc), _ = jax.lax.scan(step, (carry_p, jnp.int32(0)), events_p)
+        return c2, acc
+
+    def pattern_step(carry_n, prm, block):
+        ct = (carry_n["slot_state"], carry_n["slot_start"],
+              carry_n["captures"], carry_n["dropped"])
+        (ns, st, cp, dr), counts = jax.vmap(
+            per_partition, in_axes=(0, 0, None))(ct, block, prm)
+        new_carry = {"slot_state": ns, "slot_start": st, "captures": cp,
+                     "dropped": dr}
+        return new_carry, jnp.sum(counts)
+
+    def bank_step(carry, block, params):
+        return jax.vmap(pattern_step, in_axes=(0, 0, None))(carry, params,
+                                                            block)
+
+    return bank_step
+
+
+def make_bank_carry(spec: NfaSpec, n_patterns: int,
+                    n_partitions: int) -> Dict[str, jnp.ndarray]:
+    c = make_carry(spec, n_partitions)
+    return {k: jnp.broadcast_to(v[None], (n_patterns,) + v.shape)
+            for k, v in c.items()}
+
+
 def pack_blocks(partition_ids: np.ndarray, columns: Dict[str, np.ndarray],
                 timestamps: np.ndarray, stream_codes: np.ndarray,
                 n_partitions: int, base_ts: int = 0) -> Dict[str, np.ndarray]:

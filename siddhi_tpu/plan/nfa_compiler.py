@@ -73,11 +73,38 @@ def _flatten_chain(sis: StateInputStream) -> List[StreamStateElement]:
     return out
 
 
+def _walk_filter_constants(states) -> List:
+    """Deterministic walk over all numeric Constant/TimeConstant nodes in
+    the chain's filters (the per-pattern parameters of a pattern bank)."""
+    from ..query_api.expression import Constant, TimeConstant
+    found: List = []
+
+    def rec(e):
+        if isinstance(e, (Constant, TimeConstant)) and \
+                isinstance(getattr(e, "value", None), (int, float)) and \
+                not isinstance(e.value, bool):
+            found.append(e)
+            return
+        for f in getattr(e, "__dataclass_fields__", {}):
+            v = getattr(e, f)
+            if isinstance(v, list):
+                for x in v:
+                    if hasattr(x, "__dataclass_fields__"):
+                        rec(x)
+            elif hasattr(v, "__dataclass_fields__"):
+                rec(v)
+    for st in states:
+        for fe in st.filters:
+            rec(fe)
+    return found
+
+
 class CompiledPatternNFA:
     """One pattern query compiled for batched multi-partition execution."""
 
     def __init__(self, app_string: str, n_partitions: int,
-                 n_slots: int = 8, query_name: Optional[str] = None):
+                 n_slots: int = 8, query_name: Optional[str] = None,
+                 parameterize: bool = False):
         app = SiddhiCompiler.parse(app_string)
         self.app = app
         query = self._pick_query(app, query_name)
@@ -167,6 +194,16 @@ class CompiledPatternNFA:
             for lane, a in enumerate(cols):
                 self.cap_lane[(j, a)] = lane
 
+        # optional pattern-bank parameterization: numeric filter constants
+        # become per-pattern lanes fed through the event dict
+        self._param_map: Dict[int, str] = {}
+        self.param_names: List[str] = []
+        if parameterize:
+            for j, c in enumerate(_walk_filter_constants(states)):
+                name = f"__param_{j}"
+                self._param_map[id(c)] = name
+                self.param_names.append(name)
+
         # compile per-state condition programs against jnp
         cond_fns: List[Callable] = []
         for st in states:
@@ -223,7 +260,11 @@ class CompiledPatternNFA:
                     return ctx.qualified[(_r, 0)][_a]
                 scope.add(other.ref, a.name, a.type, gq, index=0)
                 scope.add(other.ref, a.name, a.type, gq, index=None)
-        compiled = ExprCompiler(scope, jnp).compile(expr)
+        if self._param_map:
+            compiled = _ParamExprCompiler(scope, self._param_map).compile(
+                expr)
+        else:
+            compiled = ExprCompiler(scope, jnp).compile(expr)
         cap_lane = self.cap_lane
         K = None  # resolved at trace time from captures shape
 
@@ -239,6 +280,9 @@ class CompiledPatternNFA:
                         cols[a] = captures[:, j, lane]
                 qualified[(other.ref, 0)] = cols
             cols_now = {a: event[a] for a in self.attr_names}
+            for pn in self.param_names:
+                if pn in event:
+                    cols_now[pn] = event[pn]
             ctx = EvalCtx(cols_now, jnp.full((k,), event["__ts"]), k,
                           qualified=qualified)
             out = _c.fn(ctx)
@@ -247,6 +291,30 @@ class CompiledPatternNFA:
                 out = jnp.broadcast_to(out, (k,))
             return out
         return fn
+
+    def extract_params(self, app_string: str,
+                       query_name: Optional[str] = None) -> Dict[str, float]:
+        """Constant values of a structurally-identical app, keyed by the
+        param lanes of this (parameterized) compile."""
+        app = SiddhiCompiler.parse(app_string)
+        query = self._pick_query(app, query_name)
+        elements = _flatten_chain(query.input_stream)
+        if len(elements) != len(self.states):
+            raise SiddhiAppCreationError(
+                "pattern bank: app has a different chain length")
+        states = []
+        for i, el in enumerate(elements):
+            s = el.stream
+            d = app.stream_definitions[s.stream_id]
+            filters = [h.expr for h in s.handlers if isinstance(h, Filter)]
+            states.append(_ChainState(i, s.stream_ref or f"e{i + 1}",
+                                      s.stream_id, d, filters))
+        consts = _walk_filter_constants(states)
+        if len(consts) != len(self.param_names):
+            raise SiddhiAppCreationError(
+                "pattern bank: app has a different constant count")
+        return {name: float(c.value)
+                for name, c in zip(self.param_names, consts)}
 
     # ------------------------------------------------------------ execution
 
@@ -295,3 +363,85 @@ class CompiledPatternNFA:
                         vals))
         out.sort(key=lambda m: m[1])
         return out
+
+
+class _ParamExprCompiler(ExprCompiler):
+    """Expression compiler that lowers marked Constant nodes to per-pattern
+    parameter lanes read from the event dict (pattern-bank mode)."""
+
+    def __init__(self, scope: Scope, param_map: Dict[int, str]):
+        super().__init__(scope, jnp)
+        self._param_map = param_map
+
+    def _compile_constant(self, c):
+        name = self._param_map.get(id(c))
+        if name is None:
+            return super()._compile_constant(c)
+        from .expr_compiler import CompiledExpr
+
+        def fn(ctx, _n=name):
+            return ctx.columns[_n]
+        return CompiledExpr(fn, AttrType.DOUBLE)
+
+
+class CompiledPatternBank:
+    """N structurally-identical pattern queries (constants differ) stepped
+    together: carry [N, P, ...], one shared event block per step, match
+    counts per pattern (BASELINE config: 1k NFAs × 10k partitions)."""
+
+    def __init__(self, apps: Sequence[str], n_partitions: int,
+                 n_slots: int = 8, pattern_chunk: Optional[int] = None):
+        import jax
+        from ..ops.nfa import build_bank_step, make_bank_carry
+        self.nfa = CompiledPatternNFA(apps[0], n_partitions=n_partitions,
+                                      n_slots=n_slots, parameterize=True)
+        self.n_patterns = len(apps)
+        self.n_partitions = n_partitions
+        lanes: Dict[str, List[float]] = {n: [] for n in
+                                         self.nfa.param_names}
+        for a in apps:
+            for k, v in self.nfa.extract_params(a).items():
+                lanes[k].append(v)
+        # chunk the pattern axis so carry + step intermediates fit HBM;
+        # every chunk shares one compiled executable (same shapes)
+        if pattern_chunk is None:
+            pattern_chunk = self._default_chunk(n_partitions, n_slots)
+        self.chunk = min(pattern_chunk, self.n_patterns)
+        if self.n_patterns % self.chunk:
+            raise SiddhiAppCreationError(
+                f"n_patterns ({self.n_patterns}) must be a multiple of "
+                f"pattern_chunk ({self.chunk})")
+        self.n_chunks = self.n_patterns // self.chunk
+        self.params = []
+        for ci in range(self.n_chunks):
+            sl = slice(ci * self.chunk, (ci + 1) * self.chunk)
+            self.params.append({k: jnp.asarray(v[sl], jnp.float32)
+                                for k, v in lanes.items()})
+        self.carries = [make_bank_carry(self.nfa.spec, self.chunk,
+                                        n_partitions)
+                        for _ in range(self.n_chunks)]
+        self._step = jax.jit(build_bank_step(self.nfa.spec),
+                             donate_argnums=0)
+        self.base_ts: Optional[int] = None
+
+    def _default_chunk(self, n_partitions: int, n_slots: int) -> int:
+        spec = self.nfa.spec
+        # carry bytes × ~16 for scan/vmap intermediates (measured on v5e:
+        # N=1000 P=10k K=8 S=2 C=1 wants ~22G)
+        bytes_per_pattern = n_partitions * n_slots * (
+            4 + 4 + 4 * spec.n_states * max(spec.n_caps, 1)) * 16
+        budget = 8 << 30      # leave headroom below ~16G HBM
+        chunk = max(1, budget // max(bytes_per_pattern, 1))
+        for c in (500, 250, 200, 125, 100, 50, 25, 20, 10, 5, 4, 2, 1):
+            if c <= chunk and self.n_patterns % c == 0:
+                return c
+        return 1
+
+    def process_block(self, block) -> np.ndarray:
+        """→ per-pattern match counts for this block ([N] int32)."""
+        outs = []
+        for ci in range(self.n_chunks):
+            self.carries[ci], counts = self._step(self.carries[ci], block,
+                                                  self.params[ci])
+            outs.append(counts)
+        return jnp.concatenate(outs)

@@ -129,3 +129,40 @@ def test_sharded_step_runs_on_virtual_mesh():
     # same events through the unsharded path must match exactly
     tpu = nfa.process_events(pids, cols, ts)
     assert int(stats["matches"]) == len(tpu)
+
+
+def test_pattern_bank_counts_match_individual_runs():
+    """N parameterized NFAs stepped together == N separate compiles."""
+    import numpy as np
+    from siddhi_tpu.ops.nfa import pack_blocks
+    from siddhi_tpu.plan.nfa_compiler import CompiledPatternBank
+
+    def app_for(thr):
+        return f"""
+        define stream S (partition int, price float, kind int);
+        @info(name='q')
+        from every e1=S[kind == 0 and price > {thr}] -> e2=S[kind == 1 and price > e1.price]
+        select e1.price as p1, e2.price as p2
+        insert into Out;
+        """
+
+    thresholds = [10.0, 30.0, 50.0, 70.0, 90.0]
+    apps = [app_for(t) for t in thresholds]
+    n_partitions = 8
+    pids, prices, kind, ts = gen_events(11, 600, n_partitions)
+    cols = {"partition": pids.astype(np.float32), "price": prices,
+            "kind": kind.astype(np.float32)}
+
+    bank = CompiledPatternBank(apps, n_partitions=n_partitions, n_slots=16)
+    block = pack_blocks(pids, cols, ts, np.zeros(len(pids), np.int32),
+                        n_partitions, base_ts=int(ts[0]))
+    counts = np.asarray(bank.process_block(block))
+
+    expected = []
+    for a in apps:
+        matches = run_tpu(a, pids, prices, kind, ts, n_partitions, 16)
+        expected.append(len(matches))
+    assert counts.tolist() == expected
+    assert counts.sum() > 0
+    # higher threshold → fewer (or equal) matches
+    assert counts.tolist() == sorted(counts.tolist(), reverse=True)
