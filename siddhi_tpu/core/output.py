@@ -23,6 +23,8 @@ class OutputCallbackProcessor(Processor):
         super().__init__()
         self.events_for = events_for
         self.query_callbacks: List = []
+        self.query_name = ""          # set by QueryRuntime (debugger OUT)
+        self.app_ctx = None
 
     def _filter_for_action(self, chunk: EventChunk) -> EventChunk:
         if self.events_for == OutputEventsFor.CURRENT:
@@ -38,6 +40,10 @@ class OutputCallbackProcessor(Processor):
     def process(self, chunk: EventChunk):
         if chunk.is_empty:
             return
+        dbg = getattr(self.app_ctx, "debugger", None) if self.app_ctx \
+            else None
+        if dbg is not None:
+            dbg.check(self.query_name, dbg.OUT, chunk)
         self.notify_callbacks(chunk)
         self.emit(self._filter_for_action(chunk))
 

@@ -32,15 +32,22 @@ from .window import WindowProcessor, create_window_processor
 
 class ProcessStreamReceiver:
     """Junction entry point for a query; holds the query lock
-    (reference query/input/ProcessStreamReceiver.java)."""
+    (reference query/input/ProcessStreamReceiver.java; debugger check at the
+    IN terminal :103-106)."""
 
     def __init__(self, first: Processor, lock: threading.RLock,
-                 latency_tracker=None):
+                 latency_tracker=None, query_name: str = "",
+                 app_ctx=None):
         self.first = first
         self.lock = lock
         self.latency_tracker = latency_tracker
+        self.query_name = query_name
+        self.app_ctx = app_ctx
 
     def receive_chunk(self, chunk: EventChunk):
+        dbg = getattr(self.app_ctx, "debugger", None) if self.app_ctx else None
+        if dbg is not None:
+            dbg.check(self.query_name, dbg.IN, chunk)
         with self.lock:
             if self.latency_tracker is not None:
                 self.latency_tracker.mark_in()
@@ -116,7 +123,7 @@ class QueryRuntime:
         self._finish_chain(chain, scope, definition, factory)
         receiver = ProcessStreamReceiver(
             self._chain_head(chain), self.lock,
-            app.latency_tracker_for(self.name))
+            app.latency_tracker_for(self.name), self.name, app.app_ctx)
         if app.has_named_window(s.stream_id):
             app.named_window_of(s.stream_id).subscribe(receiver)
         else:
@@ -158,6 +165,8 @@ class QueryRuntime:
         self.rate_limiter = build_rate_limiter(q.output_rate, app.app_ctx,
                                                group_names)
         self.output_processor = self._make_output(q, factory)
+        self.output_processor.query_name = self.name
+        self.output_processor.app_ctx = app.app_ctx
 
     def _make_output(self, q: Query, factory) -> OutputCallbackProcessor:
         app = self.app_runtime

@@ -335,6 +335,9 @@ class SiddhiAppRuntime:
             t.start()
 
     def shutdown(self):
+        dbg = getattr(self.app_ctx, "debugger", None)
+        if dbg is not None:
+            dbg.detach()
         for s in self.sources:
             s.shutdown()
         for s in self.sinks:
@@ -348,6 +351,16 @@ class SiddhiAppRuntime:
         if self.app_ctx.statistics_manager:
             self.app_ctx.statistics_manager.stop_reporting()
         self._started = False
+
+    def debug(self):
+        """Start in debug mode: returns a SiddhiDebugger whose breakpoints
+        block event threads at query IN/OUT terminals (reference
+        SiddhiAppRuntime.debug :575)."""
+        from .debugger import SiddhiDebugger
+        dbg = SiddhiDebugger(self)
+        self.app_ctx.debugger = dbg
+        self.start()
+        return dbg
 
     # ------------------------------------------------------------ persistence
 

@@ -357,6 +357,79 @@ class TimeBatchWindowProcessor(WindowProcessor):
         self._emit_due(ts)
 
 
+class HopingWindowProcessor(WindowProcessor):
+    """Hopping time window: every hop(t2) emit the events of the last
+    window(t1) as CURRENT and those that slid out as EXPIRED (reference
+    HopingWindowProcessor.java — 'hoping' spelling kept for SiddhiQL
+    compatibility; `hopping` is accepted too)."""
+
+    requires_scheduler = True
+
+    def __init__(self, app_ctx, names, window_ms: int, hop_ms: int):
+        super().__init__(app_ctx, names)
+        self.window_ms = window_ms
+        self.hop_ms = hop_ms
+        self.next_emit: Optional[int] = None
+        self.last_emitted: Optional[EventChunk] = None
+
+    def on_data(self, chunk: EventChunk):
+        now = int(chunk.timestamps[-1])
+        if self.next_emit is None:
+            self.next_emit = int(chunk.timestamps[0]) + self.hop_ms
+            self.app_ctx.scheduler.notify_at(self.next_emit, self._on_timer)
+        self._emit_due(now)
+        self._buf_append(chunk)
+
+    def _emit_due(self, now: int):
+        while self.next_emit is not None and now >= self.next_emit:
+            self._hop(self.next_emit)
+            self.next_emit += self.hop_ms
+
+    def _hop(self, ts: int):
+        # window contents at this hop = events with ts in (ts - window, ts]
+        outs = []
+        if self.buffer is not None and not self.buffer.is_empty:
+            keep = self.buffer.timestamps > ts - self.window_ms
+            self.buffer = self.buffer.mask(keep)
+        current = self.buffer
+        if self.last_emitted is not None and not self.last_emitted.is_empty:
+            gone = self.last_emitted.timestamps <= ts - self.window_ms
+            expired = self.last_emitted.mask(gone)
+            if not expired.is_empty:
+                outs.append(expired.with_types(EXPIRED).with_timestamps(
+                    np.full(len(expired), ts, np.int64)))
+        if current is not None and not current.is_empty:
+            outs.append(_reset_row(current, ts))
+            outs.append(current.with_types(CURRENT))
+        self.last_emitted = current.copy() if current is not None else None
+        if outs:
+            self.send_next(EventChunk.concat(outs))
+
+    def _on_timer(self, now: int):
+        def run():
+            self._emit_due(now)
+            if self.next_emit is not None:
+                self.app_ctx.scheduler.notify_at(self.next_emit,
+                                                 self._on_timer)
+        self._locked(run)
+
+    def on_timer_event(self, ts: int):
+        self._emit_due(ts)
+
+    def current_state(self):
+        s = super().current_state()
+        s["next_emit"] = self.next_emit
+        s["last_emitted"] = (_chunk_state(self.last_emitted)
+                             if self.last_emitted is not None else None)
+        return s
+
+    def restore_state(self, state):
+        super().restore_state(state)
+        self.next_emit = state.get("next_emit")
+        le = state.get("last_emitted")
+        self.last_emitted = _chunk_restore(le, self.names) if le else None
+
+
 class ExternalTimeBatchWindowProcessor(WindowProcessor):
     """Tumbling externalTimeBatch(ts_attr, t [, start])
     (reference ExternalTimeBatchWindowProcessor.java)."""
@@ -861,6 +934,8 @@ def create_window_processor(name: str, params: List, app_ctx, names,
         key_exprs = [compile_expr(p) for p in params[2:]]
         return LossyFrequentWindowProcessor(app_ctx, names, support, error,
                                             key_exprs)
+    if low in ("hoping", "hopping"):
+        return HopingWindowProcessor(app_ctx, names, time_ms(0), time_ms(1))
     if low == "delay":
         return DelayWindowProcessor(app_ctx, names, time_ms(0))
     if low == "cron":

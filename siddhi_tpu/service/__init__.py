@@ -1,0 +1,4 @@
+"""REST service layer (reference: modules/siddhi-service)."""
+from .rest import SiddhiService
+
+__all__ = ["SiddhiService"]

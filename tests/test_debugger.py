@@ -1,0 +1,63 @@
+"""Debugger tests (reference model: siddhi-core debugger/TestDebugger)."""
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+APP = """
+define stream S (symbol string, price float);
+@info(name='q1') from S[price > 10] select symbol, price insert into Out;
+"""
+
+
+def test_breakpoint_in_and_out_and_state():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(APP)
+    hits = []
+
+    def cb(events, query, terminal, dbg):
+        hits.append((query, terminal, [e.data for e in events]))
+        dbg.play()  # synchronous resume
+
+    dbg = rt.debug()
+    dbg.set_debugger_callback(cb)
+    dbg.acquire_break_point("q1", dbg.IN)
+    dbg.acquire_break_point("q1", dbg.OUT)
+    got = []
+    rt.add_callback("Out", StreamCallback(lambda evs: got.extend(evs)))
+    rt.get_input_handler("S").send(["IBM", 50.0])
+    rt.shutdown()
+    assert ("q1", "IN", [["IBM", 50.0]]) in hits
+    assert ("q1", "OUT", [["IBM", 50.0]]) in hits
+    assert len(got) == 1
+
+
+def test_next_steps_to_following_terminal():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(APP)
+    hits = []
+
+    def cb(events, query, terminal, dbg):
+        hits.append(terminal)
+        if len(hits) == 1:
+            dbg.next()     # step: must stop again at OUT
+        else:
+            dbg.play()
+
+    dbg = rt.debug()
+    dbg.set_debugger_callback(cb)
+    dbg.acquire_break_point("q1", dbg.IN)
+    rt.get_input_handler("S").send(["IBM", 50.0])
+    rt.shutdown()
+    assert hits == ["IN", "OUT"]
+
+
+def test_get_query_state():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, price float);
+        @info(name='q1') from S select symbol, sum(price) as t
+        group by symbol insert into Out;
+    """)
+    dbg = rt.debug()
+    rt.get_input_handler("S").send(["IBM", 5.0])
+    state = dbg.get_query_state("q1")
+    assert any("selector" in k for k in state)
+    rt.shutdown()

@@ -1,0 +1,29 @@
+"""Hopping window behaviour (reference HopingWindowProcessor)."""
+from siddhi_tpu import QueryCallback, SiddhiManager
+
+
+def test_hoping_window_emits_on_hops():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:playback
+        define stream S (v int);
+        @info(name='q')
+        from S#window.hoping(2 sec, 1 sec) select v
+        insert all events into Out;
+    """)
+    currents, expireds = [], []
+    rt.add_callback("q", QueryCallback(lambda ts, cur, exp: (
+        currents.extend(e.data[0] for e in (cur or [])),
+        expireds.extend(e.data[0] for e in (exp or [])))))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([1], timestamp=1000)
+    h.send([2], timestamp=1500)
+    h.send([3], timestamp=2100)    # hop at 2000 emits current [1, 2]
+    h.send([4], timestamp=3200)    # hop at 3000: current [2, 3], 1 expired
+    rt.app_ctx.timestamp_generator.observe_event_time(4200)
+    rt.app_ctx.scheduler.advance_to(4200)  # hop at 4000: 2 expired
+    rt.shutdown()
+    assert currents[:2] == [1, 2]
+    assert 3 in currents
+    assert 1 in expireds and 2 in expireds

@@ -1,0 +1,55 @@
+"""REST service tests (reference model: siddhi-service deploy/undeploy API)."""
+import json
+import urllib.request
+
+from siddhi_tpu.service import SiddhiService
+
+APP = """
+@app:name('restapp')
+define stream S (symbol string, price float);
+@info(name='q1') from S[price > 10] select symbol, price insert into Out;
+"""
+
+
+def _req(method, url, body=None):
+    data = body.encode() if isinstance(body, str) else (
+        json.dumps(body).encode() if body is not None else None)
+    req = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_deploy_send_query_undeploy():
+    svc = SiddhiService(port=0).start()
+    base = f"http://127.0.0.1:{svc.port}"
+    try:
+        out = _req("POST", f"{base}/siddhi/artifact/deploy", APP)
+        assert out == {"status": "deployed", "app": "restapp"}
+        assert _req("GET", f"{base}/siddhi/apps")["apps"] == ["restapp"]
+        _req("POST", f"{base}/siddhi/apps/restapp/streams/S",
+             [{"data": ["IBM", 50.0]}, {"data": ["X", 5.0]}])
+        assert _req("GET", f"{base}/health") == {"status": "up"}
+        out = _req("GET", f"{base}/siddhi/artifact/undeploy/restapp")
+        assert out["status"] == "undeployed"
+        assert _req("GET", f"{base}/siddhi/apps")["apps"] == []
+    finally:
+        svc.stop()
+
+
+def test_store_query_over_http():
+    svc = SiddhiService(port=0).start()
+    base = f"http://127.0.0.1:{svc.port}"
+    try:
+        _req("POST", f"{base}/siddhi/artifact/deploy", """
+            @app:name('tapp')
+            define stream S (symbol string, price float);
+            define table T (symbol string, price float);
+            from S insert into T;
+        """)
+        _req("POST", f"{base}/siddhi/apps/tapp/streams/S",
+             [{"data": ["IBM", 42.0]}])
+        out = _req("POST", f"{base}/siddhi/apps/tapp/query",
+                   "from T select symbol, price")
+        assert out["events"][0]["data"] == ["IBM", 42.0]
+    finally:
+        svc.stop()
