@@ -84,7 +84,13 @@ class SiddhiAppRuntime:
                  app_string: Optional[str] = None):
         self.app = app
         self.siddhi_context = siddhi_context
-        name = app.name or f"app_{id(app) & 0xffffff:x}"
+        name = app.name
+        if name is None:
+            # stable content-derived default so persistence revisions of an
+            # unnamed app resolve across restarts
+            import hashlib
+            basis = app_string if app_string else repr(app)
+            name = "app_" + hashlib.sha1(basis.encode()).hexdigest()[:8]
         self.name = name
         self.app_ctx = SiddhiAppContext(siddhi_context, name)
         self.app_ctx.runtime = self
@@ -371,8 +377,9 @@ class SiddhiAppRuntime:
                 "No persistence store set on SiddhiManager")
         return store
 
-    def persist(self) -> str:
-        return self.snapshot_service.persist(self.name, self._store())
+    def persist(self, incremental: bool = False) -> str:
+        return self.snapshot_service.persist(self.name, self._store(),
+                                             incremental=incremental)
 
     def restore_revision(self, revision: str):
         self.snapshot_service.restore_revision(self.name, self._store(),
@@ -445,9 +452,10 @@ class SiddhiManager:
 
     def create_siddhi_app_runtime(
             self, app: Union[str, SiddhiApp]) -> SiddhiAppRuntime:
+        app_string = app if isinstance(app, str) else None
         if isinstance(app, str):
             app = SiddhiCompiler.parse(app)
-        rt = SiddhiAppRuntime(app, self.siddhi_context)
+        rt = SiddhiAppRuntime(app, self.siddhi_context, app_string)
         self.runtimes[rt.name] = rt
         return rt
 

@@ -27,6 +27,9 @@ class PersistenceStore:
     def last_revision(self, app_name: str) -> Optional[str]:
         raise NotImplementedError
 
+    def revisions(self, app_name: str) -> list:
+        raise NotImplementedError
+
     def clear_all_revisions(self, app_name: str):
         raise NotImplementedError
 
@@ -44,6 +47,9 @@ class InMemoryPersistenceStore(PersistenceStore):
     def last_revision(self, app_name):
         revs = sorted(self._data.get(app_name, {}).keys())
         return revs[-1] if revs else None
+
+    def revisions(self, app_name):
+        return sorted(self._data.get(app_name, {}).keys())
 
     def clear_all_revisions(self, app_name):
         self._data.pop(app_name, None)
@@ -73,6 +79,9 @@ class FileSystemPersistenceStore(PersistenceStore):
         revs = sorted(os.listdir(self._dir(app_name)))
         return revs[-1] if revs else None
 
+    def revisions(self, app_name):
+        return sorted(os.listdir(self._dir(app_name)))
+
     def clear_all_revisions(self, app_name):
         d = self._dir(app_name)
         for f in os.listdir(d):
@@ -86,6 +95,11 @@ class SnapshotService:
         self.app_ctx = app_ctx
         self._elements: Dict[str, object] = {}
         self._lock = threading.Lock()
+        # incremental bookkeeping: per-element digest of the last persisted
+        # state (reference separates incrementalSnapshotable op-logs from
+        # periodic base state, SnapshotService.java:159-205; a content
+        # digest over the columnar state plays the role of the op-log)
+        self._last_digest: Dict[str, bytes] = {}
 
     def register(self, element_id: str, element):
         self._elements[element_id] = element
@@ -122,11 +136,51 @@ class SnapshotService:
         finally:
             barrier.unlock()
 
+    def incremental_snapshot(self) -> bytes:
+        """Only elements whose state changed since the last persisted
+        snapshot (full or incremental)."""
+        import hashlib
+        barrier = self.app_ctx.thread_barrier
+        barrier.lock()
+        try:
+            changed = {}
+            for eid, el in self._elements.items():
+                s = el.current_state()
+                if s is None:
+                    continue
+                blob = pickle.dumps(s, protocol=pickle.HIGHEST_PROTOCOL)
+                digest = hashlib.sha256(blob).digest()
+                if self._last_digest.get(eid) != digest:
+                    changed[eid] = s
+                    self._last_digest[eid] = digest
+            return pickle.dumps({"__incremental__": True, "state": changed},
+                                protocol=pickle.HIGHEST_PROTOCOL)
+        finally:
+            barrier.unlock()
+
+    def _mark_digests(self, snapshot: bytes):
+        import hashlib
+        state = pickle.loads(snapshot)
+        for eid, s in state.items():
+            blob = pickle.dumps(s, protocol=pickle.HIGHEST_PROTOCOL)
+            self._last_digest[eid] = hashlib.sha256(blob).digest()
+
     # ------------------------------------------------------------ revisions
 
-    def persist(self, app_name: str, store: PersistenceStore) -> str:
-        revision = f"{int(time.time() * 1000)}_{app_name}"
-        store.save(app_name, revision, self.full_snapshot())
+    def persist(self, app_name: str, store: PersistenceStore,
+                incremental: bool = False) -> str:
+        """Full revisions end `_full`; incremental deltas end `_inc` and are
+        replayed on top of the latest full base at restore (reference
+        IncrementalFileSystemPersistenceStore revision chains)."""
+        now = int(time.time() * 1000)
+        if incremental and self._last_digest:
+            revision = f"{now}_{app_name}_inc"
+            store.save(app_name, revision, self.incremental_snapshot())
+        else:
+            revision = f"{now}_{app_name}_full"
+            snap = self.full_snapshot()
+            self._mark_digests(snap)
+            store.save(app_name, revision, snap)
         return revision
 
     def restore_revision(self, app_name: str, store: PersistenceStore,
@@ -135,7 +189,36 @@ class SnapshotService:
         snap = store.load(app_name, revision)
         if snap is None:
             raise CannotRestoreStateError(f"No revision {revision}")
-        self.restore(snap)
+        state = pickle.loads(snap)
+        if isinstance(state, dict) and state.get("__incremental__"):
+            # replay: latest full base before this revision, then every
+            # increment up to and including it
+            revisions = sorted(r for r in store.revisions(app_name)
+                               if r <= revision)
+            base = None
+            for r in revisions:
+                if r.endswith("_full"):
+                    base = r
+            chain = [r for r in revisions
+                     if base is None or r >= base]
+            barrier = self.app_ctx.thread_barrier
+            barrier.lock()
+            try:
+                for r in chain:
+                    blob = store.load(app_name, r)
+                    if blob is None:
+                        continue
+                    st = pickle.loads(blob)
+                    if isinstance(st, dict) and st.get("__incremental__"):
+                        st = st["state"]
+                    for eid, s in st.items():
+                        el = self._elements.get(eid)
+                        if el is not None:
+                            el.restore_state(s)
+            finally:
+                barrier.unlock()
+        else:
+            self.restore(snap)
 
     def restore_last_revision(self, app_name: str,
                               store: PersistenceStore) -> Optional[str]:

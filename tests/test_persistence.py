@@ -1,0 +1,75 @@
+"""Persistence tests (reference model: managment/PersistenceTestCase and
+IncrementalPersistenceTestCase — persist → new runtime → restore → state
+continues)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.snapshot import (FileSystemPersistenceStore,
+                                      InMemoryPersistenceStore)
+
+APP = """
+define stream S (symbol string, price float);
+from S select symbol, sum(price) as total group by symbol insert into Out;
+"""
+
+
+def _fresh(store):
+    m = SiddhiManager()
+    m.set_persistence_store(store)
+    rt = m.create_siddhi_app_runtime(APP)
+    got = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: got.extend(e.data for e in evs)))
+    rt.start()
+    return m, rt, got
+
+
+def test_full_persist_restore_roundtrip():
+    store = InMemoryPersistenceStore()
+    m, rt, _ = _fresh(store)
+    rt.get_input_handler("S").send(["IBM", 10.0])
+    rt.get_input_handler("S").send(["IBM", 15.0])
+    rev = rt.persist()
+    assert rev.endswith("_full")
+    rt.shutdown()
+
+    m2, rt2, got = _fresh(store)
+    rt2.restore_last_revision()
+    rt2.get_input_handler("S").send(["IBM", 5.0])
+    rt2.shutdown()
+    assert got == [["IBM", pytest.approx(30.0)]]
+
+
+def test_incremental_chain_restore(tmp_path):
+    store = FileSystemPersistenceStore(str(tmp_path))
+    m, rt, _ = _fresh(store)
+    h = rt.get_input_handler("S")
+    h.send(["IBM", 10.0])
+    base = rt.persist()                      # full base
+    h.send(["IBM", 5.0])
+    inc1 = rt.persist(incremental=True)
+    assert inc1.endswith("_inc")
+    h.send(["WSO2", 7.0])
+    inc2 = rt.persist(incremental=True)
+    rt.shutdown()
+
+    m2, rt2, got = _fresh(store)
+    rt2.restore_last_revision()              # base + inc1 + inc2 replay
+    rt2.get_input_handler("S").send(["IBM", 1.0])
+    rt2.get_input_handler("S").send(["WSO2", 1.0])
+    rt2.shutdown()
+    assert got == [["IBM", pytest.approx(16.0)],
+                   ["WSO2", pytest.approx(8.0)]]
+
+
+def test_incremental_skips_unchanged_elements():
+    store = InMemoryPersistenceStore()
+    m, rt, _ = _fresh(store)
+    rt.get_input_handler("S").send(["IBM", 10.0])
+    rt.persist()
+    rev = rt.persist(incremental=True)       # nothing changed since full
+    import pickle
+    payload = pickle.loads(store.load(rt.name, rev))
+    assert payload["__incremental__"] is True
+    assert payload["state"] == {}
+    rt.shutdown()
