@@ -1,0 +1,172 @@
+"""Sliding length-window aggregation kernel (BASELINE config 2 path).
+
+Replaces the reference's per-event window buffer mutation + per-key
+aggregator map lookups (query/processor/stream/window/LengthWindowProcessor
+.java + QuerySelector.java:171 — linked-list buffer, HashMap of aggregator
+objects per group key) with a dense formulation:
+
+    ring   [P, W]  — last W accepted values per partition/group lane
+    state  pos/cnt/runsum [P]
+    step: evict-one + append-one via a one-hot over W, runsum updated
+          incrementally; scan over the block's T events, lanes vectorised.
+
+Two implementations with identical semantics:
+  - `build_wagg_step`        — pure jax.numpy (runs everywhere; conformance
+                               reference and CPU-backend path)
+  - `build_wagg_step_pallas` — Pallas TPU kernel: the ring tile stays
+                               resident in VMEM across the whole event loop
+                               instead of round-tripping HBM per scan step;
+                               lanes ride the 128-wide vector dimension.
+
+Filter + value projection are evaluated OUTSIDE the kernel by the shared
+expression compiler (plan/expr_compiler with xp=jnp) — the kernel consumes
+(values, accepted) lanes, so any SiddhiQL filter works on both paths.
+"""
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class WaggCarry(NamedTuple):
+    ring: jnp.ndarray      # [P, W] f32
+    pos: jnp.ndarray       # [P] i32 — next write slot
+    cnt: jnp.ndarray       # [P] i32 — entries held (≤ W)
+    runsum: jnp.ndarray    # [P] f32
+
+
+def make_wagg_carry(n_partitions: int, window: int) -> WaggCarry:
+    return WaggCarry(
+        ring=jnp.zeros((n_partitions, window), jnp.float32),
+        pos=jnp.zeros((n_partitions,), jnp.int32),
+        cnt=jnp.zeros((n_partitions,), jnp.int32),
+        runsum=jnp.zeros((n_partitions,), jnp.float32))
+
+
+# ------------------------------------------------------------------ jnp path
+
+def build_wagg_step(window: int):
+    """fn(carry, values [P,T], accepted [P,T]) →
+    (carry, (sums [P,T], counts [P,T]))  — running aggregate after each
+    accepted event (positions with accepted=False repeat the previous)."""
+
+    def lane_step(carry, xs):
+        ring, pos, cnt, runsum = carry
+        x, ok = xs
+        oh = jnp.arange(window) == pos            # [W]
+        old = jnp.sum(ring * oh)
+        evict = cnt == window
+        delta = x - jnp.where(evict, old, 0.0)
+        runsum2 = jnp.where(ok, runsum + delta, runsum)
+        ring2 = jnp.where(ok & oh, x, ring)
+        pos2 = jnp.where(ok, (pos + 1) % window, pos)
+        cnt2 = jnp.where(ok, jnp.minimum(cnt + 1, window), cnt)
+        return (ring2, pos2, cnt2, runsum2), (runsum2, cnt2)
+
+    def per_lane(carry_l, values_l, ok_l):
+        return jax.lax.scan(lane_step, carry_l, (values_l, ok_l))
+
+    def step(carry: WaggCarry, values, accepted):
+        (ring, pos, cnt, runsum), (sums, counts) = jax.vmap(per_lane)(
+            tuple(carry), values, accepted)
+        return WaggCarry(ring, pos, cnt, runsum), (sums, counts)
+
+    return step
+
+
+# --------------------------------------------------------------- pallas path
+
+LANES = 128
+
+
+def build_wagg_step_pallas(window: int, t_per_block: int):
+    """Same contract as build_wagg_step, lowered to one Pallas kernel.
+
+    Layout: partition lanes ride the last (128-wide) dim; the grid walks
+    P/128 tiles; each program keeps its (W, 128) ring tile in VMEM for the
+    whole T loop."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    W, T = window, t_per_block
+
+    def kernel(values_ref, ok_ref, ring_in, pos_in, cnt_in, sum_in,
+               ring_out, pos_out, cnt_out, sum_out, sums_ref, counts_ref):
+        # refs carry a leading block dim of 1 (one tile per program)
+        ring = ring_in[0, :, :]                  # (W, 128)
+        pos = pos_in[0, 0, :]                    # (128,)
+        cnt = cnt_in[0, 0, :]
+        runsum = sum_in[0, 0, :]
+        iota_w = jax.lax.broadcasted_iota(jnp.int32, (W, LANES), 0)
+        for t in range(T):                       # static unroll over events
+            x = values_ref[0, t, :]
+            ok = ok_ref[0, t, :] != 0
+            oh = iota_w == pos[None, :]
+            old = jnp.sum(jnp.where(oh, ring, 0.0), axis=0)
+            evict = cnt == W
+            delta = x - jnp.where(evict, old, 0.0)
+            runsum = jnp.where(ok, runsum + delta, runsum)
+            ring = jnp.where(oh & ok[None, :], x[None, :], ring)
+            pos = jnp.where(ok, (pos + 1) % W, pos)
+            cnt = jnp.where(ok, jnp.minimum(cnt + 1, W), cnt)
+            sums_ref[0, t, :] = runsum
+            counts_ref[0, t, :] = cnt
+        ring_out[0, :, :] = ring
+        pos_out[0, 0, :] = pos
+        cnt_out[0, 0, :] = cnt
+        sum_out[0, 0, :] = runsum
+
+    def step(carry: WaggCarry, values, accepted):
+        P = carry.ring.shape[0]
+        assert P % LANES == 0, f"partitions must be a multiple of {LANES}"
+        tiles = P // LANES
+        # lanes-last layout: [tiles, T|W, 128]
+        vals = values.reshape(tiles, LANES, -1).transpose(0, 2, 1)
+        ok = accepted.astype(jnp.int32).reshape(tiles, LANES, -1) \
+            .transpose(0, 2, 1)
+        ring = carry.ring.reshape(tiles, LANES, W).transpose(0, 2, 1)
+        pos = carry.pos.reshape(tiles, 1, LANES)
+        cnt = carry.cnt.reshape(tiles, 1, LANES)
+        rs = carry.runsum.reshape(tiles, 1, LANES)
+
+        grid = (tiles,)
+
+        def tile_spec(shape):
+            return pl.BlockSpec((1,) + shape,
+                                lambda i: (i,) + (0,) * len(shape),
+                                memory_space=pltpu.VMEM)
+
+        out_shape = [
+            jax.ShapeDtypeStruct(ring.shape, jnp.float32),   # ring'
+            jax.ShapeDtypeStruct(pos.shape, jnp.int32),
+            jax.ShapeDtypeStruct(cnt.shape, jnp.int32),
+            jax.ShapeDtypeStruct(rs.shape, jnp.float32),
+            jax.ShapeDtypeStruct(vals.shape, jnp.float32),   # sums
+            jax.ShapeDtypeStruct(ok.shape, jnp.int32),       # counts
+        ]
+
+        ring2, pos2, cnt2, rs2, sums, counts = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[tile_spec((T, LANES)), tile_spec((T, LANES)),
+                      tile_spec((W, LANES)), tile_spec((1, LANES)),
+                      tile_spec((1, LANES)), tile_spec((1, LANES))],
+            out_specs=[tile_spec((W, LANES)), tile_spec((1, LANES)),
+                       tile_spec((1, LANES)), tile_spec((1, LANES)),
+                       tile_spec((T, LANES)), tile_spec((T, LANES))],
+            out_shape=out_shape,
+            input_output_aliases={2: 0, 3: 1, 4: 2, 5: 3},
+        )(vals, ok, ring, pos, cnt, rs)
+
+        new_carry = WaggCarry(
+            ring=ring2.transpose(0, 2, 1).reshape(P, W),
+            pos=pos2.reshape(P), cnt=cnt2.reshape(P),
+            runsum=rs2.reshape(P))
+        sums_pt = sums.transpose(0, 2, 1).reshape(P, -1)
+        counts_pt = counts.transpose(0, 2, 1).reshape(P, -1)
+        return new_carry, (sums_pt, counts_pt)
+
+    return step

@@ -1,0 +1,131 @@
+"""Windowed-aggregation query → TPU kernel (BASELINE config 2 path).
+
+Lowers `from S[filter]#window.length(W) select sum(x)/count()/avg(x) group by
+<partition key>` into ops/windowed_agg: the filter and the aggregated value
+expression compile once through the shared expression compiler under
+jax.numpy and run as one fused [P, T] program; the stateful sliding-window
+update runs as the Pallas ring kernel on TPU (jnp scan elsewhere).
+
+The group-by key is the partition axis — the same key→lane mapping the NFA
+path and the reference's per-key partitioning use (SURVEY.md §2.8)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compiler import SiddhiCompiler
+from ..query_api import Filter, Query, SingleInputStream, WindowHandler
+from ..query_api.expression import AttributeFunction, Constant, Variable
+from ..utils.errors import SiddhiAppCreationError
+from .expr_compiler import EvalCtx, ExprCompiler, Scope
+from ..ops.windowed_agg import (LANES, WaggCarry, build_wagg_step,
+                                build_wagg_step_pallas, make_wagg_carry)
+
+_AGGS = {"sum", "count", "avg"}
+
+
+class CompiledWindowedAgg:
+    """One length-window aggregation query over P group/partition lanes."""
+
+    def __init__(self, app_string: str, n_partitions: int,
+                 t_per_block: int = 16, query_name: Optional[str] = None,
+                 use_pallas: Optional[bool] = None):
+        app = SiddhiCompiler.parse(app_string)
+        query = None
+        for el in app.execution_elements:
+            if isinstance(el, Query) and (query_name is None or
+                                          el.name == query_name):
+                query = el
+                break
+        if query is None:
+            raise SiddhiAppCreationError(f"No query '{query_name}'")
+        s = query.input_stream
+        if not isinstance(s, SingleInputStream):
+            raise SiddhiAppCreationError(
+                "windowed-agg path needs a single input stream")
+        wh = s.window_handler
+        if wh is None or wh.name.lower() != "length":
+            raise SiddhiAppCreationError(
+                "windowed-agg path needs #window.length(n)")
+        self.window = int(wh.params[0].value)
+        definition = app.stream_definitions[s.stream_id]
+
+        scope = Scope()
+        scope.add_primary(s.stream_id, s.stream_ref, definition)
+        compiler = ExprCompiler(scope, jnp)
+        filters = [compiler.compile(h.expr) for h in s.handlers
+                   if isinstance(h, Filter)]
+        self.filters = filters
+
+        # outputs: aggregates of ONE value expression + key passthroughs
+        self.outputs: List[Tuple[str, str]] = []   # (name, sum|count|avg)
+        value_expr = None
+        for oa in query.selector.attributes:
+            e = oa.expr
+            if isinstance(e, AttributeFunction) and e.name.lower() in _AGGS:
+                fname = e.name.lower()
+                if e.args:
+                    ce = compiler.compile(e.args[0])
+                    if value_expr is None:
+                        value_expr = ce
+                self.outputs.append((oa.rename, fname))
+            elif isinstance(e, Variable):
+                self.outputs.append((oa.rename, "key"))
+            else:
+                raise SiddhiAppCreationError(
+                    "windowed-agg select supports sum/count/avg of one "
+                    "expression plus key attributes")
+        self.value = value_expr
+        self.n_partitions = n_partitions
+        self.t_per_block = t_per_block
+        if use_pallas is None:
+            use_pallas = jax.devices()[0].platform == "tpu" and \
+                n_partitions % LANES == 0
+        step = (build_wagg_step_pallas(self.window, t_per_block)
+                if use_pallas else build_wagg_step(self.window))
+        self.use_pallas = use_pallas
+
+        def full_step(carry: WaggCarry, block: Dict[str, jnp.ndarray]):
+            # filter + projection: one fused elementwise program over [P, T]
+            n = block["__ts"].size
+            cols = {k: v.reshape(-1) for k, v in block.items()
+                    if not k.startswith("__")}
+            ctx = EvalCtx(cols, block["__ts"].reshape(-1), n)
+            ok = block["__valid"].reshape(-1)
+            for f in self.filters:
+                m = f.fn(ctx)
+                ok = ok & jnp.broadcast_to(jnp.asarray(m, bool), ok.shape)
+            vals = (jnp.broadcast_to(
+                jnp.asarray(self.value.fn(ctx), jnp.float32), ok.shape)
+                if self.value is not None else jnp.zeros(ok.shape,
+                                                         jnp.float32))
+            shape = block["__ts"].shape
+            return step(carry, vals.reshape(shape), ok.reshape(shape))
+
+        self._step = jax.jit(full_step, donate_argnums=0)
+        self.carry = make_wagg_carry(n_partitions, self.window)
+
+    def process_block(self, block):
+        """block: [P, T] packed lanes (ops.nfa.pack_blocks) →
+        (sums [P, T], counts [P, T]) running aggregates."""
+        self.carry, (sums, counts) = self._step(self.carry, block)
+        return sums, counts
+
+    def current_aggregates(self) -> Dict[str, np.ndarray]:
+        """Per-lane aggregate values right now."""
+        s = np.asarray(self.carry.runsum)
+        c = np.asarray(self.carry.cnt)
+        out = {}
+        for name, kind in self.outputs:
+            if kind == "sum":
+                out[name] = s
+            elif kind == "count":
+                out[name] = c.astype(np.int64)
+            elif kind == "avg":
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    out[name] = np.where(c > 0, s / np.maximum(c, 1),
+                                         np.nan)
+        return out
