@@ -143,6 +143,52 @@ class AggregationRuntime:
 
         junction = app_runtime.junction_of(self.stream_id)
         junction.subscribe(self)
+        self._setup_purging()
+
+    # ------------------------------------------------------------ purging
+
+    _DEFAULT_RETENTION = {"sec": 120_000, "min": 86_400_000,
+                          "hour": 30 * 86_400_000, "day": 365 * 86_400_000,
+                          "month": None, "year": None}   # None = keep all
+
+    def _setup_purging(self):
+        """@purge(enable, interval, @retentionPeriod(sec=..., min=...))
+        (reference aggregation/IncrementalDataPurging.java)."""
+        from ..query_api import find_annotation
+        ann = find_annotation(self.ad.annotations, "purge")
+        if ann is None or str(ann.get("enable", "true")).lower() != "true":
+            self.retention = None
+            return
+        from .runtime import _parse_time_str
+        interval = _parse_time_str(ann.get("interval", "15 min"))
+        self.retention = dict(self._DEFAULT_RETENTION)
+        rp = find_annotation(ann.annotations, "retentionperiod") or \
+            find_annotation(ann.annotations, "retentionPeriod")
+        if rp is not None:
+            for k, v in rp.as_dict().items():
+                kk = k.lower().rstrip("s")
+                if kk in self.retention:
+                    self.retention[kk] = (None if str(v).lower() == "all"
+                                          else _parse_time_str(v))
+        ctx = self.app.app_ctx
+
+        def fire(now):
+            self.purge(now)
+            ctx.scheduler.notify_at(now + interval, fire)
+        ctx.scheduler.notify_at(
+            ctx.timestamp_generator.current_time() + interval, fire)
+
+    def purge(self, now: int):
+        if self.retention is None:
+            return
+        for dur in self.durations:
+            keep_ms = self.retention.get(dur)
+            if keep_ms is None:
+                continue
+            store = self.buckets[dur]
+            cutoff = now - keep_ms
+            for b in [b for b in store if b[0] < cutoff]:
+                del store[b]
 
     # ------------------------------------------------------------ ingestion
 

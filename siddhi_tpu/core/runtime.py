@@ -475,6 +475,19 @@ class SiddhiManager:
     def set_persistence_store(self, store: PersistenceStore):
         self.siddhi_context.persistence_store = store
 
+    def set_config_manager(self, config_manager):
+        """System-parameter source for extensions (reference
+        SiddhiManager.setConfigManager, util/config/)."""
+        self.siddhi_context.config_manager = config_manager
+
+    def set_source_handler_manager(self, manager):
+        """HA hook factory for sources (reference SourceHandlerManager)."""
+        self.siddhi_context.source_handler_manager = manager
+
+    def set_sink_handler_manager(self, manager):
+        """HA hook factory for sinks (reference SinkHandlerManager)."""
+        self.siddhi_context.sink_handler_manager = manager
+
     def persist(self):
         for rt in self.runtimes.values():
             rt.persist()

@@ -75,7 +75,10 @@ class PassThroughSourceMapper(SourceMapper):
         if isinstance(obj, (list, tuple)):
             if obj and isinstance(obj[0], Event):
                 return list(obj)
-            return [Event(int(time.time() * 1000), list(obj))]
+            now = int(time.time() * 1000)
+            if obj and isinstance(obj[0], (list, tuple)):
+                return [Event(now, list(r)) for r in obj]   # batch of rows
+            return [Event(now, list(obj))]
         raise MappingFailedError(f"passThrough cannot map {type(obj)}")
 
 
@@ -133,6 +136,37 @@ SINK_MAPPERS = {"passthrough": PassThroughSinkMapper,
 
 # ===================================================================== source
 
+class SourceHandler:
+    """HA hook between a source and its input handler: an outer platform
+    subclasses this to gate events on passive nodes (reference
+    stream/input/source/SourceHandler.java + SourceHandlerManager — the
+    active/passive coordination SPI)."""
+
+    def handle(self, events):
+        """Return the events to forward (possibly filtered), or None to
+        drop (passive node)."""
+        return events
+
+
+class SinkHandler:
+    """HA hook before a sink publishes (reference
+    stream/output/sink/SinkHandler.java)."""
+
+    def handle(self, payload, event):
+        """Return the payload to publish, or None to suppress."""
+        return payload
+
+
+class SourceHandlerManager:
+    def generate_source_handler(self, source) -> SourceHandler:
+        return SourceHandler()
+
+
+class SinkHandlerManager:
+    def generate_sink_handler(self, sink) -> SinkHandler:
+        return SinkHandler()
+
+
 class Source:
     """Base source with connect-retry lifecycle
     (reference Source.connectWithRetry:128-157 + BackoffRetryCounter)."""
@@ -177,6 +211,9 @@ class Source:
         except MappingFailedError as e:
             log.error("mapping failed on %s: %s", self.stream_def.id, e)
             return
+        handler = getattr(self, "handler", None)
+        if handler is not None and events:
+            events = handler.handle(events)
         if events:
             self.input_handler.send(events)
 
@@ -269,6 +306,11 @@ class Sink:
                    for v in self.options.values())
 
     def _publish_with_retry(self, payload, event):
+        handler = getattr(self, "handler", None)
+        if handler is not None:
+            payload = handler.handle(payload, event)
+            if payload is None:
+                return
         for i, delay in enumerate(self.RETRIES):
             if delay:
                 time.sleep(delay)
@@ -368,12 +410,19 @@ class DistributedSink(Sink):
 
 def attach_sources_and_sinks(app_runtime):
     """Scan stream definitions for @source/@sink annotations."""
+    ctx = app_runtime.siddhi_context
+    shm = getattr(ctx, "source_handler_manager", None)
+    khm = getattr(ctx, "sink_handler_manager", None)
     for sid, d in list(app_runtime.stream_definitions.items()):
         for ann in find_all(d.annotations, "source"):
             src = _build_source(app_runtime, d, ann)
+            if shm is not None:
+                src.handler = shm.generate_source_handler(src)
             app_runtime.sources.append(src)
         for ann in find_all(d.annotations, "sink"):
             sink = _build_sink(app_runtime, d, ann)
+            if khm is not None:
+                sink.handler = khm.generate_sink_handler(sink)
             app_runtime.sinks.append(sink)
             app_runtime.junctions[sid].subscribe(sink)
 

@@ -149,3 +149,30 @@ def test_last_value_is_per_bucket():
     rows = sorted(e.data for e in events)
     assert rows == [[1496289950000, 10.0], [1496289951000, 99.0]]
     rt.shutdown()
+
+
+def test_aggregation_purge():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:playback
+        define stream T (symbol string, price double, ts long);
+        @purge(enable='true', interval='10 sec',
+               @retentionPeriod(sec='1 min', min='1 hour'))
+        define aggregation A
+        from T select symbol, sum(price) as total
+        group by symbol
+        aggregate by ts every sec ... min;
+    """)
+    rt.start()
+    agg = rt.aggregations["A"]
+    h = rt.get_input_handler("T")
+    h.send(["WSO2", 10.0, 1_000_000], timestamp=1_000_000)
+    h.send(["WSO2", 20.0, 1_200_000], timestamp=1_200_000)
+    # the scheduled purge already ran on virtual-time advance: the first
+    # sec bucket (1,000,000) fell past the 1-minute retention
+    assert len(agg.buckets["sec"]) == 1
+    assert len(agg.buckets["min"]) == 2     # minute retention = 1 hour
+    agg.purge(1_200_000 + 3_700_000)        # past the minute retention too
+    assert len(agg.buckets["sec"]) == 0
+    assert len(agg.buckets["min"]) == 0
+    rt.shutdown()

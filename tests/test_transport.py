@@ -1,0 +1,128 @@
+"""Transport & fault-tolerance tests (reference model: transport/
+InMemoryTransportTestCase + TestFailingInMemorySink/Source retry paths,
+SourceHandler/SinkHandler HA SPI)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.source_sink import (InMemoryBroker, InMemorySink,
+                                         SinkHandler, SinkHandlerManager,
+                                         SourceHandler, SourceHandlerManager)
+from siddhi_tpu.utils.errors import ConnectionUnavailableError
+
+APP = """
+@source(type='inMemory', topic='in_t', @map(type='passThrough'))
+define stream In (symbol string, price float);
+@sink(type='inMemory', topic='out_t', @map(type='passThrough'))
+define stream Out (symbol string, price float);
+from In[price > 10] select symbol, price insert into Out;
+"""
+
+
+class Collect:
+    def __init__(self):
+        self.items = []
+
+    def on_message(self, msg):
+        self.items.append(msg)
+
+
+def test_inmemory_transport_roundtrip():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(APP)
+    col = Collect()
+    col.topic = "out_t"
+    InMemoryBroker.subscribe(col)
+    rt.start()
+    InMemoryBroker.publish("in_t", [["IBM", 50.0], ["X", 5.0]])
+    rt.shutdown()
+    InMemoryBroker.unsubscribe(col)
+    assert len(col.items) == 1
+
+
+def test_failing_sink_retries_then_succeeds():
+    """Publish raises ConnectionUnavailable twice, then works (reference
+    TestFailingInMemorySink connect-retry semantics)."""
+    m = SiddhiManager()
+
+    attempts = []
+
+    class FailingSink(InMemorySink):
+        def publish(self, payload, event):
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise ConnectionUnavailableError("down")
+            super().publish(payload, event)
+
+    m.set_extension("sink:flaky", FailingSink)
+    rt = m.create_siddhi_app_runtime("""
+        define stream In (symbol string);
+        @sink(type='flaky', topic='flaky_t', @map(type='passThrough'))
+        define stream Out (symbol string);
+        from In select symbol insert into Out;
+    """)
+    col = Collect()
+    col.topic = "flaky_t"
+    InMemoryBroker.subscribe(col)
+    rt.start()
+    rt.get_input_handler("In").send(["IBM"])
+    rt.shutdown()
+    InMemoryBroker.unsubscribe(col)
+    assert len(attempts) == 3       # two failures + one success
+    assert len(col.items) == 1
+
+
+def test_sink_handler_suppresses_on_passive_node():
+    m = SiddhiManager()
+
+    class PassiveSinkHandler(SinkHandler):
+        def handle(self, payload, event):
+            return None             # passive: publish nothing
+
+    class Mgr(SinkHandlerManager):
+        def generate_sink_handler(self, sink):
+            return PassiveSinkHandler()
+
+    m.set_sink_handler_manager(Mgr())
+    rt = m.create_siddhi_app_runtime(APP)
+    col = Collect()
+    col.topic = "out_t"
+    InMemoryBroker.subscribe(col)
+    rt.start()
+    InMemoryBroker.publish("in_t", [["IBM", 50.0]])
+    rt.shutdown()
+    InMemoryBroker.unsubscribe(col)
+    assert col.items == []
+
+
+def test_source_handler_filters_events():
+    m = SiddhiManager()
+
+    class DropAll(SourceHandler):
+        def handle(self, events):
+            return None
+
+    class Mgr(SourceHandlerManager):
+        def generate_source_handler(self, source):
+            return DropAll()
+
+    m.set_source_handler_manager(Mgr())
+    rt = m.create_siddhi_app_runtime(APP)
+    got = []
+    rt.add_callback("Out", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    InMemoryBroker.publish("in_t", [["IBM", 50.0]])
+    rt.shutdown()
+    assert got == []
+
+
+def test_config_manager_reader():
+    from siddhi_tpu.utils.config import InMemoryConfigManager
+    cm = InMemoryConfigManager({"kafka.bootstrap": "b:9092",
+                                "global": "x"},
+                               {"shard.id": "3"})
+    r = cm.generate_config_reader("kafka")
+    assert r.read_config("bootstrap") == "b:9092"
+    assert r.read_config("global") == "x"
+    assert r.read_config("missing", "d") == "d"
+    assert r.get_all_configs() == {"bootstrap": "b:9092"}
+    assert cm.extract_system_configs("shard.id") == "3"
