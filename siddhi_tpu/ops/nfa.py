@@ -51,28 +51,47 @@ class NfaSpec(NamedTuple):
     cap_cols: List[List[str]]
     attr_names: List[str]             # event column order
     is_every: bool
+    # leading kleene state `A<m:n>` (reference CountPre/PostStateProcessor):
+    # one accumulator lane per partition counts condition-0 matches and
+    # spawns a slot at state 1 when min is reached; first/last capture banks
+    # serve e1[0].x / e1[last].x.  None → plain chain.
+    count0_min: Optional[int] = None
+    count0_max: Optional[int] = None
+    n_first_lanes: int = 0            # lanes 0..n-1 = first-occurrence bank
 
 
 def make_carry(spec: NfaSpec, n_partitions: int) -> Dict[str, jnp.ndarray]:
     P, K, S, C = n_partitions, spec.n_slots, spec.n_states, spec.n_caps
-    return {
+    carry = {
         "slot_state": jnp.full((P, K), -1, jnp.int32),
         "slot_start": jnp.zeros((P, K), jnp.int32),
         "captures": jnp.zeros((P, K, S, max(C, 1)), jnp.float32),
         "dropped": jnp.zeros((P,), jnp.int32),   # slot-overflow counter
     }
+    if spec.count0_min is not None:
+        carry["acc_ctr"] = jnp.zeros((P,), jnp.int32)
+        carry["acc_caps"] = jnp.zeros((P, max(C, 1)), jnp.float32)
+        carry["acc_ts"] = jnp.zeros((P,), jnp.int32)
+    if not spec.is_every:
+        carry["armed_total"] = jnp.zeros((P,), jnp.int32)
+    return carry
 
 
-def _one_partition_step(spec: NfaSpec, carry, event):
+def _one_partition_step(spec: NfaSpec, carry: Dict, event):
     """Step one partition's slot ring over one event.
 
     carry: slot_state [K], slot_start [K], captures [K, S, C], dropped []
+           (+ acc_ctr/acc_caps/acc_ts for a leading kleene state)
     event: cols dict of scalars + ts + stream_code + valid
     returns (new_carry, (match_mask [K], match_caps [K, S, C], match_ts [K]))
     """
     K = spec.n_slots
     S = spec.n_states
-    slot_state, slot_start, captures, dropped = carry
+    C = max(spec.n_caps, 1)
+    slot_state = carry["slot_state"]
+    slot_start = carry["slot_start"]
+    captures = carry["captures"]
+    dropped = carry["dropped"]
     ts = event["__ts"]
     valid = event["__valid"]
     stream = event["__stream"]
@@ -109,33 +128,65 @@ def _one_partition_step(spec: NfaSpec, carry, event):
     # completed slots free up
     new_state = jnp.where(completed, -1, new_state)
 
-    # arm a fresh partial at state 0 (reference `every` re-arm / start init):
+    # --- arming a fresh partial (reference `every` re-arm / start init) ---
     # condition 0 never reads captures, so row 0 of cond is uniform over K
-    arm = valid & (stream == spec.state_streams[0]) & cond[0, 0]
+    c0 = valid & (stream == spec.state_streams[0]) & cond[0, 0]
+    out_carry = {}
+    if spec.count0_min is None:
+        arm = c0
+        arm_caps0 = ev_caps[0]                 # [C]
+        arm_ts = ts
+    else:
+        # leading kleene accumulator (reference CountPreStateProcessor:
+        # one accumulating partial per partition; forwards at min count)
+        acc_ctr = carry["acc_ctr"]
+        acc_caps = carry["acc_caps"]
+        acc_ts = carry["acc_ts"]
+        if spec.within_ms is not None:
+            acc_dead = (acc_ctr > 0) & (ts - acc_ts > spec.within_ms)
+            acc_ctr = jnp.where(acc_dead, 0, acc_ctr)
+        ctr2 = jnp.where(c0, acc_ctr + 1, acc_ctr)
+        fresh = c0 & (ctr2 == 1)
+        lane_is_last = jnp.arange(C) >= spec.n_first_lanes
+        acc_caps = jnp.where(
+            fresh | (c0 & lane_is_last), ev_caps[0], acc_caps)
+        acc_ts = jnp.where(fresh, ts, acc_ts)
+        arm = c0 & (ctr2 >= spec.count0_min)
+        out_carry["acc_ctr"] = jnp.where(arm, 0, ctr2)
+        out_carry["acc_caps"] = acc_caps
+        out_carry["acc_ts"] = acc_ts
+        arm_caps0 = acc_caps
+        arm_ts = acc_ts
+    if not spec.is_every:
+        # without `every` only the initial partial exists: first arm wins
+        # (reference StreamPreStateProcessor.init + resetState guards)
+        armed_total = carry["armed_total"]
+        arm = arm & (armed_total == 0)
+        out_carry["armed_total"] = armed_total + \
+            jnp.where(arm, 1, 0)
     free = new_state < 0
     first_free = jnp.argmax(free)            # 0 if none free — guarded below
     any_free = jnp.any(free)
     do_arm = arm & any_free
-    one_done = S == 1
     slot_iota = jnp.arange(K)
     armed_here = do_arm & (slot_iota == first_free)
-    if one_done:
+    write0 = armed_here[:, None, None] & \
+        (jnp.arange(S)[None, :, None] == 0)
+    if S == 1:
         # single-state pattern: arming IS completion
         match_mask = match_mask | armed_here
-        caps0 = jnp.where(armed_here[:, None, None], ev_caps[None], captures)
+        caps0 = jnp.where(write0, arm_caps0[None, None, :], captures)
         match_caps = jnp.where(armed_here[:, None, None], caps0, match_caps)
         match_ts = jnp.where(armed_here, ts, match_ts)
     else:
         new_state = jnp.where(armed_here, 1, new_state)
-        slot_start = jnp.where(armed_here, ts, slot_start)
-        captures = jnp.where(
-            (armed_here[:, None, None] &
-             (jnp.arange(S)[None, :, None] == 0)),
-            ev_caps[None, :, :], captures)
+        slot_start = jnp.where(armed_here, arm_ts, slot_start)
+        captures = jnp.where(write0, arm_caps0[None, None, :], captures)
     dropped = dropped + jnp.where(arm & ~any_free, 1, 0)
 
-    return ((new_state, slot_start, captures, dropped),
-            (match_mask, match_caps, match_ts))
+    out_carry.update({"slot_state": new_state, "slot_start": slot_start,
+                      "captures": captures, "dropped": dropped})
+    return out_carry, (match_mask, match_caps, match_ts)
 
 
 def _event_capture_matrix(spec: NfaSpec, event) -> jnp.ndarray:
@@ -162,19 +213,11 @@ def build_block_step(spec: NfaSpec):
         # events_p: dict of [T] arrays for one partition
         def step(c, ev):
             return _one_partition_step(spec, c, ev)
-        return jax.lax.scan(step, carry_p,
-                            events_p)
+        return jax.lax.scan(step, carry_p, events_p)
 
     def block_step(carry, block):
         # carry dict [P, ...]; block dict [P, T]
-        carry_t = (carry["slot_state"], carry["slot_start"],
-                   carry["captures"], carry["dropped"])
-        # vmap over partitions; scan over time inside
-        (ns, st, cp, dr), (mm, mc, mt) = jax.vmap(per_partition)(
-            carry_t, block)
-        new_carry = {"slot_state": ns, "slot_start": st, "captures": cp,
-                     "dropped": dr}
-        # matches come out [P, T, ...] → transpose mask to [T, P, K]
+        new_carry, (mm, mc, mt) = jax.vmap(per_partition)(carry, block)
         return new_carry, (mm, mc, mt)
 
     return block_step
@@ -203,12 +246,8 @@ def build_bank_step(spec: NfaSpec):
         return c2, acc
 
     def pattern_step(carry_n, prm, block):
-        ct = (carry_n["slot_state"], carry_n["slot_start"],
-              carry_n["captures"], carry_n["dropped"])
-        (ns, st, cp, dr), counts = jax.vmap(
-            per_partition, in_axes=(0, 0, None))(ct, block, prm)
-        new_carry = {"slot_state": ns, "slot_start": st, "captures": cp,
-                     "dropped": dr}
+        new_carry, counts = jax.vmap(
+            per_partition, in_axes=(0, 0, None))(carry_n, block, prm)
         return new_carry, jnp.sum(counts)
 
     def bank_step(carry, block, params):

@@ -46,20 +46,36 @@ class _ChainState:
         self.filters = filters
 
 
-def _flatten_chain(sis: StateInputStream) -> List[StreamStateElement]:
-    """Next(Every(A), Next(B, C)) → [A, B, C]; rejects non-chain shapes."""
+def _flatten_chain(sis: StateInputStream):
+    """Next(Every(A), Next(B, C)) → ([A, B, C], count0) where count0 is the
+    (min, max) of a leading kleene state; rejects non-chain shapes."""
+    from ..query_api import CountStateElement
     out: List[StreamStateElement] = []
+    count0: List = [None]
+
+    def base(el, first: bool):
+        if isinstance(el, CountStateElement):
+            if not first:
+                raise SiddhiAppCreationError(
+                    "TPU NFA path supports kleene counts only on the first "
+                    "chain element (A<m:n> -> B -> ...)")
+            count0[0] = (el.min_count, el.max_count)
+            return el.state
+        return el
 
     def rec(el, first: bool):
         if isinstance(el, NextStateElement):
             rec(el.state, first)
             rec(el.next, False)
-        elif isinstance(el, EveryStateElement):
-            if not first or not isinstance(el.state, StreamStateElement):
+            return
+        el = base(el, first)
+        if isinstance(el, EveryStateElement):
+            inner = base(el.state, first)
+            if not first or not isinstance(inner, StreamStateElement):
                 raise SiddhiAppCreationError(
                     "TPU NFA path supports `every` only on the first chain "
                     "element")
-            out.append(el.state)
+            out.append(inner)
         elif isinstance(el, StreamStateElement):
             if type(el) is not StreamStateElement:
                 raise SiddhiAppCreationError(
@@ -70,7 +86,7 @@ def _flatten_chain(sis: StateInputStream) -> List[StreamStateElement]:
                 f"TPU NFA path: unsupported state element "
                 f"{type(el).__name__}")
     rec(sis.state, True)
-    return out
+    return out, count0[0]
 
 
 def _walk_filter_constants(states) -> List:
@@ -112,7 +128,8 @@ class CompiledPatternNFA:
         if not isinstance(sis, StateInputStream) or \
                 sis.state_type != StateType.PATTERN:
             raise SiddhiAppCreationError("TPU NFA path needs a PATTERN query")
-        elements = _flatten_chain(sis)
+        elements, count0 = _flatten_chain(sis)
+        self.count0 = count0
         is_every = isinstance(
             sis.state.state if isinstance(sis.state, NextStateElement)
             else sis.state, EveryStateElement)
@@ -148,10 +165,25 @@ class CompiledPatternNFA:
                     self.attr_names.append(a.name)
                     self.attr_types[a.name] = a.type
 
-        # capture lanes: (state, attr) pairs referenced by later filters or
-        # by the select clause
+        # capture lanes: (state, attr, first|last) referenced by later
+        # filters or the select clause.  A leading kleene state keeps two
+        # banks (e1[0].x first-occurrence, e1[last].x latest); plain states
+        # alias both to one lane.
         ref_to_idx = {st.ref: st.idx for st in states}
-        needed: List[set] = [set() for _ in range(S)]
+        needed_f: List[set] = [set() for _ in range(S)]
+        needed_l: List[set] = [set() for _ in range(S)]
+
+        def which_of(var: Variable, idx: int) -> str:
+            si = var.stream_index
+            if si is None or si == 0:
+                return "f"
+            if si == -1:
+                if idx == 0 and count0 is not None:
+                    return "l"
+                return "f"      # non-count states hold a single event
+            raise SiddhiAppCreationError(
+                f"TPU NFA path: only e[0]/e[last] capture indexing is "
+                f"supported (got index {si})")
 
         def note(var: Variable, current_idx: Optional[int]):
             if var.stream_id is None:
@@ -159,7 +191,8 @@ class CompiledPatternNFA:
             idx = ref_to_idx.get(var.stream_id)
             if idx is None or idx == current_idx:
                 return
-            needed[idx].add(var.attribute)
+            (needed_f if which_of(var, idx) == "f" else
+             needed_l)[idx].add(var.attribute)
 
         def scan_expr(e, current_idx):
             if isinstance(e, Variable):
@@ -176,7 +209,7 @@ class CompiledPatternNFA:
         for st in states:
             for fe in st.filters:
                 scan_expr(fe, st.idx)
-        self.select_outputs: List[Tuple[str, int, str]] = []
+        self.select_outputs: List[Tuple[str, int, str, str]] = []
         for oa in query.selector.attributes:
             e = oa.expr
             if not isinstance(e, Variable) or e.stream_id is None:
@@ -184,15 +217,30 @@ class CompiledPatternNFA:
                     "TPU NFA path: select must be captured attributes "
                     "(e1.attr as name)")
             idx = ref_to_idx[e.stream_id]
-            needed[idx].add(e.attribute)
-            self.select_outputs.append((oa.rename, idx, e.attribute))
+            w = which_of(e, idx)
+            (needed_f if w == "f" else needed_l)[idx].add(e.attribute)
+            self.select_outputs.append((oa.rename, idx, e.attribute, w))
 
-        cap_cols = [sorted(n) for n in needed]
+        # lane layout per state: first-bank cols then last-bank cols; only
+        # the count state actually distinguishes them
+        cap_cols: List[List[str]] = []
+        self.cap_lane: Dict[Tuple[int, str, str], int] = {}
+        n_first0 = 0
+        for j in range(S):
+            fcols = sorted(needed_f[j])
+            lcols = sorted(needed_l[j]) if (j == 0 and count0 is not None) \
+                else []
+            if j == 0:
+                n_first0 = len(fcols)
+            cols = fcols + lcols
+            cap_cols.append(cols)
+            for lane, a in enumerate(fcols):
+                self.cap_lane[(j, a, "f")] = lane
+                if not lcols:
+                    self.cap_lane[(j, a, "l")] = lane
+            for lane, a in enumerate(lcols):
+                self.cap_lane[(j, a, "l")] = len(fcols) + lane
         C = max((len(c) for c in cap_cols), default=0)
-        self.cap_lane: Dict[Tuple[int, str], int] = {}
-        for j, cols in enumerate(cap_cols):
-            for lane, a in enumerate(cols):
-                self.cap_lane[(j, a)] = lane
 
         # optional pattern-bank parameterization: numeric filter constants
         # become per-pattern lanes fed through the event dict
@@ -215,7 +263,10 @@ class CompiledPatternNFA:
             state_streams=np.asarray(
                 [self.stream_codes[st.stream_id] for st in states], np.int32),
             cond_fns=cond_fns, cap_cols=cap_cols,
-            attr_names=self.attr_names, is_every=is_every)
+            attr_names=self.attr_names, is_every=is_every,
+            count0_min=(count0[0] if count0 is not None else None),
+            count0_max=(count0[1] if count0 is not None else None),
+            n_first_lanes=n_first0)
         self.n_partitions = n_partitions
         self.carry = make_carry(self.spec, n_partitions)
         self._step = jax.jit(build_block_step(self.spec), donate_argnums=0)
@@ -251,15 +302,21 @@ class CompiledPatternNFA:
             scope.add(None, a.name, a.type, g)
             scope.add(st.stream_id, a.name, a.type, g)
             scope.add(st.ref, a.name, a.type, g)
-        # earlier captures: [K] lanes
+        # earlier captures: [K] lanes (first bank at index 0/None, last bank
+        # at index -1 for a leading kleene state)
         for other in self.states:
             if other.idx == st.idx:
                 continue
             for a in other.definition.attributes:
                 def gq(ctx, _r=other.ref, _a=a.name):
                     return ctx.qualified[(_r, 0)][_a]
+
+                def gql(ctx, _r=other.ref, _a=a.name):
+                    q = ctx.qualified.get((_r, -1))
+                    return (q or ctx.qualified[(_r, 0)])[_a]
                 scope.add(other.ref, a.name, a.type, gq, index=0)
                 scope.add(other.ref, a.name, a.type, gq, index=None)
+                scope.add(other.ref, a.name, a.type, gql, index=-1)
         if self._param_map:
             compiled = _ParamExprCompiler(scope, self._param_map).compile(
                 expr)
@@ -274,11 +331,15 @@ class CompiledPatternNFA:
             for other in self.states:
                 if other.idx == _st.idx:
                     continue
-                cols = {}
-                for (j, a), lane in cap_lane.items():
-                    if j == other.idx:
-                        cols[a] = captures[:, j, lane]
-                qualified[(other.ref, 0)] = cols
+                cols_f, cols_l = {}, {}
+                for (j, a, w), lane in cap_lane.items():
+                    if j != other.idx:
+                        continue
+                    (cols_f if w == "f" else cols_l)[a] = \
+                        captures[:, j, lane]
+                qualified[(other.ref, 0)] = cols_f
+                if cols_l:
+                    qualified[(other.ref, -1)] = cols_l
             cols_now = {a: event[a] for a in self.attr_names}
             for pn in self.param_names:
                 if pn in event:
@@ -298,7 +359,7 @@ class CompiledPatternNFA:
         param lanes of this (parameterized) compile."""
         app = SiddhiCompiler.parse(app_string)
         query = self._pick_query(app, query_name)
-        elements = _flatten_chain(query.input_stream)
+        elements, _count0 = _flatten_chain(query.input_stream)
         if len(elements) != len(self.states):
             raise SiddhiAppCreationError(
                 "pattern bank: app has a different chain length")
@@ -352,8 +413,8 @@ class CompiledPatternNFA:
         ps, tts, ks = np.nonzero(mask)
         for p, t, k in zip(ps, tts, ks):
             vals = {}
-            for name, idx, attr in self.select_outputs:
-                lane = self.cap_lane[(idx, attr)]
+            for name, idx, attr, which in self.select_outputs:
+                lane = self.cap_lane[(idx, attr, which)]
                 v = float(caps[p, t, k, idx, lane])
                 at = self.attr_types.get(attr)
                 if at in (AttrType.INT, AttrType.LONG):

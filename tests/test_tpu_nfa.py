@@ -166,3 +166,43 @@ def test_pattern_bank_counts_match_individual_runs():
     assert counts.sum() > 0
     # higher threshold → fewer (or equal) matches
     assert counts.tolist() == sorted(counts.tolist(), reverse=True)
+
+
+APP_COUNT = """
+define stream S (partition int, price float, kind int);
+@info(name='q')
+from e1=S[kind == 0 and price > 20.0]<3:3> -> e2=S[kind == 1 and price > e1[0].price]
+select e1[0].price as p0, e1[last].price as pl, e2.price as p2
+insert into Out;
+"""
+
+
+def test_count_chain_conformance():
+    """Leading kleene <3:3>, non-every (the reference-supported shape):
+    exact-match conformance vs the oracle."""
+    assert_equal_matches(APP_COUNT, seed=21, n=500, n_partitions=8,
+                         outputs=["p0", "pl", "p2"])
+
+
+def test_nonevery_chain_single_match():
+    """Without `every`, only the initial partial exists — one match."""
+    app = APP.replace("from every e1", "from e1")
+    assert_equal_matches(app, seed=23, n=400, n_partitions=8,
+                         outputs=["p1", "p2"])
+
+
+def test_every_count_greedy_restart_groups():
+    """`every A<3:3> -> B`: kernel groups the A-stream into consecutive
+    triples (documented TPU-path semantics; the reference leaves the
+    every+leading-count combination effectively single-shot)."""
+    import numpy as np
+    app = APP_COUNT.replace("from e1", "from every e1")
+    n_partitions = 1
+    # A A A B A A A B — two complete groups
+    prices = np.asarray([30, 31, 32, 100, 40, 41, 42, 110], np.float32)
+    kind = np.asarray([0, 0, 0, 1, 0, 0, 0, 1], np.int32)
+    pids = np.zeros(8, np.int64)
+    ts = 1_000_000 + np.arange(8, dtype=np.int64)
+    tpu = run_tpu(app, pids, prices, kind, ts, n_partitions, 8)
+    got = [(v["p0"], v["pl"], v["p2"]) for _, _, v in tpu]
+    assert got == [(30.0, 32.0, 100.0), (40.0, 42.0, 110.0)]
