@@ -80,7 +80,8 @@ class QueryRuntime:
     def _expr_compiler_factory(self) -> Callable[[Scope], ExprCompiler]:
         app = self.app_runtime
         return lambda scope: ExprCompiler(
-            scope, np, app.app_ctx.script_functions, app.extension_registry)
+            scope, np, app.app_ctx.script_functions, app.extension_registry,
+            tables=app.tables)
 
     def _build(self):
         q = self.query
@@ -161,6 +162,10 @@ class QueryRuntime:
         self.selector = QuerySelector(q.selector, scope, input_definition,
                                       factory, output_id=target)
         self.output_definition = self.selector.output_definition
+        if isinstance(q.input_stream, SingleInputStream):
+            # table on/set expressions may qualify by the source stream name
+            self.output_definition.source_alias = \
+                q.input_stream.stream_ref or q.input_stream.stream_id
         group_names = [v.attribute for v in q.selector.group_by]
         self.rate_limiter = build_rate_limiter(q.output_rate, app.app_ctx,
                                                group_names)

@@ -242,17 +242,19 @@ class InMemoryTable:
         if on is None:
             return CompiledTableCondition(None)
         scope = Scope()
-        # table attributes: primary columns
-        scope.add_primary(self.definition.id, None, self.definition)
-        # stream attributes: qualified scalars (by stream name or unqualified
-        # when not shadowed by a table attribute)
+        # stream attributes first: qualified scalars (by stream name, or
+        # unqualified when not shadowed by a table attribute)
         if stream_def is not None:
             for a in stream_def.attributes:
                 def g(ctx, name=a.name):
                     return ctx.qualified[(STREAM_QUAL, 0)][name]
-                scope.add(stream_def.id, a.name, a.type, g)
+                for qual in _stream_quals(stream_def, self.definition.id):
+                    scope.add(qual, a.name, a.type, g)
                 if self.definition.index_of(a.name) < 0:
                     scope.add(None, a.name, a.type, g)
+        # table attributes last: `T.x` (and unqualified table columns) must
+        # resolve to the table even when the flowing definition shares ids
+        scope.add_primary(self.definition.id, None, self.definition)
         compiler = factory(scope)
         pk_probe = self._try_pk_probe(on, stream_def, factory)
         return CompiledTableCondition(compiler.compile(on), pk_probe)
@@ -284,7 +286,8 @@ class InMemoryTable:
             for a in stream_def.attributes:
                 def g(ctx, name=a.name):
                     return ctx.qualified[(STREAM_QUAL, 0)][name]
-                scope.add(stream_def.id, a.name, a.type, g)
+                for qual in _stream_quals(stream_def, self.definition.id):
+                    scope.add(qual, a.name, a.type, g)
                 scope.add(None, a.name, a.type, g)
         compiler = factory(scope)
         return [(k, compiler.compile(v))
@@ -299,7 +302,9 @@ class InMemoryTable:
                 for at in stream_def.attributes:
                     def g(ctx, name=at.name):
                         return ctx.qualified[(STREAM_QUAL, 0)][name]
-                    scope.add(stream_def.id, at.name, at.type, g)
+                    for qual in _stream_quals(stream_def,
+                                              self.definition.id):
+                        scope.add(qual, at.name, at.type, g)
                     if self.definition.index_of(at.name) < 0:
                         scope.add(None, at.name, at.type, g)
             compiler = factory(scope)
@@ -317,6 +322,18 @@ class InMemoryTable:
         self.timestamps = list(s["timestamps"])
         self._rebuild_indexes()
         self._invalidate()
+
+
+def _stream_quals(stream_def, table_id):
+    """Qualifiers the `on`/`set` expressions may use for stream attributes:
+    the flowing definition's id plus the query's source stream alias
+    (set by QueryRuntime — reference matcher binds the input stream name).
+    The table's own id never qualifies stream attributes."""
+    quals = [stream_def.id]
+    alias = getattr(stream_def, "source_alias", None)
+    if alias and alias not in quals:
+        quals.append(alias)
+    return [q for q in quals if q != table_id]
 
 
 def _item(v):

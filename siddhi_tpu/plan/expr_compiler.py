@@ -161,11 +161,12 @@ class ExprCompiler:
 
     def __init__(self, scope: Scope, xp=np,
                  script_functions: Optional[Dict[str, Any]] = None,
-                 extension_registry=None):
+                 extension_registry=None, tables: Optional[Dict] = None):
         self.scope = scope
         self.xp = xp
         self.script_functions = script_functions or {}
         self.extension_registry = extension_registry
+        self.tables = tables or {}
 
     def compile(self, expr: Expression) -> CompiledExpr:
         xp = self.xp
@@ -330,9 +331,10 @@ class ExprCompiler:
     def _compile_in(self, e: In) -> CompiledExpr:
         inner = self.compile(e.expr)
         source_id = e.source_id
+        tables = self.tables
 
         def fn(ctx):
-            table = ctx.tables.get(source_id)
+            table = ctx.tables.get(source_id) or tables.get(source_id)
             if table is None:
                 raise SiddhiAppValidationException(
                     f"'in {source_id}': unknown table")

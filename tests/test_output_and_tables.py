@@ -1,0 +1,262 @@
+"""Rate limiting, table CRUD, triggers, statistics, store queries and
+distributed sinks (reference models: query/ratelimit/, query/table/,
+trigger tests, managment/StatisticsTestCase, store/,
+transport/MultiClientDistributedSinkTestCase)."""
+import pytest
+
+from siddhi_tpu import QueryCallback, SiddhiManager, StreamCallback
+from siddhi_tpu.core.source_sink import InMemoryBroker
+
+
+def make(app, cb="Out"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback(cb, StreamCallback(
+        lambda evs: got.extend(e.data for e in evs)))
+    rt.start()
+    return m, rt, got
+
+
+# ---------------------------------------------------------------- rate limit
+
+def test_output_every_n_events():
+    m, rt, got = make("""
+        define stream S (v int);
+        from S select v output every 3 events insert into Out;
+    """)
+    h = rt.get_input_handler("S")
+    for i in range(7):
+        h.send([i])
+    rt.shutdown()
+    # batches flushed at every 3rd event
+    assert [g[0] for g in got] == [0, 1, 2, 3, 4, 5]
+
+
+def test_output_first_every_n_events():
+    m, rt, got = make("""
+        define stream S (v int);
+        from S select v output first every 3 events insert into Out;
+    """)
+    h = rt.get_input_handler("S")
+    for i in range(7):
+        h.send([i])
+    rt.shutdown()
+    assert [g[0] for g in got] == [0, 3, 6]
+
+
+def test_output_last_every_n_events():
+    m, rt, got = make("""
+        define stream S (v int);
+        from S select v output last every 3 events insert into Out;
+    """)
+    h = rt.get_input_handler("S")
+    for i in range(6):
+        h.send([i])
+    rt.shutdown()
+    assert [g[0] for g in got] == [2, 5]
+
+
+def test_output_snapshot_every_time():
+    m, rt, got = make("""
+        @app:playback
+        define stream S (v int);
+        from S#window.length(5) select sum(v) as total
+        output snapshot every 1 sec insert into Out;
+    """)
+    h = rt.get_input_handler("S")
+    h.send([10], timestamp=1000)
+    h.send([20], timestamp=1200)
+    rt.app_ctx.timestamp_generator.observe_event_time(2100)
+    rt.app_ctx.scheduler.advance_to(2100)
+    rt.shutdown()
+    assert got and got[-1][0] == 30
+
+
+# ---------------------------------------------------------------- tables
+
+TABLE_APP = """
+define stream Add (symbol string, price float);
+define stream Del (symbol string);
+define stream Upd (symbol string, price float);
+define stream Check (symbol string);
+define table T (symbol string, price float);
+from Add insert into T;
+from Del delete T on T.symbol == Del.symbol;
+from Upd update T set T.price = Upd.price on T.symbol == Upd.symbol;
+@info(name='q') from Check[Check.symbol in T] select symbol insert into Out;
+"""
+
+
+def test_table_insert_delete_update_in():
+    m, rt, got = make(TABLE_APP)
+    add = rt.get_input_handler("Add")
+    add.send(["IBM", 10.0])
+    add.send(["WSO2", 20.0])
+    rt.get_input_handler("Check").send(["IBM"])          # present
+    rt.get_input_handler("Del").send(["IBM"])
+    rt.get_input_handler("Check").send(["IBM"])          # deleted
+    rt.get_input_handler("Upd").send(["WSO2", 99.0])
+    events = rt.query("from T select symbol, price")
+    rt.shutdown()
+    assert got == [["IBM"]]
+    assert [e.data for e in events] == [["WSO2", 99.0]]
+
+
+def test_table_update_or_insert():
+    m, rt, got = make("""
+        define stream S (symbol string, price float);
+        define table T (symbol string, price float);
+        from S update or insert into T set T.price = S.price
+            on T.symbol == S.symbol;
+    """, cb=None) if False else (None, None, None)
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, price float);
+        define table T (symbol string, price float);
+        from S update or insert into T set T.price = S.price
+            on T.symbol == S.symbol;
+    """)
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["IBM", 1.0])
+    h.send(["IBM", 2.0])     # updates, not duplicates
+    h.send(["WSO2", 3.0])
+    events = rt.query("from T select symbol, price")
+    rt.shutdown()
+    assert sorted(e.data for e in events) == [["IBM", 2.0], ["WSO2", 3.0]]
+
+
+def test_primary_key_table_store_query():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, price float);
+        @PrimaryKey('symbol')
+        define table T (symbol string, price float);
+        from S insert into T;
+    """)
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["A", 1.0])
+    h.send(["B", 2.0])
+    events = rt.query("from T on T.symbol == 'B' select symbol, price")
+    rt.shutdown()
+    assert [e.data for e in events] == [["B", 2.0]]
+
+
+# ---------------------------------------------------------------- triggers
+
+def test_periodic_trigger_playback():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:playback
+        define trigger T at every 1 sec;
+        from T select triggered_time insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: got.extend(e.data for e in evs)))
+    rt.start()
+    rt.app_ctx.timestamp_generator.observe_event_time(3500)
+    rt.app_ctx.scheduler.advance_to(3500)
+    rt.shutdown()
+    assert len(got) >= 2
+
+
+def test_start_trigger():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define trigger T at 'start';
+        from T select triggered_time insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: got.extend(e.data for e in evs)))
+    rt.start()
+    rt.shutdown()
+    assert len(got) == 1
+
+
+# ---------------------------------------------------------------- statistics
+
+def test_statistics_counters():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:statistics(reporter='console', interval='300')
+        define stream S (v int);
+        @info(name='q') from S[v > 0] select v insert into Out;
+    """)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(5):
+        h.send([i + 1])
+    snap = rt.statistics
+    rt.shutdown()
+    flat = str(snap)
+    assert "S" in flat
+    # throughput tracker saw the 5 events
+    assert any("5" in str(v) for v in str(snap).split())
+
+
+# ---------------------------------------------------------------- dist sinks
+
+def test_round_robin_distributed_sink():
+    class Collect:
+        def __init__(self, topic):
+            self.topic = topic
+            self.items = []
+
+        def on_message(self, msg):
+            self.items.append(msg)
+
+    c1, c2 = Collect("d1"), Collect("d2")
+    InMemoryBroker.subscribe(c1)
+    InMemoryBroker.subscribe(c2)
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (v int);
+        @sink(type='inMemory', @map(type='passThrough'),
+              @distribution(strategy='roundRobin',
+                            @destination(topic='d1'),
+                            @destination(topic='d2')))
+        define stream Out (v int);
+        from S select v insert into Out;
+    """)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(4):
+        h.send([i])
+    rt.shutdown()
+    InMemoryBroker.unsubscribe(c1)
+    InMemoryBroker.unsubscribe(c2)
+    assert len(c1.items) == 2 and len(c2.items) == 2
+
+
+def test_broadcast_distributed_sink():
+    class Collect:
+        def __init__(self, topic):
+            self.topic = topic
+            self.items = []
+
+        def on_message(self, msg):
+            self.items.append(msg)
+
+    c1, c2 = Collect("b1"), Collect("b2")
+    InMemoryBroker.subscribe(c1)
+    InMemoryBroker.subscribe(c2)
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (v int);
+        @sink(type='inMemory', @map(type='passThrough'),
+              @distribution(strategy='broadcast',
+                            @destination(topic='b1'),
+                            @destination(topic='b2')))
+        define stream Out (v int);
+        from S select v insert into Out;
+    """)
+    rt.start()
+    rt.get_input_handler("S").send([7])
+    rt.shutdown()
+    InMemoryBroker.unsubscribe(c1)
+    InMemoryBroker.unsubscribe(c2)
+    assert len(c1.items) == 1 and len(c2.items) == 1
