@@ -1,0 +1,65 @@
+"""@Async junctions and @OnError fault streams (reference models:
+managment/AsyncTestCase, stream/junction OnError tests)."""
+import time
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+
+def test_async_junction_delivers_all_events():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @Async(buffer.size='256', workers='2', batch.size.max='32')
+        define stream S (v int);
+        from S[v >= 0] select v insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(300):
+        h.send([i])
+    deadline = time.time() + 5
+    while len(got) < 300 and time.time() < deadline:
+        time.sleep(0.01)
+    rt.shutdown()
+    assert sorted(e.data[0] for e in got) == list(range(300))
+
+
+def test_onerror_stream_routes_failures():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream A (v int);
+        @OnError(action='STREAM')
+        define stream S (v int);
+        define function boom[python] return int { data[0] if data[0] < 3 else (_ for _ in ()).throw(ValueError('kaboom')) };
+        from A select v insert into S;
+        from S select boom(v) as v insert into Out;
+        from !S select v, _error insert into FaultOut;
+    """)
+    ok, fault = [], []
+    rt.add_callback("Out", StreamCallback(lambda evs: ok.extend(evs)))
+    rt.add_callback("FaultOut", StreamCallback(lambda evs: fault.extend(evs)))
+    rt.start()
+    h = rt.get_input_handler("A")
+    h.send([1])
+    h.send([5])     # boom() raises → routed to !S
+    rt.shutdown()
+    assert [e.data[0] for e in ok] == [1]
+    assert len(fault) == 1 and fault[0].data[0] == 5
+    assert "kaboom" in str(fault[0].data[1])
+
+
+def test_onerror_log_default_swallows():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (v int);
+        define function boom2[python] return int { (_ for _ in ()).throw(ValueError('x')) };
+        from S select boom2(v) as v insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    rt.get_input_handler("S").send([1])   # error logged, app alive
+    rt.get_input_handler("S").send([2])
+    rt.shutdown()
+    assert got == []
