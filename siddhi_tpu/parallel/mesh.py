@@ -28,38 +28,47 @@ def partition_mesh(devices: Optional[Sequence] = None,
     return Mesh(devs, (axis,))
 
 
+def lead_axis_sharding(mesh: Mesh, v, axis: str = "p") -> NamedSharding:
+    """Leading-dim-on-`axis` sharding for an array(-like) leaf."""
+    return NamedSharding(mesh, P(axis, *([None] * (jnp.ndim(v) - 1))))
+
+
 def shard_carry(carry: Dict[str, jnp.ndarray], mesh: Mesh,
                 axis: str = "p") -> Dict[str, jnp.ndarray]:
     """Place NFA carry tensors with their leading partition dim sharded."""
-    out = {}
-    for k, v in carry.items():
-        spec = P(axis, *([None] * (v.ndim - 1)))
-        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
-    return out
+    return {k: jax.device_put(v, lead_axis_sharding(mesh, v, axis))
+            for k, v in carry.items()}
 
 
 def build_sharded_step(spec: NfaSpec, mesh: Mesh, axis: str = "p"):
-    """jit-compiled block step with partition-sharded inputs/outputs and a
-    psum'd per-block stats reduction (the only collective)."""
+    """jit-compiled block step with explicit partition-sharded in/out
+    shardings and a summed per-block stats reduction (the only collective —
+    with the leading axis sharded XLA lowers it to an all-reduce over ICI)."""
     step = build_block_step(spec)
 
     def stepped(carry, block):
         new_carry, (mask, caps, ts) = step(carry, block)
-        # global per-block stats ride one reduction; with the leading axis
-        # sharded XLA lowers this to an all-reduce over ICI
         stats = {
             "matches": jnp.sum(mask.astype(jnp.int32)),
             "dropped": jnp.sum(new_carry["dropped"]),
         }
         return new_carry, (mask, caps, ts), stats
 
-    def in_spec(v):
-        return NamedSharding(mesh, P(axis, *([None] * (v.ndim - 1))))
-
-    def shardings_like(tree):
-        return jax.tree_util.tree_map(in_spec, tree)
-
-    return jax.jit(stepped)
+    replicated = NamedSharding(mesh, P())
+    # carry tree structure is fixed by the spec — probe it at P=1
+    proto_carry = make_carry(spec, 1)
+    carry_sh = jax.tree_util.tree_map(
+        lambda v: lead_axis_sharding(mesh, v, axis), proto_carry)
+    block_sh = {name: NamedSharding(mesh, P(axis, None))
+                for name in list(spec.attr_names) +
+                ["__ts", "__stream", "__valid"]}
+    matches_sh = (NamedSharding(mesh, P(axis, None, None)),          # mask
+                  NamedSharding(mesh, P(axis, *([None] * 4))),       # caps
+                  NamedSharding(mesh, P(axis, None, None)))          # ts
+    stats_sh = {"matches": replicated, "dropped": replicated}
+    return jax.jit(stepped,
+                   in_shardings=(carry_sh, block_sh),
+                   out_shardings=(carry_sh, matches_sh, stats_sh))
 
 
 def make_sharded_carry(spec: NfaSpec, n_partitions: int, mesh: Mesh,
