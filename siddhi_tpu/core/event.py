@@ -121,7 +121,7 @@ class EventChunk:
         if types is None:
             types = np.zeros(len(timestamps), np.int8)
         return EventChunk(names, np.asarray(timestamps, np.int64), types,
-                          columns)
+                          {k: np.asarray(v) for k, v in columns.items()})
 
     # ------------------------------------------------------------ accessors
 

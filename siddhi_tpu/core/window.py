@@ -373,12 +373,21 @@ class HopingWindowProcessor(WindowProcessor):
         self.last_emitted: Optional[EventChunk] = None
 
     def on_data(self, chunk: EventChunk):
-        now = int(chunk.timestamps[-1])
         if self.next_emit is None:
             self.next_emit = int(chunk.timestamps[0]) + self.hop_ms
             self.app_ctx.scheduler.notify_at(self.next_emit, self._on_timer)
-        self._emit_due(now)
-        self._buf_append(chunk)
+        # a batch may span hop boundaries: events at or before a due hop
+        # belong to that hop's window, so split-append before each emission
+        while not chunk.is_empty and \
+                int(chunk.timestamps[-1]) >= self.next_emit:
+            pre = chunk.timestamps <= self.next_emit
+            if pre.any():
+                self._buf_append(chunk.mask(pre))
+                chunk = chunk.mask(~pre)
+            self._hop(self.next_emit)
+            self.next_emit += self.hop_ms
+        if not chunk.is_empty:
+            self._buf_append(chunk)
 
     def _emit_due(self, now: int):
         while self.next_emit is not None and now >= self.next_emit:

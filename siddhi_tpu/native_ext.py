@@ -63,6 +63,12 @@ def assign_rows(pids: np.ndarray,
     Returns (rows [n] int32, counts [P] int32, T)."""
     pids = np.ascontiguousarray(pids, np.int32)
     n = len(pids)
+    if n and (pids.min() < 0 or pids.max() >= n_partitions):
+        # the native path would heap-write out of bounds and the numpy
+        # fallback would silently wrap negatives — reject both up front
+        raise ValueError(
+            f"partition ids must be in [0, {n_partitions}); got range "
+            f"[{int(pids.min())}, {int(pids.max())}]")
     rows = np.empty(n, np.int32)
     counts = np.empty(n_partitions, np.int32)
     lib = _load()

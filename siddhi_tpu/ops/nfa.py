@@ -205,8 +205,8 @@ def build_block_step(spec: NfaSpec):
     """Returns jittable fn(carry, block) → (carry, matches).
 
     block: dict of [P, T] arrays — per-partition event lanes, time-major
-    scan; `__valid` masks padding.  matches: (mask [T, P, K],
-    caps [T, P, K, S, C], ts [T, P, K]).
+    scan; `__valid` masks padding.  matches: (mask [P, T, K],
+    caps [P, T, K, S, C], ts [P, T, K]).
     """
 
     def per_partition(carry_p, events_p):

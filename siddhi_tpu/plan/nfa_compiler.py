@@ -272,6 +272,17 @@ class CompiledPatternNFA:
         self._step = jax.jit(build_block_step(self.spec), donate_argnums=0)
         self.base_ts: Optional[int] = None
 
+        # capture lanes ride float32: LONG values above 2**24 round silently
+        import warnings
+        warned = set()
+        for (_j, a, _w) in self.cap_lane:
+            if self.attr_types.get(a) == AttrType.LONG and a not in warned:
+                warned.add(a)
+                warnings.warn(
+                    f"TPU NFA path: LONG attribute '{a}' rides a float32 "
+                    f"capture lane; values above 2**24 lose precision on "
+                    f"decode", stacklevel=2)
+
     @staticmethod
     def _pick_query(app, query_name) -> Query:
         from ..query_api import find_annotation
@@ -393,6 +404,9 @@ class CompiledPatternNFA:
         Returns a list of (partition, match_ts, {out_name: value})."""
         if self.base_ts is None:
             self.base_ts = int(timestamps[0]) if len(timestamps) else 0
+        if len(timestamps):
+            self._maybe_rebase(int(np.min(timestamps)),
+                               int(np.max(timestamps)))
         if stream_names is None:
             codes = np.zeros(len(partition_ids), np.int32)
         else:
@@ -404,6 +418,43 @@ class CompiledPatternNFA:
                             self.n_partitions, base_ts=self.base_ts)
         mask, caps, ts = self.process_block(block)
         return self.decode_matches(mask, caps, ts)
+
+    def _ts_safe_max(self) -> int:
+        # keep ts - slot_start inside int32 even for a slot clamped to
+        # -(within+1): max offset + within + 1 must stay below int32 max
+        w = self.spec.within_ms or 0
+        return (1 << 31) - (1 << 21) - (w + 1)
+
+    def _maybe_rebase(self, ts_min: int, ts_max: int) -> None:
+        """Timestamps ride int32 ms offsets from base_ts, which overflows
+        after ~24.8 days of stream time.  Rebase the origin onto this batch
+        and shift the carried start/accumulator timestamps to match."""
+        safe = self._ts_safe_max()
+        if ts_max - self.base_ts <= safe:
+            return
+        if ts_max - ts_min > safe:
+            raise ValueError(
+                "TPU NFA path: one batch spans more than ~24 days of "
+                "stream time; int32 timestamp offsets cannot represent it")
+        delta = ts_min - self.base_ts
+        carry = dict(self.carry)
+        # inactive slots / idle accumulators hold stale values but are gated
+        # on slot_state>=0 / acc_ctr>0, so a uniform shift is safe; clamp in
+        # int64 so an arbitrarily large delta can't wrap int32 — anything
+        # older than `within` is expired regardless of how old, and
+        # -(within+1) reads as expired at every ts >= 0 without the expiry
+        # subtraction ever leaving int32 range (see _ts_safe_max)
+        lo = -(self.spec.within_ms + 1) \
+            if self.spec.within_ms is not None else 0
+
+        def shift(v):
+            s = np.asarray(v, np.int64) - delta
+            return jnp.asarray(np.maximum(s, lo).astype(np.int32))
+        carry["slot_start"] = shift(carry["slot_start"])
+        if "acc_ts" in carry:
+            carry["acc_ts"] = shift(carry["acc_ts"])
+        self.carry = carry
+        self.base_ts += delta
 
     def decode_matches(self, mask, caps, ts):
         mask = np.asarray(mask)          # [P, T, K]

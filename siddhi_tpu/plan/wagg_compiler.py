@@ -63,14 +63,22 @@ class CompiledWindowedAgg:
         # outputs: aggregates of ONE value expression + key passthroughs
         self.outputs: List[Tuple[str, str]] = []   # (name, sum|count|avg)
         value_expr = None
+        value_ast = None
         for oa in query.selector.attributes:
             e = oa.expr
             if isinstance(e, AttributeFunction) and e.name.lower() in _AGGS:
                 fname = e.name.lower()
                 if e.args:
-                    ce = compiler.compile(e.args[0])
+                    # the kernel carries one value lane: every aggregate must
+                    # ride the same argument expression (count() is arg-free)
+                    if value_ast is not None and e.args[0] != value_ast:
+                        raise SiddhiAppCreationError(
+                            "windowed-agg path supports aggregates of a "
+                            f"single shared argument expression; got both "
+                            f"{value_ast} and {e.args[0]}")
                     if value_expr is None:
-                        value_expr = ce
+                        value_expr = compiler.compile(e.args[0])
+                        value_ast = e.args[0]
                 self.outputs.append((oa.rename, fname))
             elif isinstance(e, Variable):
                 self.outputs.append((oa.rename, "key"))

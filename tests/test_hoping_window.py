@@ -27,3 +27,26 @@ def test_hoping_window_emits_on_hops():
     assert currents[:2] == [1, 2]
     assert 3 in currents
     assert 1 in expireds and 2 in expireds
+
+
+def test_hoping_window_batch_spans_hop_boundary():
+    """Events at/before a hop boundary arriving in the same batch as a
+    later event must be included in that hop's emission."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:playback
+        define stream S (v int);
+        @info(name='q')
+        from S#window.hoping(2 sec, 1 sec) select v
+        insert all events into Out;
+    """)
+    hops = []
+    rt.add_callback("q", QueryCallback(lambda ts, cur, exp: hops.append(
+        [e.data[0] for e in (cur or [])])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([1], timestamp=1000)
+    # one batch spanning the hop at 2000: 1500 belongs to that hop
+    h.send_batch({"v": [2, 3]}, timestamps=[1500, 2100])
+    rt.shutdown()
+    assert hops[0] == [1, 2]

@@ -72,3 +72,10 @@ def test_ring_concurrent_producers():
 @pytest.mark.skipif(not have_native(), reason="native .so not built")
 def test_native_lib_is_loaded():
     assert native_ext.have_native()
+
+
+def test_assign_rows_rejects_out_of_range_pids():
+    with pytest.raises(ValueError):
+        assign_rows(np.array([0, 5, 2], np.int32), 4)
+    with pytest.raises(ValueError):
+        assign_rows(np.array([0, -1, 2], np.int32), 4)

@@ -206,3 +206,33 @@ def test_every_count_greedy_restart_groups():
     tpu = run_tpu(app, pids, prices, kind, ts, n_partitions, 8)
     got = [(v["p0"], v["pl"], v["p2"]) for _, _, v in tpu]
     assert got == [(30.0, 32.0, 100.0), (40.0, 42.0, 110.0)]
+
+
+def test_int32_ts_rebase_across_long_streams():
+    """Stream time beyond ~24.8 days must rebase the int32 ts origin and
+    keep `within` semantics intact (ADVICE: silent overflow guard)."""
+    nfa = CompiledPatternNFA(APP_WITHIN, n_partitions=2, n_slots=8)
+    day = 86_400_000
+    base = 1_000_000
+
+    def send(ts_list, prices, kinds):
+        n = len(ts_list)
+        return nfa.process_events(
+            np.zeros(n, np.int64),
+            {"partition": np.zeros(n, np.float32),
+             "price": np.asarray(prices, np.float32),
+             "kind": np.asarray(kinds, np.float32)},
+            np.asarray(ts_list, np.int64))
+
+    got = send([base, base + 100], [60.0, 70.0], [0, 1])
+    assert [(m[2]["p1"], m[2]["p2"]) for m in got] == [(60.0, 70.0)]
+    # 40 days later: would overflow int32 ms offsets without the rebase
+    far = base + 40 * day
+    got2 = send([far, far + 100], [55.0, 80.0], [0, 1])
+    assert [(m[2]["p1"], m[2]["p2"]) for m in got2] == [(55.0, 80.0)]
+    assert got2[0][1] == far + 100          # decoded ts stays absolute
+    # a partial armed just before the rebase still honours `within`
+    far2 = far + 40 * day
+    send([far2], [65.0], [0])
+    got3 = send([far2 + 40 * day], [99.0], [1])   # way past within 1 sec
+    assert got3 == []

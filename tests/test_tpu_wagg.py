@@ -103,3 +103,37 @@ def test_wagg_pallas_interpret_matches_jnp():
     assert (np.asarray(n1) == np.asarray(n2)).all()
     assert np.allclose(np.asarray(c1.ring), np.asarray(c2.ring))
     assert (np.asarray(c1.pos) == np.asarray(c2.pos)).all()
+
+
+def test_wagg_rejects_distinct_aggregate_args():
+    """sum(x) + avg(y) can't share the single value lane — must be rejected
+    at compile time, not silently aggregate the wrong column."""
+    from siddhi_tpu.utils.errors import SiddhiAppCreationError
+    with pytest.raises(SiddhiAppCreationError):
+        CompiledWindowedAgg("""
+            define stream S (k int, x float, y float);
+            @info(name='q')
+            from S#window.length(5)
+            select k, sum(x) as sx, avg(y) as ay group by k
+            insert into Out;
+        """, n_partitions=4)
+
+
+def test_wagg_same_arg_multiple_aggs_ok():
+    c = CompiledWindowedAgg("""
+        define stream S (k int, x float);
+        @info(name='q')
+        from S#window.length(5)
+        select k, sum(x) as sx, avg(x) as ax, count() as n group by k
+        insert into Out;
+    """, n_partitions=4, use_pallas=False)
+    pids = np.array([0, 1, 0, 1], np.int32)
+    vals = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    ts = 1_000_000 + np.arange(4, dtype=np.int64)
+    block = pack_blocks(pids, {"k": pids.astype(np.float32), "x": vals},
+                        ts, np.zeros(4, np.int32), 4, base_ts=1_000_000)
+    c.process_block(block)
+    agg = c.current_aggregates()
+    assert agg["sx"][0] == pytest.approx(4.0)
+    assert agg["ax"][1] == pytest.approx(3.0)
+    assert agg["n"][0] == 2
