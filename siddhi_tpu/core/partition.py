@@ -249,6 +249,15 @@ class PartitionRuntime:
             if sid.startswith("#"):
                 continue
             self.partitioned_streams.append(sid)
+
+        # device mode: partition keys become lanes of one NFA state slab
+        # instead of per-key runtime clones (the TPU replacement for
+        # PartitionRuntime.java:255-308's cloneIfNotExist)
+        self.device_mode = False
+        self.device_query_runtimes: Dict[str, QueryRuntime] = {}
+        self.fallback_reason: Optional[str] = None
+        if self._try_device_mode():
+            return
         # parse queries once so global output streams exist before any key
         # arrives (reference: QueryParser runs per partition query at build
         # time, creating inferred output definitions)
@@ -268,6 +277,56 @@ class PartitionRuntime:
             self.purge_interval_ms = _parse_time_str(
                 purge.get("interval", "1 min"))
             self._schedule_purge()
+
+    def _try_device_mode(self) -> bool:
+        """Compile every partition query onto keyed device lanes; any
+        incompatibility rolls back cleanly to the host clone machinery."""
+        from ..plan.planner import engine_mode
+        from ..query_api import StateInputStream
+
+        app = self.app_runtime
+        mode = engine_mode(app.app)
+        reject = None
+        if mode == "host":
+            reject = "engine mode 'host'"
+        elif find_annotation(self.partition.annotations, "purge") is not None:
+            reject = "@purge needs host per-key instances"
+        else:
+            for q in self.partition.queries:
+                if not isinstance(q.input_stream, StateInputStream):
+                    reject = "non-pattern partition query"
+                    break
+                ids = set(q.input_stream.all_stream_ids())
+                if not ids <= set(self.executors):
+                    reject = "partition query reads a non-partitioned stream"
+                    break
+        if reject is not None:
+            if mode == "device":
+                raise SiddhiAppCreationError(
+                    f"engine mode 'device': partition not compilable "
+                    f"({reject})")
+            self.fallback_reason = reject
+            return False
+        try:
+            for i, q in enumerate(self.partition.queries):
+                name = q.name or f"{self.name}_query_{i}"
+                qr = QueryRuntime(q, app, name,
+                                  device_key_executors=self.executors)
+                self.device_query_runtimes[name] = qr
+                for cb in self.pending_callbacks.get(name, []):
+                    qr.add_callback(cb)
+            self.device_mode = True
+            return True
+        except SiddhiAppCreationError as e:
+            if mode == "device":
+                raise
+            # roll back partial junction subscriptions before host fallback
+            for qr in self.device_query_runtimes.values():
+                for sid, recv in qr.receivers.items():
+                    app.junction_of(sid).unsubscribe(recv)
+            self.device_query_runtimes = {}
+            self.fallback_reason = str(e)
+            return False
 
     @staticmethod
     def _input_stream_ids(q: Query) -> List[str]:
@@ -290,6 +349,8 @@ class PartitionRuntime:
         return inst
 
     def query_runtime_by_name(self, target: str):
+        if self.device_mode:
+            return self.device_query_runtimes.get(target)
         for q in self.partition.queries:
             if q.name == target:
                 return _CallbackProxy(self, target)
@@ -314,6 +375,11 @@ class PartitionRuntime:
     # ------------------------------------------------------------ snapshot
 
     def current_state(self):
+        if self.device_mode:
+            return {"device": {
+                qname: {eid: obj.current_state()
+                        for eid, obj in qr.stateful_elements()}
+                for qname, qr in self.device_query_runtimes.items()}}
         out = {}
         with self.lock:
             for key, inst in self.instances.items():
@@ -325,6 +391,16 @@ class PartitionRuntime:
         return {"keys": out}
 
     def restore_state(self, state):
+        if self.device_mode:
+            for qname, elems in state.get("device", {}).items():
+                qr = self.device_query_runtimes.get(qname)
+                if qr is None:
+                    continue
+                live = dict(qr.stateful_elements())
+                for eid, s in elems.items():
+                    if eid in live and s is not None:
+                        live[eid].restore_state(s)
+            return
         with self.lock:
             for key, qstates in state["keys"].items():
                 inst = self.instance_of(key)

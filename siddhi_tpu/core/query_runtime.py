@@ -60,7 +60,8 @@ class ProcessStreamReceiver:
 
 class QueryRuntime:
     def __init__(self, query: Query, app_runtime, query_name: str,
-                 partition_key: Optional[str] = None):
+                 partition_key: Optional[str] = None,
+                 device_key_executors: Optional[Dict] = None):
         self.query = query
         self.app_runtime = app_runtime
         self.name = query_name
@@ -72,6 +73,10 @@ class QueryRuntime:
         self.receivers: Dict[str, ProcessStreamReceiver] = {}
         self.state_runtime = None          # set for pattern/sequence queries
         self.join_runtime = None
+        self.device_runtime = None         # set when the planner picked TPU
+        self.backend = "host"
+        self.backend_reason: Optional[str] = None
+        self._device_key_executors = device_key_executors
         self.output_definition: Optional[StreamDefinition] = None
         self._build()
 
@@ -94,9 +99,30 @@ class QueryRuntime:
             from .join import JoinRuntime
             self.join_runtime = JoinRuntime(self, q.input_stream, factory)
         elif isinstance(q.input_stream, StateInputStream):
-            from .pattern import StateStreamRuntime
-            self.state_runtime = StateStreamRuntime(self, q.input_stream,
-                                                    factory)
+            if self._device_key_executors is not None:
+                # keyed (partition) mode: device or raise — the caller
+                # (PartitionRuntime) owns the host fallback, because a host
+                # fallback HERE would wire an unpartitioned state runtime
+                from ..plan.planner import DevicePatternRuntime
+                self.device_runtime = DevicePatternRuntime(
+                    self, q.input_stream, factory,
+                    key_executors=self._device_key_executors)
+                self.backend = "device"
+                return
+            dev, reason = None, "inside host partition clone"
+            if self.partition_key is None and \
+                    getattr(app, "app", None) is not None:
+                from ..plan.planner import plan_state_runtime
+                dev, reason = plan_state_runtime(self, q.input_stream,
+                                                 factory)
+            if dev is not None:
+                self.device_runtime = dev
+                self.backend = "device"
+            else:
+                self.backend_reason = reason
+                from .pattern import StateStreamRuntime
+                self.state_runtime = StateStreamRuntime(self, q.input_stream,
+                                                        factory)
         else:
             raise SiddhiAppCreationError(
                 f"Unsupported input stream {type(q.input_stream).__name__}")
@@ -166,12 +192,29 @@ class QueryRuntime:
             # table on/set expressions may qualify by the source stream name
             self.output_definition.source_alias = \
                 q.input_stream.stream_ref or q.input_stream.stream_id
+        self._finish_output_tail(factory)
+
+    def _finish_output_tail(self, factory):
+        """Rate limiter + output callback (shared by host and device
+        chains); requires self.output_definition."""
+        q = self.query
+        app = self.app_runtime
         group_names = [v.attribute for v in q.selector.group_by]
         self.rate_limiter = build_rate_limiter(q.output_rate, app.app_ctx,
                                                group_names)
         self.output_processor = self._make_output(q, factory)
         self.output_processor.query_name = self.name
         self.output_processor.app_ctx = app.app_ctx
+
+    def _finish_device_chain(self, output_definition: StreamDefinition,
+                             factory):
+        """Output tail for a device-compiled query (the select clause is
+        folded into the device kernel's capture decode); returns the chain
+        head the device runtime feeds."""
+        self.output_definition = output_definition
+        self._finish_output_tail(factory)
+        self.rate_limiter.next = self.output_processor
+        return self.rate_limiter
 
     def _make_output(self, q: Query, factory) -> OutputCallbackProcessor:
         app = self.app_runtime
@@ -232,6 +275,8 @@ class QueryRuntime:
             out.append((f"{self.name}:window:{i}", w))
         if self.state_runtime is not None:
             out.append((f"{self.name}:state", self.state_runtime))
+        if self.device_runtime is not None:
+            out.append((f"{self.name}:state", self.device_runtime))
         if self.join_runtime is not None:
             for i, w in enumerate(self.join_runtime.windows):
                 out.append((f"{self.name}:join:{i}", w))

@@ -72,6 +72,12 @@ def make_carry(spec: NfaSpec, n_partitions: int) -> Dict[str, jnp.ndarray]:
         carry["acc_ctr"] = jnp.zeros((P,), jnp.int32)
         carry["acc_caps"] = jnp.zeros((P, max(C, 1)), jnp.float32)
         carry["acc_ts"] = jnp.zeros((P,), jnp.int32)
+        # a PATTERN leading-kleene chain is single-shot: the one initial
+        # partial accumulates, forwards exactly at min, and dies at max or
+        # on within expiry — PATTERN start states are never re-initialised
+        # (StreamPreStateProcessor.resetState runs only for SEQUENCE) and
+        # the `every` re-arm clone can never re-reach min
+        carry["acc_dead"] = jnp.zeros((P,), jnp.bool_)
     if not spec.is_every:
         carry["armed_total"] = jnp.zeros((P,), jnp.int32)
     return carry
@@ -104,6 +110,37 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
         slot_state = jnp.where(expired, -1, slot_state)
         active = slot_state >= 0
 
+    ev_caps = _event_capture_matrix(spec, event)          # [S, C]
+    out_carry = {}
+
+    # --- leading kleene: append to the accumulator BEFORE evaluating later
+    # conditions (the reference's count pre-state runs first in unit order,
+    # and the chain object is shared with slots waiting on later states) ---
+    if spec.count0_min is not None:
+        acc_ctr = carry["acc_ctr"]
+        acc_caps = carry["acc_caps"]
+        acc_ts = carry["acc_ts"]
+        acc_dead = carry["acc_dead"]
+        if spec.within_ms is not None:
+            acc_dead = acc_dead | \
+                ((acc_ctr > 0) & (ts - acc_ts > spec.within_ms))
+        # condition 0 never reads captures → uniform over K; take lane 0
+        c0 = valid & (stream == spec.state_streams[0]) & ~acc_dead & \
+            spec.cond_fns[0](event, captures)[0]
+        ctr2 = jnp.where(c0, acc_ctr + 1, acc_ctr)
+        fresh = c0 & (ctr2 == 1)
+        lane_is_last = jnp.arange(C) >= spec.n_first_lanes
+        acc_caps = jnp.where(
+            fresh | (c0 & lane_is_last), ev_caps[0], acc_caps)
+        acc_ts = jnp.where(fresh, ts, acc_ts)
+        # live last-bank append under the armed slot while the chain grows
+        # (the reference shares one StateEvent object between the kleene
+        # chain and the next state's pending list)
+        wl = (c0 & (slot_state == 1))[:, None, None] & \
+            (jnp.arange(S)[None, :, None] == 0) & \
+            lane_is_last[None, None, :]
+        captures = jnp.where(wl, ev_caps[0][None, None, :], captures)
+
     # evaluate every state's condition against this event for all K slots
     cond = jnp.stack([fn(event, captures) for fn in spec.cond_fns], axis=1)
     # [K, S] → gate each slot on its own pending state
@@ -113,7 +150,6 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
     advance = active & stream_ok & slot_cond & valid
 
     # write captures for advancing slots at their pending state
-    ev_caps = _event_capture_matrix(spec, event)          # [S, C]
     write = advance[:, None, None] & \
         (jnp.arange(S)[None, :, None] == idx[:, None, None])
     captures = jnp.where(write, ev_caps[None, :, :], captures)
@@ -129,32 +165,23 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
     new_state = jnp.where(completed, -1, new_state)
 
     # --- arming a fresh partial (reference `every` re-arm / start init) ---
-    # condition 0 never reads captures, so row 0 of cond is uniform over K
-    c0 = valid & (stream == spec.state_streams[0]) & cond[0, 0]
-    out_carry = {}
     if spec.count0_min is None:
+        # condition 0 never reads captures, so row 0 of cond is uniform
+        c0 = valid & (stream == spec.state_streams[0]) & cond[0, 0]
         arm = c0
         arm_caps0 = ev_caps[0]                 # [C]
         arm_ts = ts
     else:
-        # leading kleene accumulator (reference CountPreStateProcessor:
-        # one accumulating partial per partition; forwards at min count)
-        acc_ctr = carry["acc_ctr"]
-        acc_caps = carry["acc_caps"]
-        acc_ts = carry["acc_ts"]
-        if spec.within_ms is not None:
-            acc_dead = (acc_ctr > 0) & (ts - acc_ts > spec.within_ms)
-            acc_ctr = jnp.where(acc_dead, 0, acc_ctr)
-        ctr2 = jnp.where(c0, acc_ctr + 1, acc_ctr)
-        fresh = c0 & (ctr2 == 1)
-        lane_is_last = jnp.arange(C) >= spec.n_first_lanes
-        acc_caps = jnp.where(
-            fresh | (c0 & lane_is_last), ev_caps[0], acc_caps)
-        acc_ts = jnp.where(fresh, ts, acc_ts)
-        arm = c0 & (ctr2 >= spec.count0_min)
-        out_carry["acc_ctr"] = jnp.where(arm, 0, ctr2)
+        # reference CountPostStateProcessor: forward exactly at min count;
+        # the chain keeps growing (NOT reset by the forward) and freezes at
+        # max (stateChanged removes it) — arming is intrinsically single-shot
+        arm = c0 & (ctr2 == spec.count0_min)
+        hit_max = (c0 & (ctr2 == spec.count0_max)
+                   if (spec.count0_max or 0) > 0 else jnp.bool_(False))
+        out_carry["acc_ctr"] = ctr2
         out_carry["acc_caps"] = acc_caps
         out_carry["acc_ts"] = acc_ts
+        out_carry["acc_dead"] = acc_dead | hit_max
         arm_caps0 = acc_caps
         arm_ts = acc_ts
     if not spec.is_every:
@@ -266,15 +293,20 @@ def make_bank_carry(spec: NfaSpec, n_patterns: int,
 
 def pack_blocks(partition_ids: np.ndarray, columns: Dict[str, np.ndarray],
                 timestamps: np.ndarray, stream_codes: np.ndarray,
-                n_partitions: int, base_ts: int = 0) -> Dict[str, np.ndarray]:
+                n_partitions: int, base_ts: int = 0,
+                pad_t_pow2: bool = False) -> Dict[str, np.ndarray]:
     """Host-side: scatter a flat event batch into dense [P, T] lanes
-    (T = max events of any partition in the batch; padding masked invalid).
+    (T = max events of any partition in the batch; padding masked invalid;
+    pad_t_pow2 rounds T up to a power of two so jit sees few distinct
+    shapes).
 
     This is the columnar replacement for the reference's per-key junction
     routing (partition/PartitionStreamReceiver.java:83-153)."""
     from ..native_ext import assign_rows
     n = len(partition_ids)
     row, _counts, T = assign_rows(partition_ids, n_partitions)
+    if pad_t_pow2:
+        T = 1 << (T - 1).bit_length()
     block: Dict[str, np.ndarray] = {}
     for name, col in columns.items():
         out = np.zeros((n_partitions, T), np.float32)

@@ -59,6 +59,10 @@ def _flatten_chain(sis: StateInputStream):
                 raise SiddhiAppCreationError(
                     "TPU NFA path supports kleene counts only on the first "
                     "chain element (A<m:n> -> B -> ...)")
+            if not el.min_count or el.min_count < 1:
+                raise SiddhiAppCreationError(
+                    "TPU NFA path: kleene min count must be >= 1 "
+                    "(zero-occurrence matches need the host oracle)")
             count0[0] = (el.min_count, el.max_count)
             return el.state
         return el
@@ -118,12 +122,14 @@ def _walk_filter_constants(states) -> List:
 class CompiledPatternNFA:
     """One pattern query compiled for batched multi-partition execution."""
 
-    def __init__(self, app_string: str, n_partitions: int,
+    def __init__(self, app_string, n_partitions: int,
                  n_slots: int = 8, query_name: Optional[str] = None,
-                 parameterize: bool = False):
-        app = SiddhiCompiler.parse(app_string)
+                 parameterize: bool = False, query: Optional[Query] = None):
+        app = (SiddhiCompiler.parse(app_string)
+               if isinstance(app_string, str) else app_string)
         self.app = app
-        query = self._pick_query(app, query_name)
+        if query is None:
+            query = self._pick_query(app, query_name)
         sis = query.input_stream
         if not isinstance(sis, StateInputStream) or \
                 sis.state_type != StateType.PATTERN:
@@ -191,6 +197,10 @@ class CompiledPatternNFA:
             idx = ref_to_idx.get(var.stream_id)
             if idx is None or idx == current_idx:
                 return
+            if var.attribute not in self.attr_types:
+                raise SiddhiAppCreationError(
+                    f"TPU NFA path: captured attribute "
+                    f"'{var.stream_id}.{var.attribute}' is not numeric")
             (needed_f if which_of(var, idx) == "f" else
              needed_l)[idx].add(var.attribute)
 
@@ -217,6 +227,10 @@ class CompiledPatternNFA:
                     "TPU NFA path: select must be captured attributes "
                     "(e1.attr as name)")
             idx = ref_to_idx[e.stream_id]
+            if e.attribute not in self.attr_types:
+                raise SiddhiAppCreationError(
+                    f"TPU NFA path: selected attribute "
+                    f"'{e.stream_id}.{e.attribute}' is not numeric")
             w = which_of(e, idx)
             (needed_f if w == "f" else needed_l)[idx].add(e.attribute)
             self.select_outputs.append((oa.rename, idx, e.attribute, w))
@@ -272,16 +286,18 @@ class CompiledPatternNFA:
         self._step = jax.jit(build_block_step(self.spec), donate_argnums=0)
         self.base_ts: Optional[int] = None
 
-        # capture lanes ride float32: LONG values above 2**24 round silently
+        # capture lanes ride float32: INT/LONG values above 2**24 round
+        # silently
         import warnings
         warned = set()
         for (_j, a, _w) in self.cap_lane:
-            if self.attr_types.get(a) == AttrType.LONG and a not in warned:
+            if self.attr_types.get(a) in (AttrType.INT, AttrType.LONG) and \
+                    a not in warned:
                 warned.add(a)
                 warnings.warn(
-                    f"TPU NFA path: LONG attribute '{a}' rides a float32 "
-                    f"capture lane; values above 2**24 lose precision on "
-                    f"decode", stacklevel=2)
+                    f"TPU NFA path: {self.attr_types[a].name} attribute "
+                    f"'{a}' rides a float32 capture lane; values above "
+                    f"2**24 lose precision on decode", stacklevel=2)
 
     @staticmethod
     def _pick_query(app, query_name) -> Query:
@@ -390,6 +406,56 @@ class CompiledPatternNFA:
 
     # ------------------------------------------------------------ execution
 
+    def grow(self, n_partitions: int) -> None:
+        """Widen the partition axis (slab growth for keyed partitioning);
+        existing lane state is preserved, new lanes start empty."""
+        if n_partitions <= self.n_partitions:
+            return
+        fresh = make_carry(self.spec, n_partitions - self.n_partitions)
+        self.carry = {k: jnp.concatenate([self.carry[k], fresh[k]], axis=0)
+                      for k in self.carry}
+        self.n_partitions = n_partitions
+
+    def grow_slots(self, n_slots: int) -> None:
+        """Widen the K (concurrent-partials) axis: the host oracle's pending
+        lists are unbounded, so the slot ring must grow rather than drop
+        when a pattern has no `within` bound."""
+        if n_slots <= self.spec.n_slots:
+            return
+        pad = n_slots - self.spec.n_slots
+        c = dict(self.carry)
+        P = self.n_partitions
+        S, C = self.spec.n_states, max(self.spec.n_caps, 1)
+        c["slot_state"] = jnp.concatenate(
+            [c["slot_state"], jnp.full((P, pad), -1, jnp.int32)], axis=1)
+        c["slot_start"] = jnp.concatenate(
+            [c["slot_start"], jnp.zeros((P, pad), jnp.int32)], axis=1)
+        c["captures"] = jnp.concatenate(
+            [c["captures"], jnp.zeros((P, pad, S, C), jnp.float32)], axis=1)
+        self.carry = c
+        self.spec = self.spec._replace(n_slots=n_slots)
+        self._step = jax.jit(build_block_step(self.spec), donate_argnums=0)
+
+    def max_active_slots(self) -> int:
+        """Device reduction: the fullest partition's live-partial count."""
+        return int(jnp.max(jnp.sum(
+            (self.carry["slot_state"] >= 0).astype(jnp.int32), axis=1)))
+
+    def current_state(self) -> Dict[str, Any]:
+        return {"carry": {k: np.asarray(v) for k, v in self.carry.items()},
+                "base_ts": self.base_ts,
+                "n_partitions": self.n_partitions}
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        self.n_partitions = state["n_partitions"]
+        self.carry = {k: jnp.asarray(v) for k, v in state["carry"].items()}
+        self.base_ts = state["base_ts"]
+        k = int(self.carry["slot_state"].shape[1])
+        if k != self.spec.n_slots:    # snapshot taken after slot growth
+            self.spec = self.spec._replace(n_slots=k)
+            self._step = jax.jit(build_block_step(self.spec),
+                                 donate_argnums=0)
+
     def process_block(self, block: Dict[str, np.ndarray]):
         """Run one [P, T] packed block; returns decoded matches."""
         self.carry, (mask, caps, ts) = self._step(self.carry, block)
@@ -398,7 +464,9 @@ class CompiledPatternNFA:
     def process_events(self, partition_ids: np.ndarray,
                        columns: Dict[str, np.ndarray],
                        timestamps: np.ndarray,
-                       stream_names: Optional[np.ndarray] = None):
+                       stream_names: Optional[np.ndarray] = None,
+                       stream_codes: Optional[np.ndarray] = None,
+                       pad_t_pow2: bool = False):
         """Flat event batch → packed lanes → device step → decoded matches.
 
         Returns a list of (partition, match_ts, {out_name: value})."""
@@ -407,7 +475,9 @@ class CompiledPatternNFA:
         if len(timestamps):
             self._maybe_rebase(int(np.min(timestamps)),
                                int(np.max(timestamps)))
-        if stream_names is None:
+        if stream_codes is not None:
+            codes = np.asarray(stream_codes, np.int32)
+        elif stream_names is None:
             codes = np.zeros(len(partition_ids), np.int32)
         else:
             codes = np.asarray([self.stream_codes[s] for s in stream_names],
@@ -415,7 +485,8 @@ class CompiledPatternNFA:
         cols = {a: np.asarray(columns[a]) for a in self.attr_names}
         block = pack_blocks(np.asarray(partition_ids), cols,
                             np.asarray(timestamps), codes,
-                            self.n_partitions, base_ts=self.base_ts)
+                            self.n_partitions, base_ts=self.base_ts,
+                            pad_t_pow2=pad_t_pow2)
         mask, caps, ts = self.process_block(block)
         return self.decode_matches(mask, caps, ts)
 

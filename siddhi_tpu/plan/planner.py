@@ -1,0 +1,219 @@
+"""Device/host query planner — routes each query to compiled TPU execution
+or the host oracle.
+
+This is the role the reference's QueryParser plays (util/parser/
+QueryParser.java:83-249: object model → runtime graph); here the planner
+additionally *chooses a backend* per query: pattern chains lower to the
+batched NFA kernel (plan/nfa_compiler.py + ops/nfa.py), anything the device
+path cannot express falls back to the host oracle with a recorded reason.
+
+Engine selection:
+  - `@app:engine('host'|'device'|'auto')` app annotation, else
+  - env `SIDDHI_TPU_ENGINE`, else 'auto'.
+  'auto'   — try the device compile, silently fall back to host.
+  'device' — device or raise (surface the incompatibility).
+  'host'   — never touch the device (the conformance oracle runs this way).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..query_api import StateInputStream, find_annotation
+from ..query_api.definition import Attribute, StreamDefinition
+from ..utils.errors import SiddhiAppCreationError
+from .nfa_compiler import CompiledPatternNFA
+
+ENGINE_ENV = "SIDDHI_TPU_ENGINE"
+DEFAULT_SLOTS = 8
+GROW_START = 8          # initial keyed-lane capacity (doubles on demand)
+
+
+def engine_mode(app) -> str:
+    ann = find_annotation(app.annotations, "app:engine") or \
+        find_annotation(app.annotations, "engine")
+    if ann is not None:
+        pos = ann.positional()
+        mode = str(pos[0] if pos else ann.get("mode", "auto")).lower()
+    else:
+        mode = os.environ.get(ENGINE_ENV, "auto").lower()
+    if mode not in ("auto", "device", "host"):
+        raise SiddhiAppCreationError(f"Unknown engine mode '{mode}'")
+    return mode
+
+
+class _DeviceIngress:
+    """Junction-side adapter: one per input stream of a device query.
+    Looks like a Processor head so ProcessStreamReceiver wraps it with the
+    query lock / latency tracker / debugger IN check."""
+
+    def __init__(self, runtime: "DevicePatternRuntime", stream_code: int,
+                 stream_id: str):
+        self.runtime = runtime
+        self.stream_code = stream_code
+        self.stream_id = stream_id
+        self.next = None
+
+    def process(self, chunk):
+        self.runtime.ingest(self.stream_code, self.stream_id, chunk)
+
+
+class DevicePatternRuntime:
+    """Pattern query running on the batched NFA kernel.
+
+    Non-partitioned queries run a single lane (P=1); keyed mode (driven by
+    core/partition.py) maps partition-key values to lanes of a slab that
+    doubles on demand — the device replacement for the reference's per-key
+    runtime clones (partition/PartitionRuntime.java:255-308).
+    """
+
+    backend = "device"
+
+    def __init__(self, query_runtime, sis: StateInputStream, factory,
+                 key_executors: Optional[Dict[str, Any]] = None,
+                 n_slots: int = DEFAULT_SLOTS):
+        from ..core.event import dtype_for
+        from ..core.query_runtime import ProcessStreamReceiver
+
+        qr = query_runtime
+        app = qr.app_runtime
+        q = qr.query
+        sel = q.selector
+        if sel.group_by or sel.having is not None or sel.order_by or \
+                sel.limit is not None or sel.offset is not None:
+            raise SiddhiAppCreationError(
+                "device pattern path: group-by/having/order-by/limit are "
+                "host-only")
+        self.keyed = key_executors is not None
+        self.key_executors = key_executors or {}
+        capacity = GROW_START if self.keyed else 1
+        self.nfa = CompiledPatternNFA(app.app, n_partitions=capacity,
+                                      n_slots=n_slots, query=q)
+        self.key_lanes: Dict[Any, int] = {}
+        self.qr = qr
+        self._dtype_for = dtype_for
+        # host-side upper bound on the fullest lane's live partials; when a
+        # chunk could overflow the slot ring, sync the true count and grow —
+        # the host oracle's pending lists are unbounded, drops would lose
+        # matches
+        self._ub_active = 0
+
+        # output definition straight from the capture-decode plan
+        target = getattr(q.output_stream, "target_id", "") or qr.name
+        attrs = [Attribute(name, self.nfa.attr_types[attr])
+                 for (name, _idx, attr, _w) in self.nfa.select_outputs]
+        out_def = StreamDefinition(target, attrs)
+        self.head = qr._finish_device_chain(out_def, factory)
+
+        # one receiver per distinct input stream, on the global junctions
+        for stream_id, code in self.nfa.stream_codes.items():
+            recv = ProcessStreamReceiver(
+                _DeviceIngress(self, code, stream_id), qr.lock,
+                app.latency_tracker_for(qr.name), qr.name, app.app_ctx)
+            app.junction_of(stream_id).subscribe(recv)
+            qr.receivers[stream_id] = recv
+
+    # ------------------------------------------------------------ ingest
+
+    def _lanes_for_keys(self, keys: List[Any]) -> np.ndarray:
+        lanes = np.empty(len(keys), np.int64)
+        for i, k in enumerate(keys):
+            lane = self.key_lanes.get(k)
+            if lane is None:
+                lane = len(self.key_lanes)
+                self.key_lanes[k] = lane
+            lanes[i] = lane
+        if self.key_lanes and len(self.key_lanes) > self.nfa.n_partitions:
+            cap = self.nfa.n_partitions
+            while cap < len(self.key_lanes):
+                cap *= 2
+            self.nfa.grow(cap)
+        return lanes
+
+    def ingest(self, stream_code: int, stream_id: str, chunk) -> None:
+        from ..core.event import CURRENT, EventChunk
+        data = chunk.only(CURRENT)
+        if data.is_empty:
+            return
+        n = len(data)
+        if self.keyed:
+            ex = self.key_executors.get(stream_id)
+            if ex is None:
+                raise SiddhiAppCreationError(
+                    f"device pattern path: stream '{stream_id}' has no "
+                    f"partition key executor")
+            keys = ex.keys(data)
+            keep = np.asarray([k is not None for k in keys], bool)
+            if not keep.all():
+                data = data.mask(keep)
+                keys = [k for k in keys if k is not None]
+                n = len(data)
+                if n == 0:
+                    return
+            pids = self._lanes_for_keys(keys)
+        else:
+            pids = np.zeros(n, np.int64)
+        t_max = int(np.bincount(pids, minlength=1).max())
+        if self._ub_active + t_max > self.nfa.spec.n_slots:
+            actual = self.nfa.max_active_slots()
+            need = actual + t_max
+            if need > self.nfa.spec.n_slots:
+                self.nfa.grow_slots(1 << (need - 1).bit_length())
+            self._ub_active = actual
+        self._ub_active = min(self._ub_active + t_max, self.nfa.spec.n_slots)
+        cols = {}
+        for a in self.nfa.attr_names:
+            col = data.columns.get(a)
+            cols[a] = (np.asarray(col, np.float32) if col is not None
+                       else np.zeros(n, np.float32))
+        matches = self.nfa.process_events(
+            pids, cols, np.asarray(data.timestamps, np.int64),
+            stream_codes=np.full(n, stream_code, np.int32),
+            pad_t_pow2=True)
+        if not matches:
+            return
+        names = [o[0] for o in self.nfa.select_outputs]
+        out_cols: Dict[str, np.ndarray] = {}
+        for (name, _idx, attr, _w) in self.nfa.select_outputs:
+            dt = self._dtype_for(self.nfa.attr_types[attr])
+            out_cols[name] = np.asarray([m[2][name] for m in matches], dt)
+        ts = np.asarray([m[1] for m in matches], np.int64)
+        self.head.process(EventChunk.from_columns(names, ts, out_cols))
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        pass
+
+    # ------------------------------------------------------------ snapshot
+
+    def current_state(self) -> dict:
+        return {"nfa": self.nfa.current_state(),
+                "key_lanes": dict(self.key_lanes)}
+
+    def restore_state(self, state: dict) -> None:
+        self.nfa.restore_state(state["nfa"])
+        self.key_lanes = dict(state["key_lanes"])
+        # force the overflow guard to re-sync against the restored carry
+        self._ub_active = self.nfa.spec.n_slots
+
+
+def plan_state_runtime(query_runtime, sis: StateInputStream, factory):
+    """Try the device pattern compile for a query; (runtime, reason) where
+    exactly one side is None.  'host' mode short-circuits; 'device' mode
+    re-raises the incompatibility instead of falling back.  (The keyed
+    partition path constructs DevicePatternRuntime directly — a host
+    fallback at the query level would wire an unpartitioned runtime.)"""
+    app = query_runtime.app_runtime
+    mode = engine_mode(app.app)
+    if mode == "host":
+        return None, "engine mode 'host'"
+    try:
+        return DevicePatternRuntime(query_runtime, sis, factory), None
+    except SiddhiAppCreationError as e:
+        if mode == "device":
+            raise
+        return None, str(e)

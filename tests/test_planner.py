@@ -1,0 +1,242 @@
+"""Device/host planner: backend routing, fallback reasons, and
+backend-identical output for a corpus of pattern apps run through the
+PUBLIC SiddhiQL API on both engines (VERDICT r1 item 1: the quick-start
+path must execute on device with no API change)."""
+import numpy as np
+import pytest
+
+from siddhi_tpu import QueryCallback, SiddhiManager, StreamCallback
+from siddhi_tpu.utils.errors import SiddhiAppCreationError
+
+CORPUS = [
+    # (name, app, streams→rows)
+    ("chain2", """
+        define stream A (k int, v float);
+        @info(name='q')
+        from every e1=A[v > 10.0] -> e2=A[v > e1.v]
+        select e1.v as v1, e2.v as v2 insert into Out;
+     """, [("A", [1, 11.0]), ("A", [1, 12.0]), ("A", [1, 5.0]),
+           ("A", [1, 13.0])]),
+    ("chain3_within", """
+        define stream A (k int, v float);
+        @info(name='q')
+        from every e1=A[v > 1.0] -> e2=A[v > e1.v] -> e3=A[v > e2.v]
+            within 1 sec
+        select e1.v as v1, e2.v as v2, e3.v as v3 insert into Out;
+     """, [("A", [1, 2.0]), ("A", [1, 3.0]), ("A", [1, 4.0]),
+           ("A", [1, 1.5]), ("A", [1, 9.0])]),
+    ("two_streams", """
+        define stream A (v float);
+        define stream B (w float);
+        @info(name='q')
+        from every e1=A[v > 0.0] -> e2=B[w > e1.v]
+        select e1.v as v1, e2.w as v2 insert into Out;
+     """, [("A", [1.0]), ("B", [0.5]), ("B", [2.0]), ("A", [3.0]),
+           ("B", [4.0])]),
+    ("no_every", """
+        define stream A (v float);
+        @info(name='q')
+        from e1=A[v > 10.0] -> e2=A[v > e1.v]
+        select e1.v as v1, e2.v as v2 insert into Out;
+     """, [("A", [11.0]), ("A", [12.0]), ("A", [13.0])]),
+    ("leading_count", """
+        define stream A (v float);
+        @info(name='q')
+        from every e1=A[v > 0.0]<2:4> -> e2=A[v < 0.0]
+        select e1[0].v as first_v, e2.v as last_v insert into Out;
+     """, [("A", [1.0]), ("A", [2.0]), ("A", [-1.0]), ("A", [3.0]),
+           ("A", [4.0]), ("A", [-2.0])]),
+]
+
+
+def run_app(app, sends, engine=None):
+    prefix = f"@app:engine('{engine}') " if engine else ""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(prefix + app)
+    out = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: out.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    ts = 1_000_000
+    for sid, row in sends:
+        rt.get_input_handler(sid).send(row, timestamp=ts)
+        ts += 100
+    backend = rt.query_runtimes["q"].backend
+    rt.shutdown()
+    return backend, out
+
+
+@pytest.mark.parametrize("name,app,sends", CORPUS,
+                         ids=[c[0] for c in CORPUS])
+def test_backend_identical_output(name, app, sends):
+    bh, host = run_app(app, sends, engine="host")
+    bd, dev = run_app(app, sends)            # auto → device for this corpus
+    assert bh == "host"
+    assert bd == "device", f"{name} did not plan onto the device"
+    assert host == dev
+
+
+def test_unsupported_shapes_fall_back_with_reason():
+    cases = {
+        "string_select": """
+            define stream A (s string, v float);
+            @info(name='q')
+            from every e1=A[v > 1.0] -> e2=A[v > e1.v]
+            select e1.s as s1, e2.v as v2 insert into Out;
+        """,
+        "logical_and": """
+            define stream A (v float);
+            define stream B (w float);
+            @info(name='q')
+            from every (e1=A[v > 0.0] and e2=B[w > 0.0]) -> e3=A[v > 10.0]
+            select e1.v as v1, e3.v as v3 insert into Out;
+        """,
+        "absent": """
+            define stream A (v float);
+            define stream B (w float);
+            @info(name='q')
+            from every e1=A[v > 0.0] -> not B[w > e1.v] for 1 sec
+            select e1.v as v1 insert into Out;
+        """,
+        "sequence": """
+            define stream A (v float);
+            @info(name='q')
+            from every e1=A[v > 0.0], e2=A[v > e1.v]
+            select e1.v as v1, e2.v as v2 insert into Out;
+        """,
+    }
+    for name, app in cases.items():
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(app)
+        qr = rt.query_runtimes["q"]
+        assert qr.backend == "host", name
+        assert qr.backend_reason, name
+        rt.shutdown()
+
+
+def test_engine_device_mode_raises_on_unsupported():
+    m = SiddhiManager()
+    with pytest.raises(SiddhiAppCreationError):
+        m.create_siddhi_app_runtime("""
+            @app:engine('device')
+            define stream A (s string, v float);
+            @info(name='q')
+            from every e1=A[v > 1.0] -> e2=A[v > e1.v]
+            select e1.s as s1 insert into Out;
+        """)
+
+
+def test_device_pattern_query_callback_and_int_types():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream A (k int, v float);
+        @info(name='q')
+        from every e1=A[v > 10.0] -> e2=A[v > e1.v]
+        select e1.k as k1, e2.v as v2 insert into Out;
+    """)
+    assert rt.query_runtimes["q"].backend == "device"
+    got = []
+    rt.add_callback("q", QueryCallback(
+        lambda ts, cur, exp: got.extend(tuple(e.data) for e in (cur or []))))
+    rt.start()
+    h = rt.get_input_handler("A")
+    h.send([7, 11.0])
+    h.send([8, 12.0])
+    rt.shutdown()
+    assert got == [(7, 12.0)]
+    assert isinstance(got[0][0], int)
+
+
+def test_device_pattern_persistence_roundtrip():
+    from siddhi_tpu import InMemoryPersistenceStore
+    app = """
+        define stream A (v float);
+        @info(name='q')
+        from every e1=A[v > 10.0] -> e2=A[v > e1.v]
+        select e1.v as v1, e2.v as v2 insert into Out;
+    """
+    m = SiddhiManager()
+    m.set_persistence_store(InMemoryPersistenceStore())
+    rt = m.create_siddhi_app_runtime(app)
+    assert rt.query_runtimes["q"].backend == "device"
+    rt.start()
+    rt.get_input_handler("A").send([11.0], timestamp=1_000_000)
+    rev = rt.persist()
+    rt.shutdown()
+
+    rt2 = m.create_siddhi_app_runtime(app)
+    out = []
+    rt2.add_callback("Out", StreamCallback(
+        lambda evs: out.extend(tuple(e.data) for e in evs)))
+    rt2.start()
+    rt2.restore_revision(rev)
+    rt2.get_input_handler("A").send([12.0], timestamp=1_000_100)
+    rt2.shutdown()
+    assert out == [(11.0, 12.0)]     # partial armed pre-snapshot completes
+
+
+PART_APP = """
+    define stream S (sym int, price float, kind int);
+    partition with (sym of S) begin
+    @info(name='q')
+    from every e1=S[kind == 0 and price > 50.0]
+        -> e2=S[kind == 1 and price > e1.price]
+    select e1.price as p1, e2.price as p2
+    insert into Out;
+    end;
+"""
+
+
+def run_partition(app, rows, engine=None):
+    prefix = (f"@app:engine('{engine}') " if engine else "") + \
+        "@app:playback "
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(prefix + app)
+    out = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: out.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    h = rt.get_input_handler("S")
+    ts = 1_000_000
+    for r in rows:
+        h.send(r, timestamp=ts)
+        ts += 10
+    dm = rt.partition_runtimes[0].device_mode
+    rt.shutdown()
+    return dm, out
+
+
+def test_partitioned_pattern_device_parity():
+    """Keys become NFA lanes (slab grows past the initial capacity of 8);
+    output must equal the host per-key clone machinery exactly."""
+    rng = np.random.default_rng(11)
+    rows = [[int(rng.integers(0, 13)), float(rng.uniform(0, 100)),
+             int(rng.integers(0, 2))] for _ in range(180)]
+    dm_h, host = run_partition(PART_APP, rows, engine="host")
+    dm_d, dev = run_partition(PART_APP, rows)
+    assert not dm_h and dm_d
+    assert sorted(host) == sorted(dev)
+    assert len(dev) > 0
+
+
+def test_partition_purge_falls_back_to_host():
+    app = PART_APP.replace("partition with",
+                           "@purge(enable='true', interval='1 min', "
+                           "idle.period='5 min') partition with")
+    dm, _ = run_partition(app, [[0, 60.0, 0], [0, 70.0, 1]])
+    assert not dm
+
+
+def test_partition_non_pattern_query_falls_back():
+    app = """
+        define stream S (sym int, price float);
+        partition with (sym of S) begin
+        @info(name='q')
+        from S[price > 0.0] select sym, price insert into Out;
+        end;
+    """
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    assert not rt.partition_runtimes[0].device_mode
+    assert rt.partition_runtimes[0].fallback_reason
+    rt.shutdown()

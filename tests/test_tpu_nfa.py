@@ -42,7 +42,10 @@ def oracle_matches(app, events_by_partition):
     out = []
     for p, events in events_by_partition.items():
         m = SiddhiManager()
-        rt = m.create_siddhi_app_runtime("@app:playback " + app)
+        # pin the host engine: this runtime IS the oracle the device path
+        # is checked against
+        rt = m.create_siddhi_app_runtime(
+            "@app:playback @app:engine('host') " + app)
         got = []
         rt.add_callback("q", QueryCallback(
             lambda ts, cur, exp: got.extend(
@@ -191,21 +194,34 @@ def test_nonevery_chain_single_match():
                          outputs=["p1", "p2"])
 
 
-def test_every_count_greedy_restart_groups():
-    """`every A<3:3> -> B`: kernel groups the A-stream into consecutive
-    triples (documented TPU-path semantics; the reference leaves the
-    every+leading-count combination effectively single-shot)."""
-    import numpy as np
+def test_every_count_single_shot_conformance():
+    """`every A<3:3> -> B` is effectively single-shot in the reference
+    (PATTERN start states never re-init; the every re-arm clone can never
+    re-reach min) — exact conformance vs the oracle."""
     app = APP_COUNT.replace("from e1", "from every e1")
-    n_partitions = 1
-    # A A A B A A A B — two complete groups
-    prices = np.asarray([30, 31, 32, 100, 40, 41, 42, 110], np.float32)
-    kind = np.asarray([0, 0, 0, 1, 0, 0, 0, 1], np.int32)
-    pids = np.zeros(8, np.int64)
-    ts = 1_000_000 + np.arange(8, dtype=np.int64)
-    tpu = run_tpu(app, pids, prices, kind, ts, n_partitions, 8)
+    assert_equal_matches(app, seed=29, n=400, n_partitions=8,
+                         outputs=["p0", "pl", "p2"])
+
+
+def test_count_last_bank_grows_until_max():
+    """Between min-forward and the next state's match the shared chain keeps
+    growing: e1[last] must reflect appends after arming (reference shares
+    the StateEvent object), freezing at max."""
+    import numpy as np
+    app = """
+    define stream S (partition int, price float, kind int);
+    @info(name='q')
+    from e1=S[kind == 0]<2:4> -> e2=S[kind == 1]
+    select e1[0].price as p0, e1[last].price as pl, e2.price as p2
+    insert into Out;
+    """
+    prices = np.asarray([1, 2, 3, 9], np.float32)
+    kind = np.asarray([0, 0, 0, 1], np.int32)
+    pids = np.zeros(4, np.int64)
+    ts = 1_000_000 + np.arange(4, dtype=np.int64)
+    tpu = run_tpu(app, pids, prices, kind, ts, 1, 8)
     got = [(v["p0"], v["pl"], v["p2"]) for _, _, v in tpu]
-    assert got == [(30.0, 32.0, 100.0), (40.0, 42.0, 110.0)]
+    assert got == [(1.0, 3.0, 9.0)]
 
 
 def test_int32_ts_rebase_across_long_streams():
