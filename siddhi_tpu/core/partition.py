@@ -140,6 +140,7 @@ class _PartitionExecutor:
         scope = Scope()
         scope.add_primary(pt.stream_id, None, definition)
         compiler = factory(scope)
+        self.pt = pt
         self.ranges: Optional[List] = None
         if isinstance(pt, ValuePartitionType):
             self.value_expr: Optional[CompiledExpr] = \
@@ -292,13 +293,22 @@ class PartitionRuntime:
         elif find_annotation(self.partition.annotations, "purge") is not None:
             reject = "@purge needs host per-key instances"
         else:
+            from ..query_api import SingleInputStream
             for q in self.partition.queries:
-                if not isinstance(q.input_stream, StateInputStream):
-                    reject = "non-pattern partition query"
+                if not isinstance(q.input_stream,
+                                  (StateInputStream, SingleInputStream)):
+                    reject = "join partition query needs host instances"
                     break
-                ids = set(q.input_stream.all_stream_ids())
+                # _input_stream_ids keeps the '#' prefix, so inner-stream
+                # consumers fail the subset check → host per-key isolation
+                ids = set(self._input_stream_ids(q))
                 if not ids <= set(self.executors):
                     reject = "partition query reads a non-partitioned stream"
+                    break
+                out = q.output_stream
+                if getattr(out, "is_inner", False):
+                    reject = "inner-stream output needs host per-key " \
+                        "instances"
                     break
         if reject is not None:
             if mode == "device":
@@ -376,10 +386,12 @@ class PartitionRuntime:
 
     def current_state(self):
         if self.device_mode:
-            return {"device": {
-                qname: {eid: obj.current_state()
-                        for eid, obj in qr.stateful_elements()}
-                for qname, qr in self.device_query_runtimes.items()}}
+            out = {}
+            for qname, qr in self.device_query_runtimes.items():
+                with qr.lock:      # ingest holds qr.lock, not pr.lock
+                    out[qname] = {eid: obj.current_state()
+                                  for eid, obj in qr.stateful_elements()}
+            return {"device": out}
         out = {}
         with self.lock:
             for key, inst in self.instances.items():
@@ -396,10 +408,11 @@ class PartitionRuntime:
                 qr = self.device_query_runtimes.get(qname)
                 if qr is None:
                     continue
-                live = dict(qr.stateful_elements())
-                for eid, s in elems.items():
-                    if eid in live and s is not None:
-                        live[eid].restore_state(s)
+                with qr.lock:
+                    live = dict(qr.stateful_elements())
+                    for eid, s in elems.items():
+                        if eid in live and s is not None:
+                            live[eid].restore_state(s)
             return
         with self.lock:
             for key, qstates in state["keys"].items():

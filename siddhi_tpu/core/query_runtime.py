@@ -94,6 +94,25 @@ class QueryRuntime:
         factory = self._expr_compiler_factory()
 
         if isinstance(q.input_stream, SingleInputStream):
+            if self._device_key_executors is not None:
+                # keyed (partition) mode: device or raise, as below
+                from ..plan.planner import DeviceWindowedAggRuntime
+                self.device_runtime = DeviceWindowedAggRuntime(
+                    self, q.input_stream, factory,
+                    self._device_key_executors)
+                self.backend = "device"
+                return
+            dev, reason = None, "inside host partition clone"
+            if self.partition_key is None and \
+                    getattr(app, "app", None) is not None:
+                from ..plan.planner import plan_single_runtime
+                dev, reason = plan_single_runtime(self, q.input_stream,
+                                                  factory)
+            if dev is not None:
+                self.device_runtime = dev
+                self.backend = "device"
+                return
+            self.backend_reason = reason
             self._build_single(q.input_stream, factory)
         elif isinstance(q.input_stream, JoinInputStream):
             from .join import JoinRuntime

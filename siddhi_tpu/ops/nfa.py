@@ -294,16 +294,18 @@ def make_bank_carry(spec: NfaSpec, n_patterns: int,
 def pack_blocks(partition_ids: np.ndarray, columns: Dict[str, np.ndarray],
                 timestamps: np.ndarray, stream_codes: np.ndarray,
                 n_partitions: int, base_ts: int = 0,
-                pad_t_pow2: bool = False) -> Dict[str, np.ndarray]:
+                pad_t_pow2: bool = False, return_rows: bool = False):
     """Host-side: scatter a flat event batch into dense [P, T] lanes
     (T = max events of any partition in the batch; padding masked invalid;
     pad_t_pow2 rounds T up to a power of two so jit sees few distinct
-    shapes).
+    shapes).  return_rows additionally yields each input event's row index
+    within its lane (for per-event output decode).
 
     This is the columnar replacement for the reference's per-key junction
     routing (partition/PartitionStreamReceiver.java:83-153)."""
     from ..native_ext import assign_rows
     n = len(partition_ids)
+    partition_ids = np.ascontiguousarray(partition_ids, np.int32)
     row, _counts, T = assign_rows(partition_ids, n_partitions)
     if pad_t_pow2:
         T = 1 << (T - 1).bit_length()
@@ -322,4 +324,6 @@ def pack_blocks(partition_ids: np.ndarray, columns: Dict[str, np.ndarray],
     valid = np.zeros((n_partitions, T), bool)
     valid[partition_ids, row] = True
     block["__valid"] = valid
+    if return_rows:
+        return block, row
     return block

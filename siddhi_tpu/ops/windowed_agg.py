@@ -36,6 +36,7 @@ class WaggCarry(NamedTuple):
     pos: jnp.ndarray       # [P] i32 — next write slot
     cnt: jnp.ndarray       # [P] i32 — entries held (≤ W)
     runsum: jnp.ndarray    # [P] f32
+    comp: jnp.ndarray      # [P] f32 — Kahan compensation for runsum
 
 
 def make_wagg_carry(n_partitions: int, window: int) -> WaggCarry:
@@ -43,7 +44,8 @@ def make_wagg_carry(n_partitions: int, window: int) -> WaggCarry:
         ring=jnp.zeros((n_partitions, window), jnp.float32),
         pos=jnp.zeros((n_partitions,), jnp.int32),
         cnt=jnp.zeros((n_partitions,), jnp.int32),
-        runsum=jnp.zeros((n_partitions,), jnp.float32))
+        runsum=jnp.zeros((n_partitions,), jnp.float32),
+        comp=jnp.zeros((n_partitions,), jnp.float32))
 
 
 # ------------------------------------------------------------------ jnp path
@@ -54,25 +56,30 @@ def build_wagg_step(window: int):
     accepted event (positions with accepted=False repeat the previous)."""
 
     def lane_step(carry, xs):
-        ring, pos, cnt, runsum = carry
+        ring, pos, cnt, runsum, comp = carry
         x, ok = xs
         oh = jnp.arange(window) == pos            # [W]
         old = jnp.sum(ring * oh)
         evict = cnt == window
         delta = x - jnp.where(evict, old, 0.0)
-        runsum2 = jnp.where(ok, runsum + delta, runsum)
+        # Kahan-compensated add: float32 running sums would drift over long
+        # streams of incremental add/subtract updates
+        y = delta - comp
+        t = runsum + y
+        comp2 = jnp.where(ok, (t - runsum) - y, comp)
+        runsum2 = jnp.where(ok, t, runsum)
         ring2 = jnp.where(ok & oh, x, ring)
         pos2 = jnp.where(ok, (pos + 1) % window, pos)
         cnt2 = jnp.where(ok, jnp.minimum(cnt + 1, window), cnt)
-        return (ring2, pos2, cnt2, runsum2), (runsum2, cnt2)
+        return (ring2, pos2, cnt2, runsum2, comp2), (runsum2, cnt2)
 
     def per_lane(carry_l, values_l, ok_l):
         return jax.lax.scan(lane_step, carry_l, (values_l, ok_l))
 
     def step(carry: WaggCarry, values, accepted):
-        (ring, pos, cnt, runsum), (sums, counts) = jax.vmap(per_lane)(
+        (ring, pos, cnt, runsum, comp), (sums, counts) = jax.vmap(per_lane)(
             tuple(carry), values, accepted)
-        return WaggCarry(ring, pos, cnt, runsum), (sums, counts)
+        return WaggCarry(ring, pos, cnt, runsum, comp), (sums, counts)
 
     return step
 
@@ -93,13 +100,15 @@ def build_wagg_step_pallas(window: int, t_per_block: int):
 
     W, T = window, t_per_block
 
-    def kernel(values_ref, ok_ref, ring_in, pos_in, cnt_in, sum_in,
-               ring_out, pos_out, cnt_out, sum_out, sums_ref, counts_ref):
+    def kernel(values_ref, ok_ref, ring_in, pos_in, cnt_in, sum_in, comp_in,
+               ring_out, pos_out, cnt_out, sum_out, comp_out, sums_ref,
+               counts_ref):
         # refs carry a leading block dim of 1 (one tile per program)
         ring = ring_in[0, :, :]                  # (W, 128)
         pos = pos_in[0, 0, :]                    # (128,)
         cnt = cnt_in[0, 0, :]
         runsum = sum_in[0, 0, :]
+        comp = comp_in[0, 0, :]
         iota_w = jax.lax.broadcasted_iota(jnp.int32, (W, LANES), 0)
         for t in range(T):                       # static unroll over events
             x = values_ref[0, t, :]
@@ -108,7 +117,11 @@ def build_wagg_step_pallas(window: int, t_per_block: int):
             old = jnp.sum(jnp.where(oh, ring, 0.0), axis=0)
             evict = cnt == W
             delta = x - jnp.where(evict, old, 0.0)
-            runsum = jnp.where(ok, runsum + delta, runsum)
+            # Kahan-compensated add (see build_wagg_step)
+            y = delta - comp
+            tt = runsum + y
+            comp = jnp.where(ok, (tt - runsum) - y, comp)
+            runsum = jnp.where(ok, tt, runsum)
             ring = jnp.where(oh & ok[None, :], x[None, :], ring)
             pos = jnp.where(ok, (pos + 1) % W, pos)
             cnt = jnp.where(ok, jnp.minimum(cnt + 1, W), cnt)
@@ -118,6 +131,7 @@ def build_wagg_step_pallas(window: int, t_per_block: int):
         pos_out[0, 0, :] = pos
         cnt_out[0, 0, :] = cnt
         sum_out[0, 0, :] = runsum
+        comp_out[0, 0, :] = comp
 
     def step(carry: WaggCarry, values, accepted):
         P = carry.ring.shape[0]
@@ -131,6 +145,7 @@ def build_wagg_step_pallas(window: int, t_per_block: int):
         pos = carry.pos.reshape(tiles, 1, LANES)
         cnt = carry.cnt.reshape(tiles, 1, LANES)
         rs = carry.runsum.reshape(tiles, 1, LANES)
+        cp = carry.comp.reshape(tiles, 1, LANES)
 
         grid = (tiles,)
 
@@ -144,27 +159,30 @@ def build_wagg_step_pallas(window: int, t_per_block: int):
             jax.ShapeDtypeStruct(pos.shape, jnp.int32),
             jax.ShapeDtypeStruct(cnt.shape, jnp.int32),
             jax.ShapeDtypeStruct(rs.shape, jnp.float32),
+            jax.ShapeDtypeStruct(cp.shape, jnp.float32),
             jax.ShapeDtypeStruct(vals.shape, jnp.float32),   # sums
             jax.ShapeDtypeStruct(ok.shape, jnp.int32),       # counts
         ]
 
-        ring2, pos2, cnt2, rs2, sums, counts = pl.pallas_call(
+        ring2, pos2, cnt2, rs2, cp2, sums, counts = pl.pallas_call(
             kernel,
             grid=grid,
             in_specs=[tile_spec((T, LANES)), tile_spec((T, LANES)),
                       tile_spec((W, LANES)), tile_spec((1, LANES)),
-                      tile_spec((1, LANES)), tile_spec((1, LANES))],
+                      tile_spec((1, LANES)), tile_spec((1, LANES)),
+                      tile_spec((1, LANES))],
             out_specs=[tile_spec((W, LANES)), tile_spec((1, LANES)),
                        tile_spec((1, LANES)), tile_spec((1, LANES)),
-                       tile_spec((T, LANES)), tile_spec((T, LANES))],
+                       tile_spec((1, LANES)), tile_spec((T, LANES)),
+                       tile_spec((T, LANES))],
             out_shape=out_shape,
-            input_output_aliases={2: 0, 3: 1, 4: 2, 5: 3},
-        )(vals, ok, ring, pos, cnt, rs)
+            input_output_aliases={2: 0, 3: 1, 4: 2, 5: 3, 6: 4},
+        )(vals, ok, ring, pos, cnt, rs, cp)
 
         new_carry = WaggCarry(
             ring=ring2.transpose(0, 2, 1).reshape(P, W),
             pos=pos2.reshape(P), cnt=cnt2.reshape(P),
-            runsum=rs2.reshape(P))
+            runsum=rs2.reshape(P), comp=cp2.reshape(P))
         sums_pt = sums.transpose(0, 2, 1).reshape(P, -1)
         counts_pt = counts.transpose(0, 2, 1).reshape(P, -1)
         return new_carry, (sums_pt, counts_pt)

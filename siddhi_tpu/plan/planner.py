@@ -23,13 +23,55 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..query_api import StateInputStream, find_annotation
-from ..query_api.definition import Attribute, StreamDefinition
+from ..query_api.definition import Attribute, AttrType, StreamDefinition
+from ..query_api.expression import Variable
+from ..query_api.query import OutputEventsFor
 from ..utils.errors import SiddhiAppCreationError
 from .nfa_compiler import CompiledPatternNFA
 
 ENGINE_ENV = "SIDDHI_TPU_ENGINE"
 DEFAULT_SLOTS = 8
 GROW_START = 8          # initial keyed-lane capacity (doubles on demand)
+
+
+def map_keys_to_lanes(key_lanes: Dict[Any, int], keys: List[Any],
+                      capacity: int, grow_fn) -> np.ndarray:
+    """Assign each key a stable lane index, growing the device slab (via
+    grow_fn(new_capacity)) when the key population exceeds capacity."""
+    lanes = np.empty(len(keys), np.int64)
+    for i, k in enumerate(keys):
+        lane = key_lanes.get(k)
+        if lane is None:
+            lane = len(key_lanes)
+            key_lanes[k] = lane
+        lanes[i] = lane
+    if key_lanes and len(key_lanes) > capacity:
+        cap = capacity
+        while cap < len(key_lanes):
+            cap *= 2
+        grow_fn(cap)
+    return lanes
+
+
+def _scan_fns(e, pred) -> bool:
+    """True if any AttributeFunction node in the expression satisfies pred."""
+    from ..query_api.expression import AttributeFunction
+    if isinstance(e, AttributeFunction) and pred(e):
+        return True
+    for f in getattr(e, "__dataclass_fields__", {}):
+        v = getattr(e, f)
+        if isinstance(v, list):
+            if any(hasattr(x, "__dataclass_fields__") and _scan_fns(x, pred)
+                   for x in v):
+                return True
+        elif hasattr(v, "__dataclass_fields__") and _scan_fns(v, pred):
+            return True
+    return False
+
+
+def _is_time_fn(e) -> bool:
+    return (e.namespace or "") == "" and \
+        e.name.lower() in ("eventtimestamp", "currenttimemillis")
 
 
 def engine_mode(app) -> str:
@@ -119,19 +161,8 @@ class DevicePatternRuntime:
     # ------------------------------------------------------------ ingest
 
     def _lanes_for_keys(self, keys: List[Any]) -> np.ndarray:
-        lanes = np.empty(len(keys), np.int64)
-        for i, k in enumerate(keys):
-            lane = self.key_lanes.get(k)
-            if lane is None:
-                lane = len(self.key_lanes)
-                self.key_lanes[k] = lane
-            lanes[i] = lane
-        if self.key_lanes and len(self.key_lanes) > self.nfa.n_partitions:
-            cap = self.nfa.n_partitions
-            while cap < len(self.key_lanes):
-                cap *= 2
-            self.nfa.grow(cap)
-        return lanes
+        return map_keys_to_lanes(self.key_lanes, keys,
+                                 self.nfa.n_partitions, self.nfa.grow)
 
     def ingest(self, stream_code: int, stream_id: str, chunk) -> None:
         from ..core.event import CURRENT, EventChunk
@@ -201,19 +232,402 @@ class DevicePatternRuntime:
         self._ub_active = self.nfa.spec.n_slots
 
 
-def plan_state_runtime(query_runtime, sis: StateInputStream, factory):
-    """Try the device pattern compile for a query; (runtime, reason) where
-    exactly one side is None.  'host' mode short-circuits; 'device' mode
-    re-raises the incompatibility instead of falling back.  (The keyed
-    partition path constructs DevicePatternRuntime directly — a host
-    fallback at the query level would wire an unpartitioned runtime.)"""
+class DeviceWindowedAggRuntime:
+    """Partitioned length-window aggregation on the sliding-window kernel
+    (ops/windowed_agg.py): partition keys become group lanes of one ring
+    slab (BASELINE config 2 — the reference's per-key window buffers +
+    per-group aggregator maps, QuerySelector.java:171)."""
+
+    backend = "device"
+
+    def __init__(self, query_runtime, sis, factory,
+                 key_executors: Dict[str, Any]):
+        from ..core.event import dtype_for
+        from ..core.query_runtime import ProcessStreamReceiver
+        from .expr_compiler import ExprCompiler, Scope
+        from .wagg_compiler import CompiledWindowedAgg
+
+        qr = query_runtime
+        app = qr.app_runtime
+        q = qr.query
+        sel = q.selector
+        if sel.having is not None or sel.order_by or \
+                sel.limit is not None or sel.offset is not None:
+            raise SiddhiAppCreationError(
+                "device wagg path: having/order-by/limit are host-only")
+        if getattr(q.output_stream, "events_for",
+                   OutputEventsFor.CURRENT) != OutputEventsFor.CURRENT:
+            raise SiddhiAppCreationError(
+                "device wagg path: expired-event output is host-only")
+        self.cwa = CompiledWindowedAgg(app.app, n_partitions=GROW_START,
+                                       query=q, use_pallas=False)
+        # the kernel sees int32 ts offsets while the host-twin emission
+        # filter sees true int64 — absolute-timestamp filters would diverge
+        if any(_scan_fns(e, _is_time_fn) for e in self.cwa.filter_exprs):
+            raise SiddhiAppCreationError(
+                "device wagg path: timestamp functions need int64 host "
+                "evaluation")
+        if self.cwa.value is not None and \
+                self.cwa.value.type in (AttrType.INT, AttrType.LONG):
+            raise SiddhiAppCreationError(
+                "device wagg path: INT/LONG aggregate values ride float32 "
+                "lanes (exact integer sums need the host path)")
+        ex = key_executors.get(self.cwa.stream_id)
+        if ex is None:
+            raise SiddhiAppCreationError(
+                f"device wagg path: stream '{self.cwa.stream_id}' has no "
+                f"partition key executor")
+        # group-by must be the partition key itself (lanes isolate keys);
+        # a finer grouping needs the host per-key selector
+        pt_expr = getattr(ex, "pt", None)
+        pt_expr = getattr(pt_expr, "expression", None)
+        for v in sel.group_by:
+            if not (isinstance(pt_expr, Variable) and
+                    v.attribute == pt_expr.attribute):
+                raise SiddhiAppCreationError(
+                    "device wagg path: group-by must equal the partition "
+                    "key")
+        self.key_executor = ex
+        self.qr = qr
+        self.key_lanes: Dict[Any, int] = {}
+        self._dtype_for = dtype_for
+
+        # host-side twin of the filters for emission masking (same exprs,
+        # numpy backend)
+        scope = Scope()
+        scope.add_primary(self.cwa.stream_id, sis.stream_ref,
+                          self.cwa.input_definition)
+        host_compiler = ExprCompiler(scope, np)
+        self._host_filters = [host_compiler.compile(e)
+                              for e in self.cwa.filter_exprs]
+
+        # output definition with host-parity types
+        vt = self.cwa.value.type if self.cwa.value is not None else None
+        attrs = []
+        for (name, kind, attr) in self.cwa.outputs:
+            if kind == "key":
+                t = dict((a.name, a.type) for a in
+                         self.cwa.input_definition.attributes)[attr]
+            elif kind == "count":
+                t = AttrType.LONG
+            elif kind == "sum":
+                t = (AttrType.DOUBLE if vt in (AttrType.FLOAT,
+                                               AttrType.DOUBLE, None)
+                     else AttrType.LONG)
+            else:                                  # avg
+                t = AttrType.DOUBLE
+            attrs.append(Attribute(name, t))
+        target = getattr(q.output_stream, "target_id", "") or qr.name
+        out_def = StreamDefinition(target, attrs)
+        self.head = qr._finish_device_chain(out_def, factory)
+
+        # trace the kernel now (all-invalid block) so unsupported
+        # expressions — e.g. string-typed filters — reject at PLAN time,
+        # while fallback to the host clone machinery is still possible
+        try:
+            P = self.cwa.n_partitions
+            warm = {a.name: np.zeros((P, 1), np.float32)
+                    for a in self.cwa.input_definition.attributes
+                    if self._dtype_for(a.type) is not object}
+            warm["__ts"] = np.zeros((P, 1), np.int32)
+            warm["__valid"] = np.zeros((P, 1), bool)
+            self.cwa.process_block(warm)
+        except SiddhiAppCreationError:
+            raise
+        except Exception as e:
+            raise SiddhiAppCreationError(
+                f"device wagg path: kernel compile failed ({e})")
+
+        recv = ProcessStreamReceiver(
+            _DeviceIngress(self, 0, self.cwa.stream_id), qr.lock,
+            app.latency_tracker_for(qr.name), qr.name, app.app_ctx)
+        app.junction_of(self.cwa.stream_id).subscribe(recv)
+        qr.receivers[self.cwa.stream_id] = recv
+
+    # ------------------------------------------------------------ ingest
+
+    def ingest(self, stream_code: int, stream_id: str, chunk) -> None:
+        from ..core.event import CURRENT, EventChunk
+        from ..ops.nfa import pack_blocks
+        data = chunk.only(CURRENT)
+        if data.is_empty:
+            return
+        keys = self.key_executor.keys(data)
+        keep = np.asarray([k is not None for k in keys], bool)
+        if not keep.all():
+            data = data.mask(keep)
+            keys = [k for k in keys if k is not None]
+            if data.is_empty:
+                return
+        n = len(data)
+        lanes = map_keys_to_lanes(self.key_lanes, keys,
+                                  self.cwa.n_partitions, self.cwa.grow)
+        P = self.cwa.n_partitions
+        cols = {a.name: np.asarray(data.columns[a.name])
+                for a in self.cwa.input_definition.attributes
+                if a.name in data.columns and
+                data.columns[a.name].dtype != object}
+        ts_arr = np.asarray(data.timestamps, np.int64)
+        block, rows = pack_blocks(lanes, cols, ts_arr,
+                                  np.zeros(n, np.int32), P,
+                                  base_ts=int(ts_arr[0]), pad_t_pow2=True,
+                                  return_rows=True)
+        sums, counts = self.cwa.process_block(block)
+        sums = np.asarray(sums)
+        counts = np.asarray(counts)
+
+        # host-side twin filter decides which input events emit output rows
+        from .expr_compiler import EvalCtx
+        okm = np.ones(n, bool)
+        ctx = EvalCtx(data.columns, data.timestamps, n)
+        for f in self._host_filters:
+            m = np.asarray(f.fn(ctx), bool)
+            okm &= np.broadcast_to(m, okm.shape)
+        if not okm.any():
+            return
+        sel_l = lanes[okm]
+        sel_r = rows[okm]
+        ev_sums = sums[sel_l, sel_r].astype(np.float64)
+        ev_counts = counts[sel_l, sel_r].astype(np.int64)
+        names = [o[0] for o in self.cwa.outputs]
+        cols: Dict[str, np.ndarray] = {}
+        for (name, kind, attr) in self.cwa.outputs:
+            if kind == "key":
+                cols[name] = np.asarray(data.columns[attr])[okm]
+            elif kind == "sum":
+                cols[name] = ev_sums
+            elif kind == "count":
+                cols[name] = ev_counts
+            else:
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    cols[name] = np.where(ev_counts > 0,
+                                          ev_sums / np.maximum(ev_counts, 1),
+                                          np.nan)
+        out_ts = np.asarray(data.timestamps)[okm]
+        self.head.process(EventChunk.from_columns(names, out_ts, cols))
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        pass
+
+    # ------------------------------------------------------------ snapshot
+
+    def current_state(self) -> dict:
+        return {"cwa": self.cwa.current_state(),
+                "key_lanes": dict(self.key_lanes)}
+
+    def restore_state(self, state: dict) -> None:
+        self.cwa.restore_state(state["cwa"])
+        self.key_lanes = dict(state["key_lanes"])
+
+
+class DeviceFilterRuntime:
+    """Stateless filter/project query as one jitted column program — the
+    device replacement for the reference's per-event expression-tree DFS
+    (FilterProcessor.java:55-67 + QuerySelector attribute processors)."""
+
+    backend = "device"
+
+    def __init__(self, query_runtime, sis, factory):
+        import jax
+        import jax.numpy as jnp
+        from ..core.event import dtype_for
+        from ..core.query_runtime import ProcessStreamReceiver
+        from ..core.aggregator import is_aggregator
+        from ..query_api import Filter
+        from ..query_api.expression import AttributeFunction
+        from .expr_compiler import EvalCtx, ExprCompiler, Scope
+
+        qr = query_runtime
+        app = qr.app_runtime
+        q = qr.query
+        sel = q.selector
+        if sel.group_by or sel.having is not None or sel.order_by or \
+                sel.limit is not None or sel.offset is not None:
+            raise SiddhiAppCreationError(
+                "device filter path: group-by/having/order-by/limit are "
+                "host-only")
+        if any(not isinstance(h, Filter) for h in sis.handlers):
+            raise SiddhiAppCreationError(
+                "device filter path: windows/stream functions are stateful")
+
+        def is_agg(e):
+            return is_aggregator(e.namespace, e.name, len(e.args))
+
+        definition = app.definition_of(sis.stream_id, sis.is_inner,
+                                       sis.is_fault)
+        self.definition = definition
+        numeric = {a.name for a in definition.attributes
+                   if dtype_for(a.type) is not object}
+        scope = Scope()
+        scope.add_primary(sis.stream_id, sis.stream_ref, definition)
+        compiler = ExprCompiler(scope, jnp)
+        filters = [compiler.compile(h.expr) for h in sis.handlers]
+
+        sel_attrs = sel.attributes
+        if sel.select_all:            # `select *` → passthrough of all attrs
+            from ..query_api.query import OutputAttribute
+            from ..query_api.expression import Variable as _V
+            sel_attrs = [OutputAttribute(a.name, _V(a.name))
+                         for a in definition.attributes]
+
+        all_exprs = [oa.expr for oa in sel_attrs] + \
+            [h.expr for h in sis.handlers]
+        if any(_scan_fns(oa.expr, is_agg) for oa in sel_attrs):
+            raise SiddhiAppCreationError(
+                "device filter path: aggregates are stateful (host windows)")
+        if any(_scan_fns(e, _is_time_fn) for e in all_exprs):
+            raise SiddhiAppCreationError(
+                "device filter path: timestamp functions need int64 host "
+                "evaluation")
+
+        # outputs: plain attribute passthroughs gather host-side by mask
+        # (exact dtypes — INT/LONG would corrupt on float32 device lanes);
+        # computed outputs evaluate on device and must be FLOAT/DOUBLE/BOOL
+        self.outputs = []      # (name, 'host_col', attr) | (name, 'dev', i)
+        dev_exprs = []
+        attrs = []
+        from ..query_api.expression import Variable
+        attr_types = {a.name: a.type for a in definition.attributes}
+        for oa in sel_attrs:
+            e = oa.expr
+            if isinstance(e, Variable) and e.attribute in attr_types and \
+                    e.stream_index is None:
+                self.outputs.append((oa.rename, "host_col", e.attribute))
+                attrs.append(Attribute(oa.rename, attr_types[e.attribute]))
+            else:
+                ce = compiler.compile(e)
+                if dtype_for(ce.type) is object or \
+                        ce.type in (AttrType.INT, AttrType.LONG):
+                    raise SiddhiAppCreationError(
+                        f"device filter path: computed output '{oa.rename}' "
+                        f"of type {ce.type} cannot ride float32 lanes")
+                self.outputs.append((oa.rename, "dev", len(dev_exprs)))
+                dev_exprs.append(ce)
+                attrs.append(Attribute(oa.rename, ce.type))
+        target = getattr(q.output_stream, "target_id", "") or qr.name
+        out_def = StreamDefinition(target, attrs)
+        self.head = qr._finish_device_chain(out_def, factory)
+        self.qr = qr
+        self._dtype_for = dtype_for
+        self._dev_dtypes = [dtype_for(ce.type) for ce in dev_exprs]
+        self.numeric = sorted(numeric)
+
+        def program(cols, ts, valid):
+            n = ts.shape[0]
+            ctx = EvalCtx(cols, ts, n)
+            ok = valid
+            for f in filters:
+                m = jnp.asarray(f.fn(ctx), bool)
+                ok = ok & jnp.broadcast_to(m, ok.shape)
+            outs = [jnp.broadcast_to(jnp.asarray(ce.fn(ctx)), (n,))
+                    for ce in dev_exprs]
+            return ok, outs
+
+        self._program = jax.jit(program)
+
+        # trace now so incompatibilities reject at plan time
+        try:
+            warm_cols = {a: jnp.zeros((1,), jnp.float32)
+                         for a in self.numeric}
+            self._program(warm_cols, jnp.zeros((1,), jnp.int32),
+                          jnp.zeros((1,), bool))
+        except SiddhiAppCreationError:
+            raise
+        except Exception as e:
+            raise SiddhiAppCreationError(
+                f"device filter path: program compile failed ({e})")
+
+        recv = ProcessStreamReceiver(
+            _DeviceIngress(self, 0, sis.stream_id), qr.lock,
+            app.latency_tracker_for(qr.name), qr.name, app.app_ctx)
+        if app.has_named_window(sis.stream_id):
+            raise SiddhiAppCreationError(
+                "device filter path: named-window input is host-only")
+        app.junction_of(sis.stream_id, sis.is_inner,
+                        sis.is_fault).subscribe(recv)
+        qr.receivers[sis.stream_id] = recv
+
+    # ------------------------------------------------------------ ingest
+
+    def ingest(self, stream_code: int, stream_id: str, chunk) -> None:
+        import jax.numpy as jnp
+        from ..core.event import TIMER, RESET, EventChunk
+        n = len(chunk)
+        if n == 0:
+            return
+        n_pad = 1 << (n - 1).bit_length()
+        cols = {}
+        for a in self.numeric:
+            col = chunk.columns.get(a)
+            arr = np.zeros(n_pad, np.float32)
+            if col is not None:
+                arr[:n] = np.asarray(col, np.float32)
+            cols[a] = jnp.asarray(arr)
+        # int32 ts offsets — absolute-timestamp functions are planner-
+        # rejected on this path, nothing else reads ctx.timestamps
+        ts = np.zeros(n_pad, np.int32)
+        ts_arr = np.asarray(chunk.timestamps)
+        ts[:n] = (ts_arr - ts_arr[0]).astype(np.int32)
+        valid = np.zeros(n_pad, bool)
+        valid[:n] = True
+        ok, outs = self._program(cols, jnp.asarray(ts), jnp.asarray(valid))
+        ok = np.asarray(ok)[:n]
+        # TIMER/RESET rows always pass (host FilterProcessor parity)
+        ok = ok | (chunk.types == TIMER) | (chunk.types == RESET)
+        if not ok.any():
+            return
+        out_cols: Dict[str, np.ndarray] = {}
+        for (name, kind, ref) in self.outputs:
+            if kind == "host_col":
+                out_cols[name] = np.asarray(chunk.columns[ref])[ok]
+            else:
+                arr = np.asarray(outs[ref])[:n][ok]
+                out_cols[name] = arr.astype(self._dev_dtypes[ref])
+        out = EventChunk.from_columns(
+            [o[0] for o in self.outputs],
+            np.asarray(chunk.timestamps)[ok], out_cols,
+            types=chunk.types[ok])
+        self.head.process(out)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        pass
+
+    def current_state(self):
+        return None
+
+    def restore_state(self, state):
+        pass
+
+
+def _plan(query_runtime, build):
+    """Shared try-compile: (runtime, reason) where exactly one side is None.
+    'host' mode short-circuits; 'device' mode re-raises the incompatibility
+    instead of falling back."""
     app = query_runtime.app_runtime
     mode = engine_mode(app.app)
     if mode == "host":
         return None, "engine mode 'host'"
     try:
-        return DevicePatternRuntime(query_runtime, sis, factory), None
+        return build(), None
     except SiddhiAppCreationError as e:
         if mode == "device":
             raise
         return None, str(e)
+
+
+def plan_state_runtime(query_runtime, sis: StateInputStream, factory):
+    """Device pattern compile.  (The keyed partition path constructs
+    DevicePatternRuntime directly — a host fallback at the query level
+    would wire an unpartitioned runtime.)"""
+    return _plan(query_runtime,
+                 lambda: DevicePatternRuntime(query_runtime, sis, factory))
+
+
+def plan_single_runtime(query_runtime, sis, factory):
+    """Device compile for a stateless filter/project query."""
+    return _plan(query_runtime,
+                 lambda: DeviceFilterRuntime(query_runtime, sis, factory))

@@ -30,16 +30,18 @@ _AGGS = {"sum", "count", "avg"}
 class CompiledWindowedAgg:
     """One length-window aggregation query over P group/partition lanes."""
 
-    def __init__(self, app_string: str, n_partitions: int,
+    def __init__(self, app_string, n_partitions: int,
                  t_per_block: int = 16, query_name: Optional[str] = None,
-                 use_pallas: Optional[bool] = None):
-        app = SiddhiCompiler.parse(app_string)
-        query = None
-        for el in app.execution_elements:
-            if isinstance(el, Query) and (query_name is None or
-                                          el.name == query_name):
-                query = el
-                break
+                 use_pallas: Optional[bool] = None,
+                 query: Optional[Query] = None):
+        app = (SiddhiCompiler.parse(app_string)
+               if isinstance(app_string, str) else app_string)
+        if query is None:
+            for el in app.execution_elements:
+                if isinstance(el, Query) and (query_name is None or
+                                              el.name == query_name):
+                    query = el
+                    break
         if query is None:
             raise SiddhiAppCreationError(f"No query '{query_name}'")
         s = query.input_stream
@@ -61,7 +63,8 @@ class CompiledWindowedAgg:
         self.filters = filters
 
         # outputs: aggregates of ONE value expression + key passthroughs
-        self.outputs: List[Tuple[str, str]] = []   # (name, sum|count|avg)
+        # (name, sum|count|avg|key, key_attr_or_None)
+        self.outputs: List[Tuple[str, str, Optional[str]]] = []
         value_expr = None
         value_ast = None
         for oa in query.selector.attributes:
@@ -79,14 +82,18 @@ class CompiledWindowedAgg:
                     if value_expr is None:
                         value_expr = compiler.compile(e.args[0])
                         value_ast = e.args[0]
-                self.outputs.append((oa.rename, fname))
+                self.outputs.append((oa.rename, fname, None))
             elif isinstance(e, Variable):
-                self.outputs.append((oa.rename, "key"))
+                self.outputs.append((oa.rename, "key", e.attribute))
             else:
                 raise SiddhiAppCreationError(
                     "windowed-agg select supports sum/count/avg of one "
                     "expression plus key attributes")
         self.value = value_expr
+        self.filter_exprs = [h.expr for h in s.handlers
+                             if isinstance(h, Filter)]
+        self.input_definition = definition
+        self.stream_id = s.stream_id
         self.n_partitions = n_partitions
         self.t_per_block = t_per_block
         if use_pallas is None:
@@ -116,6 +123,25 @@ class CompiledWindowedAgg:
         self._step = jax.jit(full_step, donate_argnums=0)
         self.carry = make_wagg_carry(n_partitions, self.window)
 
+    def grow(self, n_partitions: int) -> None:
+        """Widen the group-lane axis (keyed partitioning slab growth)."""
+        if n_partitions <= self.n_partitions:
+            return
+        if self.use_pallas and n_partitions % LANES:
+            n_partitions = ((n_partitions // LANES) + 1) * LANES
+        fresh = make_wagg_carry(n_partitions - self.n_partitions, self.window)
+        self.carry = WaggCarry(*[jnp.concatenate([a, b], axis=0)
+                                 for a, b in zip(self.carry, fresh)])
+        self.n_partitions = n_partitions
+
+    def current_state(self) -> dict:
+        return {"carry": [np.asarray(a) for a in self.carry],
+                "n_partitions": self.n_partitions}
+
+    def restore_state(self, state: dict) -> None:
+        self.n_partitions = state["n_partitions"]
+        self.carry = WaggCarry(*[jnp.asarray(a) for a in state["carry"]])
+
     def process_block(self, block):
         """block: [P, T] packed lanes (ops.nfa.pack_blocks) →
         (sums [P, T], counts [P, T]) running aggregates."""
@@ -127,7 +153,7 @@ class CompiledWindowedAgg:
         s = np.asarray(self.carry.runsum)
         c = np.asarray(self.carry.cnt)
         out = {}
-        for name, kind in self.outputs:
+        for name, kind, _attr in self.outputs:
             if kind == "sum":
                 out[name] = s
             elif kind == "count":

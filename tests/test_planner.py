@@ -240,3 +240,92 @@ def test_partition_non_pattern_query_falls_back():
     assert not rt.partition_runtimes[0].device_mode
     assert rt.partition_runtimes[0].fallback_reason
     rt.shutdown()
+
+
+WAGG_PART_APP = """
+    define stream S (k int, v float);
+    partition with (k of S) begin
+    @info(name='q')
+    from S[v > 2.0]#window.length(5)
+    select k, sum(v) as total, count() as n, avg(v) as mean
+    group by k
+    insert into Out;
+    end;
+"""
+
+
+def test_partitioned_windowed_agg_device_parity():
+    """Partition keys become group lanes of the sliding-window ring slab;
+    per-event running aggregates must match the host per-key instances."""
+    rng = np.random.default_rng(17)
+    rows = [[int(rng.integers(0, 11)),
+             float(np.float32(rng.uniform(0, 10)))] for _ in range(120)]
+    dm_h, host = run_partition(WAGG_PART_APP, rows, engine="host")
+    dm_d, dev = run_partition(WAGG_PART_APP, rows)
+    assert not dm_h and dm_d
+    assert len(host) == len(dev) > 0
+    for a, b in zip(host, dev):
+        assert a[0] == b[0] and a[2] == b[2]
+        assert a[1] == pytest.approx(b[1], abs=1e-3)
+        assert a[3] == pytest.approx(b[3], abs=1e-3)
+
+
+def test_wagg_int_sum_falls_back_to_host():
+    """Exact integer sums can't ride float32 lanes — host fallback."""
+    app = WAGG_PART_APP.replace("v float", "v int").replace("v > 2.0",
+                                                            "v > 2")
+    dm, _ = run_partition(app, [[0, 3], [0, 4]])
+    assert not dm
+
+
+def test_filter_project_device_parity():
+    app = """
+        define stream S (symbol string, price float, volume long);
+        @info(name='q')
+        from S[price > 100.0 and volume > 5]
+        select symbol, price, price * 2.0 as dbl
+        insert into Out;
+    """
+    sends = [("S", ["IBM", 101.0, 10]), ("S", ["X", 50.0, 99]),
+             ("S", ["GOOG", 700.0, 1]), ("S", ["MSFT", 200.0, 50])]
+    bh, host = run_app(app, sends, engine="host")
+    bd, dev = run_app(app, sends)
+    assert bh == "host" and bd == "device"
+    assert host == dev == [("IBM", 101.0, 202.0), ("MSFT", 200.0, 400.0)]
+
+
+def test_filter_select_star_device():
+    app = """
+        define stream S (symbol string, price float);
+        @info(name='q')
+        from S[price > 10.0] select * insert into Out;
+    """
+    sends = [("S", ["A", 11.0]), ("S", ["B", 5.0])]
+    bd, dev = run_app(app, sends)
+    assert bd == "device"
+    assert dev == [("A", 11.0)]
+
+
+def test_filter_string_condition_falls_back():
+    app = """
+        define stream S (symbol string, price float);
+        @info(name='q')
+        from S[symbol == 'IBM'] select price insert into Out;
+    """
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    qr = rt.query_runtimes["q"]
+    assert qr.backend == "host" and qr.backend_reason
+    rt.shutdown()
+
+
+def test_window_query_stays_host():
+    app = """
+        define stream S (v float);
+        @info(name='q')
+        from S#window.length(3) select sum(v) as s insert into Out;
+    """
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    assert rt.query_runtimes["q"].backend == "host"
+    rt.shutdown()
