@@ -148,10 +148,14 @@ class ColumnarRing:
                         np.empty(0, np.int32))
             vs, tss, ss, ps = zip(*self._items)
             self._items.clear()
-            v = np.concatenate(vs)[:max_rows]
-            return (v, np.concatenate(tss)[:max_rows],
-                    np.concatenate(ss)[:max_rows],
-                    np.concatenate(ps)[:max_rows])
+            v = np.concatenate(vs)
+            t = np.concatenate(tss)
+            s = np.concatenate(ss)
+            p = np.concatenate(ps)
+            if len(v) > max_rows:
+                self._items.append((v[max_rows:], t[max_rows:],
+                                    s[max_rows:], p[max_rows:]))
+            return (v[:max_rows], t[:max_rows], s[:max_rows], p[:max_rows])
 
     def __len__(self):
         if self._lib is not None:

@@ -12,3 +12,16 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Build the native .so if the toolchain is present and it's missing/stale, so
+# test runs exercise the real C++ path rather than the numpy fallback.
+import subprocess
+
+_here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_so = os.path.join(_here, "siddhi_tpu", "_native.so")
+_src = os.path.join(_here, "native", "eventpack.cpp")
+if os.path.exists(_src) and (
+        not os.path.exists(_so)
+        or os.path.getmtime(_so) < os.path.getmtime(_src)):
+    subprocess.run(["make", "-C", os.path.join(_here, "native")],
+                   capture_output=True)
