@@ -614,6 +614,12 @@ class Parser:
     def parse_pattern_element(self):
         if self.try_kw("every"):
             inner = self.parse_pattern_unit()
+            # `every (...) within t`: the group-scoped within parsed inside
+            # parse_pattern_unit rides the every element
+            w = getattr(inner, "within_ms", None)
+            if w is not None and not isinstance(inner, StateInputStream):
+                inner.within_ms = None
+                return EveryStateElement(state=inner, within_ms=w)
             return EveryStateElement(state=inner)
         return self.parse_pattern_unit()
 

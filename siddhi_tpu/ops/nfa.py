@@ -5,227 +5,508 @@ This replaces the reference's per-event, per-partial-match Java loop
 list of partial matches stepped one event at a time under a ReentrantLock)
 with a dense tensor program:
 
-    state:    slot_state [P, K] int32   — next condition each partial waits on
+    state:    slot_state [P, K] int32   — unit each partial slot waits on
               slot_start [P, K] int32   — first-capture timestamp (within)
-              captures   [P, K, S, C]   — captured attribute lanes per state
+              captures   [P, K, R, C]   — capture rows (one per unit side)
     events:   [P, T] time-major blocks, one independent lane per partition
 
     step = lax.scan over T  ∘  vmap over P  ∘  (condition gate + advance)
 
 All K partial slots of all P partitions evaluate their pending condition
-against the incoming event in one vectorised pass; advancing slots write
-capture lanes; slots completing state S-1 emit matches into a per-step match
-buffer.  Partition lanes are fully independent, so the P axis shards over an
-ICI mesh with jax.sharding (see parallel/mesh.py) with zero collectives on
-the hot path.
+against the incoming event in one vectorised pass.  Partition lanes are
+fully independent, so the P axis shards over an ICI mesh with jax.sharding
+(see parallel/mesh.py) with zero collectives on the hot path.
 
-Semantics covered (PATTERN type, the reference's non-strict mode):
-`every c0 -> c1 -> ... -> c_{S-1} within t` chains, per-state filters that
-may reference earlier captures (e.g. ``e2=S[price > e1.price]``), multiple
-input streams (per-state stream gating), slot-ring eviction by `within`
-expiry.  Conformance vs the host oracle is asserted in
-tests/test_tpu_nfa.py.
+The pattern algebra is a chain of *units* compiled by plan/nfa_compiler.py
+(reference util/parser/StateInputStreamParser.java:76-404):
+
+  - simple   one condition; advance on match
+             (Stream Pre/PostStateProcessor)
+  - count    kleene <m:n>: per-slot counter accumulates matches, forwards
+             at min, keeps live-appending into the last-capture bank while
+             the next unit is pending, freezes at max
+             (CountPreStateProcessor.java:53-105, CountPostStateProcessor)
+  - logical  and/or partner pair: two (stream, condition, capture-row)
+             sides + a per-slot side bitmask
+             (LogicalPreStateProcessor.java:57-92)
+  - absent   `not X for t`: per-slot deadline lane; an arriving match
+             kills the partial, deadline expiry (driven by real events or
+             host-injected TIMER rows) confirms the absence and advances
+             (AbsentStreamPreStateProcessor.java:63-96)
+
+Both PATTERN (non-strict) and SEQUENCE (strict contiguity: a partial must
+advance or append on every event or die — the reference's per-event
+resetState/updateState barriers, StreamPreStateProcessor.java:263-290)
+semantics are supported.  Conformance vs the host oracle (core/pattern.py)
+is asserted in tests/test_tpu_nfa.py and tests/test_planner.py.
 """
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, Callable, Dict, List, NamedTuple, Optional
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 NO_SLOT = jnp.int32(-1)
+COUNT_INF = 0x7FFFFFFF
+
+
+class UnitSpec(NamedTuple):
+    """One chain position (≙ one Pre/PostStateProcessor pair)."""
+    kind: str                 # 'simple' | 'count' | 'logical' | 'absent'
+    stream_a: int             # stream code of side A
+    cond_a: int               # index into NfaSpec.cond_fns
+    row_a: int                # capture row (-1: no captures, absent units)
+    stream_b: int = -1        # logical pairs only
+    cond_b: int = -1
+    row_b: int = -1
+    is_and: bool = False      # logical: and vs or
+    min_count: int = 1        # count units
+    max_count: int = 1
+    waiting_ms: int = 0       # absent units
 
 
 class NfaSpec(NamedTuple):
     """Compiled NFA structure (built by plan/nfa_compiler.py)."""
-    n_states: int
-    n_caps: int                       # capture lanes per state
+    units: Tuple[UnitSpec, ...]
+    n_rows: int                       # capture rows
+    n_caps: int                       # lanes per row (C)
     n_slots: int                      # K: max concurrent partials
     within_ms: Optional[int]
-    state_streams: np.ndarray         # [S] int32 — stream code per state
-    # cond_fns[j](event_cols: {attr: scalar}, captures: [K, S, C]) -> [K] bool
-    cond_fns: List[Callable]
-    # cap_cols[j]: attr names captured into lanes for state j (≤ C)
-    cap_cols: List[List[str]]
-    attr_names: List[str]             # event column order
+    # cond_fns[i](event_cols: {attr: scalar}, captures: [K, R, C]) -> [K]
+    cond_fns: Tuple[Callable, ...]
+    cap_cols: Tuple[Tuple[str, ...], ...]   # per row: first bank ++ last bank
+    n_first: Tuple[int, ...]          # per row: #lanes in the first bank
+    n_lane: Tuple[int, ...]           # per row: __n counter lane (-1: none)
+    matched_lane: Tuple[int, ...]     # per row: __matched lane (-1: none)
+    attr_names: Tuple[str, ...]       # event column order
     is_every: bool
-    # leading kleene state `A<m:n>` (reference CountPre/PostStateProcessor):
-    # one accumulator lane per partition counts condition-0 matches and
-    # spawns a slot at state 1 when min is reached; first/last capture banks
-    # serve e1[0].x / e1[last].x.  None → plain chain.
-    count0_min: Optional[int] = None
-    count0_max: Optional[int] = None
-    n_first_lanes: int = 0            # lanes 0..n-1 = first-occurrence bank
+    is_sequence: bool = False
+    arm_once: bool = False            # single-shot arming
+    every_group_end: int = 0          # last unit of the `every` re-arm group
+
+    @property
+    def n_states(self) -> int:
+        return len(self.units)
+
+
+def _has(spec: NfaSpec, kind: str) -> bool:
+    return any(u.kind == kind for u in spec.units)
+
+
+def _land_static(spec: NfaSpec, j_from: int):
+    """Where a slot advancing out of unit j_from ends up.
+
+    Returns (target, live0, completed): `live0` marks an epsilon-skipped
+    min-0 count unit at target-1 that keeps live-appending
+    (CountPreStateProcessor.addState min==0 branch); `completed` means the
+    chain is done and the advance emits a match."""
+    S = len(spec.units)
+    t = j_from + 1
+    live0 = False
+    if t < S and spec.units[t].kind == "count" and \
+            spec.units[t].min_count == 0:
+        live0 = True
+        t += 1
+    return t, live0, t >= S
 
 
 def make_carry(spec: NfaSpec, n_partitions: int) -> Dict[str, jnp.ndarray]:
-    P, K, S, C = n_partitions, spec.n_slots, spec.n_states, spec.n_caps
+    P, K = n_partitions, spec.n_slots
+    R, C = max(spec.n_rows, 1), max(spec.n_caps, 1)
     carry = {
         "slot_state": jnp.full((P, K), -1, jnp.int32),
         "slot_start": jnp.zeros((P, K), jnp.int32),
-        "captures": jnp.zeros((P, K, S, max(C, 1)), jnp.float32),
+        # ts the slot entered its current unit + per-partition arm sequence
+        # — together they reproduce the oracle's pending-list insertion
+        # order for same-event completions
+        "slot_enter": jnp.zeros((P, K), jnp.int32),
+        "slot_seq": jnp.zeros((P, K), jnp.int32),
+        "arm_seq": jnp.zeros((P,), jnp.int32),
+        "captures": jnp.zeros((P, K, R, C), jnp.float32),
         "dropped": jnp.zeros((P,), jnp.int32),   # slot-overflow counter
     }
-    if spec.count0_min is not None:
-        carry["acc_ctr"] = jnp.zeros((P,), jnp.int32)
-        carry["acc_caps"] = jnp.zeros((P, max(C, 1)), jnp.float32)
-        carry["acc_ts"] = jnp.zeros((P,), jnp.int32)
-        # a PATTERN leading-kleene chain is single-shot: the one initial
-        # partial accumulates, forwards exactly at min, and dies at max or
-        # on within expiry — PATTERN start states are never re-initialised
-        # (StreamPreStateProcessor.resetState runs only for SEQUENCE) and
-        # the `every` re-arm clone can never re-reach min
-        carry["acc_dead"] = jnp.zeros((P,), jnp.bool_)
-    if not spec.is_every:
+    if _has(spec, "count"):
+        carry["cnt_cur"] = jnp.zeros((P, K), jnp.int32)
+        carry["cnt_prev"] = jnp.full((P, K), -1, jnp.int32)
+    if _has(spec, "logical"):
+        carry["lmask"] = jnp.zeros((P, K), jnp.int32)
+    if _has(spec, "absent"):
+        carry["deadline"] = jnp.zeros((P, K), jnp.int32)
+    if spec.arm_once:
         carry["armed_total"] = jnp.zeros((P,), jnp.int32)
     return carry
+
+
+def _event_rows(spec: NfaSpec, event) -> jnp.ndarray:
+    """[R, C] matrix of the lanes this event would write into each row
+    (__matched lanes read 1.0; __n lanes are patched per-slot later)."""
+    R, C = max(spec.n_rows, 1), max(spec.n_caps, 1)
+    rows = []
+    for r in range(R):
+        cols = spec.cap_cols[r] if r < len(spec.cap_cols) else ()
+        lanes = [event[a].astype(jnp.float32) if a in event
+                 else jnp.float32(1.0)          # __matched / __n defaults
+                 for a in cols]
+        lanes += [jnp.float32(0)] * (C - len(lanes))
+        rows.append(jnp.stack(lanes) if lanes
+                    else jnp.zeros((C,), jnp.float32))
+    return jnp.stack(rows)
+
+
+class _StepState:
+    """Mutable per-event slot arrays threaded through the unit loop."""
+
+    def __init__(self, spec: NfaSpec, carry: Dict, K: int):
+        self.spec = spec
+        self.st = carry["slot_state"]
+        self.start = carry["slot_start"]
+        self.enter = carry["slot_enter"]
+        self.seq = carry["slot_seq"]
+        self.arm_seq = carry["arm_seq"]
+        self.caps = carry["captures"]
+        self.dropped = carry["dropped"]
+        self.cnt_cur = carry.get("cnt_cur")
+        self.cnt_prev = carry.get("cnt_prev")
+        self.lmask = carry.get("lmask")
+        self.deadline = carry.get("deadline")
+        self.armed_total = carry.get("armed_total")
+        self.m_mask = jnp.zeros((K,), bool)
+        self.m_ts = jnp.zeros((K,), jnp.int32)
+        self.m_enter = jnp.zeros((K,), jnp.int32)
+        self.m_seq = jnp.zeros((K,), jnp.int32)
+
+    def land(self, pred, j_from: int, base_ts, fwd_cnt=None, fwd_dead=None):
+        """Advance `pred` slots out of unit j_from at time base_ts.
+
+        fwd_cnt: forwarded count for count-unit exits (stays live unless
+        fwd_dead).  base_ts may be scalar (event ts) or [K] (deadlines)."""
+        spec = self.spec
+        t, live0, completed = _land_static(spec, j_from)
+        if completed:
+            self.m_mask = self.m_mask | pred
+            self.m_ts = jnp.where(pred, base_ts, self.m_ts)
+            # oracle emission order for same-event completions follows the
+            # last unit's pending-list insertion order
+            self.m_enter = jnp.where(pred, self.enter, self.m_enter)
+            self.m_seq = jnp.where(pred, self.seq, self.m_seq)
+            self.st = jnp.where(pred, -1, self.st)
+            if live0 and self.cnt_prev is not None:
+                # trailing min-0 count: match emitted on arrival, slot dies
+                pass
+            return
+        self.st = jnp.where(pred, t, self.st)
+        self.enter = jnp.where(pred, base_ts, self.enter)
+        if self.lmask is not None:
+            self.lmask = jnp.where(pred, 0, self.lmask)
+        if self.cnt_prev is not None:
+            if fwd_cnt is not None:
+                dead = fwd_dead if fwd_dead is not None else \
+                    jnp.zeros_like(pred)
+                self.cnt_prev = jnp.where(
+                    pred, jnp.where(dead, -1, fwd_cnt), self.cnt_prev)
+            elif live0:
+                self.cnt_prev = jnp.where(pred, 0, self.cnt_prev)
+            else:
+                self.cnt_prev = jnp.where(pred, -1, self.cnt_prev)
+            self.cnt_cur = jnp.where(pred, 0, self.cnt_cur)
+        if spec.units[t].kind == "absent":
+            self.deadline = jnp.where(
+                pred, base_ts + spec.units[t].waiting_ms, self.deadline)
+
+    def write_all(self, pred, row: int, ev_rows):
+        """Write every lane of `row` for `pred` slots."""
+        if row < 0:
+            return
+        R = self.caps.shape[1]
+        sel = pred[:, None, None] & \
+            (jnp.arange(R)[None, :, None] == row)
+        self.caps = jnp.where(sel, ev_rows[row][None, None, :], self.caps)
+
+    def write_count(self, pred_first, pred_last, row: int, ev_rows, new_n):
+        """Count-row append: first bank on the first append, last bank +
+        __n lane on every append."""
+        if row < 0:
+            return
+        spec = self.spec
+        R, C = self.caps.shape[1], self.caps.shape[2]
+        lane = jnp.arange(C)
+        nf = spec.n_first[row]
+        first_lanes = lane < nf
+        nl = spec.n_lane[row]
+        last_lanes = (lane >= nf) & ((lane != nl) if nl >= 0 else True)
+        row_sel = (jnp.arange(R)[None, :, None] == row)
+        ev = ev_rows[row][None, None, :]
+        self.caps = jnp.where(
+            pred_first[:, None, None] & row_sel & first_lanes[None, None, :],
+            ev, self.caps)
+        self.caps = jnp.where(
+            pred_last[:, None, None] & row_sel & last_lanes[None, None, :],
+            ev, self.caps)
+        if nl >= 0:
+            nsel = pred_last[:, None, None] & row_sel & \
+                (lane == nl)[None, None, :]
+            self.caps = jnp.where(
+                nsel, new_n.astype(jnp.float32)[:, None, None], self.caps)
+
+    def clear_slot(self, pred):
+        self.caps = jnp.where(pred[:, None, None],
+                              jnp.float32(0), self.caps)
 
 
 def _one_partition_step(spec: NfaSpec, carry: Dict, event):
     """Step one partition's slot ring over one event.
 
-    carry: slot_state [K], slot_start [K], captures [K, S, C], dropped []
-           (+ acc_ctr/acc_caps/acc_ts for a leading kleene state)
-    event: cols dict of scalars + ts + stream_code + valid
-    returns (new_carry, (match_mask [K], match_caps [K, S, C], match_ts [K]))
-    """
+    event: cols dict of scalars + __ts/__stream/__valid
+    returns (new_carry, (match_mask [K], match_caps [K, R, C],
+    match_ts [K]))"""
+    units = spec.units
+    S = len(units)
     K = spec.n_slots
-    S = spec.n_states
-    C = max(spec.n_caps, 1)
-    slot_state = carry["slot_state"]
-    slot_start = carry["slot_start"]
-    captures = carry["captures"]
-    dropped = carry["dropped"]
     ts = event["__ts"]
     valid = event["__valid"]
     stream = event["__stream"]
 
-    active = slot_state >= 0
+    s = _StepState(spec, carry, K)
 
-    # within expiry (reference isExpired :104-113)
+    # ---- within expiry (reference isExpired :104-113 — start-state
+    # partials are exempt: a half-filled leading pair or accumulating
+    # kleene start never expires, only later units enforce `within`)
     if spec.within_ms is not None:
-        expired = active & (ts - slot_start > spec.within_ms)
-        slot_state = jnp.where(expired, -1, slot_state)
-        active = slot_state >= 0
+        expired = (s.st >= 1) & (ts - s.start > spec.within_ms)
+        s.st = jnp.where(expired, -1, s.st)
 
-    ev_caps = _event_capture_matrix(spec, event)          # [S, C]
-    out_carry = {}
+    st_pre = s.st
 
-    # --- leading kleene: append to the accumulator BEFORE evaluating later
-    # conditions (the reference's count pre-state runs first in unit order,
-    # and the chain object is shared with slots waiting on later states) ---
-    if spec.count0_min is not None:
-        acc_ctr = carry["acc_ctr"]
-        acc_caps = carry["acc_caps"]
-        acc_ts = carry["acc_ts"]
-        acc_dead = carry["acc_dead"]
-        if spec.within_ms is not None:
-            acc_dead = acc_dead | \
-                ((acc_ctr > 0) & (ts - acc_ts > spec.within_ms))
-        # condition 0 never reads captures → uniform over K; take lane 0
-        c0 = valid & (stream == spec.state_streams[0]) & ~acc_dead & \
-            spec.cond_fns[0](event, captures)[0]
-        ctr2 = jnp.where(c0, acc_ctr + 1, acc_ctr)
-        fresh = c0 & (ctr2 == 1)
-        lane_is_last = jnp.arange(C) >= spec.n_first_lanes
-        acc_caps = jnp.where(
-            fresh | (c0 & lane_is_last), ev_caps[0], acc_caps)
-        acc_ts = jnp.where(fresh, ts, acc_ts)
-        # live last-bank append under the armed slot while the chain grows
-        # (the reference shares one StateEvent object between the kleene
-        # chain and the next state's pending list)
-        wl = (c0 & (slot_state == 1))[:, None, None] & \
-            (jnp.arange(S)[None, :, None] == 0) & \
-            lane_is_last[None, None, :]
-        captures = jnp.where(wl, ev_caps[0][None, None, :], captures)
+    # ---- condition programs over the current capture state
+    conds = [fn(event, s.caps) for fn in spec.cond_fns]
+    ev_rows = _event_rows(spec, event)
 
-    # evaluate every state's condition against this event for all K slots
-    cond = jnp.stack([fn(event, captures) for fn in spec.cond_fns], axis=1)
-    # [K, S] → gate each slot on its own pending state
-    idx = jnp.clip(slot_state, 0, S - 1)
-    slot_cond = jnp.take_along_axis(cond, idx[:, None], axis=1)[:, 0]
-    stream_ok = jnp.asarray(spec.state_streams)[idx] == stream
-    advance = active & stream_ok & slot_cond & valid
+    advanced = jnp.zeros((K,), bool)
+    appended = jnp.zeros((K,), bool)
 
-    # write captures for advancing slots at their pending state
-    write = advance[:, None, None] & \
-        (jnp.arange(S)[None, :, None] == idx[:, None, None])
-    captures = jnp.where(write, ev_caps[None, :, :], captures)
+    # ---- main transitions, one unit at a time (statically unrolled)
+    for j, u in enumerate(units):
+        at = valid & (st_pre == j)
+        if u.kind == "simple":
+            ok = at & (stream == u.stream_a) & conds[u.cond_a]
+            s.write_all(ok, u.row_a, ev_rows)
+            s.land(ok, j, ts)
+            advanced = advanced | ok
+        elif u.kind == "logical":
+            bitA = (s.lmask & 1) > 0
+            bitB = (s.lmask & 2) > 0
+            # a side already satisfied ignores further matches (the
+            # reference removes the partial from that side's pending list)
+            newA = at & (stream == u.stream_a) & conds[u.cond_a] & ~bitA
+            newB = at & (stream == u.stream_b) & conds[u.cond_b] & ~bitB
+            s.write_all(newA, u.row_a, ev_rows)
+            s.write_all(newB, u.row_b, ev_rows)
+            haveA, haveB = bitA | newA, bitB | newB
+            done = at & ((haveA & haveB) if u.is_and else (newA | newB))
+            s.lmask = jnp.where(newA, s.lmask | 1, s.lmask)
+            s.lmask = jnp.where(newB, s.lmask | 2, s.lmask)
+            s.land(done, j, ts)
+            advanced = advanced | done
+            appended = appended | ((newA | newB) & ~done)
+        elif u.kind == "count":
+            # accumulating phase: slot sits at j while cnt < min
+            ok = at & (stream == u.stream_a) & conds[u.cond_a]
+            c2 = s.cnt_cur + 1
+            s.write_count(ok & (s.cnt_cur == 0), ok, u.row_a, ev_rows, c2)
+            s.cnt_cur = jnp.where(ok, c2, s.cnt_cur)
+            reach = ok & (c2 == u.min_count)
+            dead = reach & (c2 == u.max_count)
+            s.land(reach, j, ts, fwd_cnt=c2, fwd_dead=dead)
+            advanced = advanced | reach
+            if spec.is_sequence:
+                appended = appended | (ok & (c2 >= u.min_count))
+            else:
+                appended = appended | ok
+        elif u.kind == "absent":
+            # an actual arrival on the `not` stream kills the partial
+            # (AbsentStreamPostStateProcessor: never advances)
+            kill = at & (stream == u.stream_a) & conds[u.cond_a]
+            s.st = jnp.where(kill, -1, s.st)
 
-    new_state = jnp.where(advance, slot_state + 1, slot_state)
-    completed = advance & (new_state == S)
+    # ---- live-append phase: a forwarded count keeps growing its last
+    # bank while the next unit is pending (the reference shares one
+    # StateEvent between the kleene chain and the next pending list,
+    # CountPreStateProcessor.removeIfNextStateProcessed)
+    if s.cnt_prev is not None:
+        for j, u in enumerate(units):
+            if u.kind != "count":
+                continue
+            t, _live0, completed = _land_static(spec, j)
+            if completed:
+                continue        # trailing count: match already emitted
+            live = valid & (st_pre == t) & (s.cnt_prev >= 0) & ~advanced
+            ok = live & (stream == u.stream_a) & conds[u.cond_a] & \
+                (s.cnt_prev < u.max_count)
+            c2 = s.cnt_prev + 1
+            s.write_count(ok & (s.cnt_prev == 0), ok, u.row_a, ev_rows, c2)
+            s.cnt_prev = jnp.where(ok, c2, s.cnt_prev)
+            # max reached → the reference marks stateChanged and stops
+            s.cnt_prev = jnp.where(ok & (c2 == u.max_count), -1, s.cnt_prev)
+            appended = appended | ok
 
-    match_mask = completed
-    match_caps = captures
-    match_ts = jnp.where(completed, ts, jnp.int32(0))
+    # ---- SEQUENCE strict contiguity: partials at simple/count units must
+    # advance or append on every event or die (per-event resetState
+    # barriers, StreamPreStateProcessor.java:263-279); logical/absent
+    # partials survive (processAndReturn keeps them)
+    if spec.is_sequence:
+        # injected TIMER rows (stream -2) are not events: the oracle's
+        # absent_tick never runs the per-event reset barrier
+        is_real = valid & (stream != -2)
+        strict = np.asarray([u.kind in ("simple", "count") for u in units] +
+                            [False], bool)
+        at_strict = jnp.asarray(strict)[jnp.clip(st_pre, 0, S)]
+        kill = is_real & (st_pre >= 0) & (s.st >= 0) & at_strict & \
+            ~(advanced | appended)
+        s.st = jnp.where(kill, -1, s.st)
 
-    # completed slots free up
-    new_state = jnp.where(completed, -1, new_state)
+    # ---- arming a fresh partial at unit 0 (reference `every` re-arm /
+    # start-state init)
+    u0 = units[0]
+    # conditions at unit 0 never read captures → uniform over K: lane 0
+    occ_gate = ~jnp.any((st_pre >= 0) & (st_pre <= spec.every_group_end)) \
+        if (spec.is_every and spec.every_group_end > 0) or \
+        u0.kind in ("count", "logical") else jnp.bool_(True)
+    if spec.arm_once:
+        occ_gate = occ_gate & (s.armed_total == 0)
 
-    # --- arming a fresh partial (reference `every` re-arm / start init) ---
-    if spec.count0_min is None:
-        # condition 0 never reads captures, so row 0 of cond is uniform
-        c0 = valid & (stream == spec.state_streams[0]) & cond[0, 0]
+    arm = jnp.zeros((), bool)
+    arm_state = jnp.int32(0)
+    arm_lmask = jnp.int32(0)
+    arm_cnt_cur = jnp.int32(0)
+    arm_cnt_prev = jnp.int32(-1)
+    arm_match = jnp.zeros((), bool)
+    arm_row_writes: List[int] = []      # rows the arming event captures
+    arm_n1_rows: List[int] = []         # count rows written with __n = 1
+
+    if u0.kind == "simple":
+        c0 = valid & (stream == u0.stream_a) & conds[u0.cond_a][0]
+        t, _live0, completed = _land_static(spec, 0)
         arm = c0
-        arm_caps0 = ev_caps[0]                 # [C]
-        arm_ts = ts
-    else:
-        # reference CountPostStateProcessor: forward exactly at min count;
-        # the chain keeps growing (NOT reset by the forward) and freezes at
-        # max (stateChanged removes it) — arming is intrinsically single-shot
-        arm = c0 & (ctr2 == spec.count0_min)
-        hit_max = (c0 & (ctr2 == spec.count0_max)
-                   if (spec.count0_max or 0) > 0 else jnp.bool_(False))
-        out_carry["acc_ctr"] = ctr2
-        out_carry["acc_caps"] = acc_caps
-        out_carry["acc_ts"] = acc_ts
-        out_carry["acc_dead"] = acc_dead | hit_max
-        arm_caps0 = acc_caps
-        arm_ts = acc_ts
-    if not spec.is_every:
-        # without `every` only the initial partial exists: first arm wins
-        # (reference StreamPreStateProcessor.init + resetState guards)
-        armed_total = carry["armed_total"]
-        arm = arm & (armed_total == 0)
-        out_carry["armed_total"] = armed_total + \
-            jnp.where(arm, 1, 0)
-    free = new_state < 0
-    first_free = jnp.argmax(free)            # 0 if none free — guarded below
+        arm_row_writes.append(u0.row_a)
+        if completed:
+            arm_match = c0
+        else:
+            arm_state = jnp.int32(t)
+            arm_cnt_prev = jnp.int32(0 if _live0 else -1)
+    elif u0.kind == "count":
+        c0 = valid & (stream == u0.stream_a) & conds[u0.cond_a][0]
+        arm = c0
+        arm_row_writes.append(u0.row_a)
+        arm_n1_rows.append(u0.row_a)
+        if u0.min_count <= 1:
+            t, _live0, completed = _land_static(spec, 0)
+            if completed:
+                arm_match = c0
+            else:
+                arm_state = jnp.int32(t)
+                arm_cnt_prev = jnp.where(
+                    jnp.bool_(u0.max_count == 1), jnp.int32(-1),
+                    jnp.int32(1))
+        else:
+            arm_state = jnp.int32(0)
+            arm_cnt_cur = jnp.int32(1)
+    elif u0.kind == "logical":
+        cA = valid & (stream == u0.stream_a) & conds[u0.cond_a][0]
+        cB = valid & (stream == u0.stream_b) & conds[u0.cond_b][0]
+        arm = cA | cB
+        both = (cA & cB) if u0.is_and else (cA | cB)
+        t, _live0, completed = _land_static(spec, 0)
+        arm_match = both if completed else jnp.zeros((), bool)
+        arm_state = jnp.where(both, jnp.int32(-2 if completed else t),
+                              jnp.int32(0))
+        arm_lmask = jnp.where(cA, 1, 0) | jnp.where(cB, 2, 0)
+        arm_cnt_prev = jnp.int32(0 if _live0 else -1)
+        # capture whichever side(s) matched
+        arm_row_writes = []     # handled below with per-side predicates
+    else:                       # absent at start: planner rejects
+        arm = jnp.zeros((), bool)
+
+    do_arm = arm & occ_gate
+    free = (s.st < 0) & ~s.m_mask
+    first_free = jnp.argmax(free)
     any_free = jnp.any(free)
-    do_arm = arm & any_free
-    slot_iota = jnp.arange(K)
-    armed_here = do_arm & (slot_iota == first_free)
-    write0 = armed_here[:, None, None] & \
-        (jnp.arange(S)[None, :, None] == 0)
-    if S == 1:
-        # single-state pattern: arming IS completion
-        match_mask = match_mask | armed_here
-        caps0 = jnp.where(write0, arm_caps0[None, None, :], captures)
-        match_caps = jnp.where(armed_here[:, None, None], caps0, match_caps)
-        match_ts = jnp.where(armed_here, ts, match_ts)
+    armed_here = (do_arm & any_free) & (jnp.arange(K) == first_free)
+    s.dropped = s.dropped + jnp.where(do_arm & ~any_free, 1, 0)
+    if spec.arm_once:
+        s.armed_total = s.armed_total + jnp.where(do_arm & any_free, 1, 0)
+        if spec.is_sequence:
+            # a non-every sequence is single-shot: its one initial partial
+            # dies forever on the first real event it cannot advance on
+            # (StreamPreStateProcessor.init runs once; SEQUENCE barriers
+            # clear the pending list every event; TIMER rows don't count)
+            virgin_dies = valid & (stream != -2) & (s.armed_total == 0)
+            s.armed_total = jnp.where(virgin_dies, 2, s.armed_total)
+
+    caps_snap = s.caps          # match decode sees pre-arm captures
+    s.clear_slot(armed_here)
+    if u0.kind == "logical":
+        cA = valid & (stream == u0.stream_a) & conds[u0.cond_a][0]
+        cB = valid & (stream == u0.stream_b) & conds[u0.cond_b][0]
+        s.write_all(armed_here & cA, u0.row_a, ev_rows)
+        s.write_all(armed_here & cB, u0.row_b, ev_rows)
     else:
-        new_state = jnp.where(armed_here, 1, new_state)
-        slot_start = jnp.where(armed_here, arm_ts, slot_start)
-        captures = jnp.where(write0, arm_caps0[None, None, :], captures)
-    dropped = dropped + jnp.where(arm & ~any_free, 1, 0)
+        for r in arm_row_writes:
+            if r in arm_n1_rows:
+                s.write_count(armed_here, armed_here, r, ev_rows,
+                              jnp.full((K,), 1, jnp.int32))
+            else:
+                s.write_all(armed_here, r, ev_rows)
+    emit_arm = armed_here & arm_match
+    s.m_mask = s.m_mask | emit_arm
+    s.m_ts = jnp.where(emit_arm, ts, s.m_ts)
+    s.m_enter = jnp.where(emit_arm, ts, s.m_enter)
+    s.m_seq = jnp.where(emit_arm, s.arm_seq, s.m_seq)
+    live_arm = armed_here & ~arm_match
+    s.st = jnp.where(live_arm, arm_state, s.st)
+    s.start = jnp.where(live_arm | emit_arm, ts, s.start)
+    s.enter = jnp.where(live_arm, ts, s.enter)
+    s.seq = jnp.where(live_arm, s.arm_seq, s.seq)
+    s.arm_seq = s.arm_seq + jnp.where(jnp.any(armed_here), 1, 0)
+    if s.lmask is not None:
+        s.lmask = jnp.where(live_arm, arm_lmask, s.lmask)
+    if s.cnt_cur is not None:
+        s.cnt_cur = jnp.where(live_arm, arm_cnt_cur, s.cnt_cur)
+        s.cnt_prev = jnp.where(live_arm, arm_cnt_prev, s.cnt_prev)
+    if s.deadline is not None and len(units) > 1:
+        t0, _l0, _c0 = _land_static(spec, 0)
+        if t0 < S and units[t0].kind == "absent":
+            s.deadline = jnp.where(live_arm & (s.st == t0),
+                                   ts + units[t0].waiting_ms, s.deadline)
 
-    out_carry.update({"slot_state": new_state, "slot_start": slot_start,
-                      "captures": captures, "dropped": dropped})
-    return out_carry, (match_mask, match_caps, match_ts)
+    # ---- absent deadline pass: virtual time has reached ts, so every due
+    # `not … for t` deadline fires now — AFTER the event was processed (the
+    # playback scheduler advances to an event's time after routing it);
+    # ascending unit order cascades an absence chain in one pass.  Slots
+    # that advance here capture the NEXT event onward.
+    if s.deadline is not None:
+        for j, u in enumerate(units):
+            if u.kind != "absent":
+                continue
+            fire = valid & (s.st == j) & (s.deadline <= ts)
+            s.land(fire, j, s.deadline)
 
+    match_caps = jnp.where(emit_arm[:, None, None], s.caps, caps_snap)
 
-def _event_capture_matrix(spec: NfaSpec, event) -> jnp.ndarray:
-    """[S, C] capture lanes this event would write at each state."""
-    S, C = spec.n_states, max(spec.n_caps, 1)
-    rows = []
-    for j in range(S):
-        lanes = [event[a].astype(jnp.float32) for a in spec.cap_cols[j]]
-        lanes += [jnp.float32(0)] * (C - len(lanes))
-        rows.append(jnp.stack(lanes) if lanes else jnp.zeros((C,),
-                                                             jnp.float32))
-    return jnp.stack(rows)
+    out = {"slot_state": s.st, "slot_start": s.start,
+           "slot_enter": s.enter, "slot_seq": s.seq, "arm_seq": s.arm_seq,
+           "captures": s.caps, "dropped": s.dropped}
+    if s.cnt_cur is not None:
+        out["cnt_cur"] = s.cnt_cur
+        out["cnt_prev"] = s.cnt_prev
+    if s.lmask is not None:
+        out["lmask"] = s.lmask
+    if s.deadline is not None:
+        out["deadline"] = s.deadline
+    if s.armed_total is not None:
+        out["armed_total"] = s.armed_total
+    return out, (s.m_mask, match_caps, s.m_ts, s.m_enter, s.m_seq)
 
 
 def build_block_step(spec: NfaSpec):
@@ -233,19 +514,15 @@ def build_block_step(spec: NfaSpec):
 
     block: dict of [P, T] arrays — per-partition event lanes, time-major
     scan; `__valid` masks padding.  matches: (mask [P, T, K],
-    caps [P, T, K, S, C], ts [P, T, K]).
-    """
+    caps [P, T, K, R, C], ts [P, T, K], enter [P, T, K], seq [P, T, K])."""
 
     def per_partition(carry_p, events_p):
-        # events_p: dict of [T] arrays for one partition
         def step(c, ev):
             return _one_partition_step(spec, c, ev)
         return jax.lax.scan(step, carry_p, events_p)
 
     def block_step(carry, block):
-        # carry dict [P, ...]; block dict [P, T]
-        new_carry, (mm, mc, mt) = jax.vmap(per_partition)(carry, block)
-        return new_carry, (mm, mc, mt)
+        return jax.vmap(per_partition)(carry, block)
 
     return block_step
 
@@ -265,8 +542,8 @@ def build_bank_step(spec: NfaSpec):
     def per_partition(carry_p, events_p, prm):
         def step(c, ev):
             inner, acc = c
-            inner2, (mm, _mc, _mt) = _one_partition_step(spec, inner,
-                                                         {**ev, **prm})
+            inner2, (mm, *_rest) = _one_partition_step(spec, inner,
+                                                       {**ev, **prm})
             # accumulate in-carry: avoids a [N, P, T] stacked ys buffer
             return (inner2, acc + jnp.sum(mm.astype(jnp.int32))), None
         (c2, acc), _ = jax.lax.scan(step, (carry_p, jnp.int32(0)), events_p)
@@ -326,4 +603,17 @@ def pack_blocks(partition_ids: np.ndarray, columns: Dict[str, np.ndarray],
     block["__valid"] = valid
     if return_rows:
         return block, row
+    return block
+
+
+def make_timer_block(n_partitions: int, ts_offset: int,
+                     attr_names) -> Dict[str, np.ndarray]:
+    """One virtual TIMER row per partition lane (stream code -2 matches no
+    unit): drives absent-state deadlines and within expiry between real
+    events (≙ the reference Scheduler's TIMER StreamEvents,
+    util/Scheduler.java:180-211)."""
+    block = {a: np.zeros((n_partitions, 1), np.float32) for a in attr_names}
+    block["__ts"] = np.full((n_partitions, 1), ts_offset, np.int32)
+    block["__stream"] = np.full((n_partitions, 1), -2, np.int32)
+    block["__valid"] = np.ones((n_partitions, 1), bool)
     return block

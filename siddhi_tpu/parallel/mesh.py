@@ -47,7 +47,7 @@ def build_sharded_step(spec: NfaSpec, mesh: Mesh, axis: str = "p"):
     step = build_block_step(spec)
 
     def stepped(carry, block):
-        new_carry, (mask, caps, ts) = step(carry, block)
+        new_carry, (mask, caps, ts, _enter, _seq) = step(carry, block)
         stats = {
             "matches": jnp.sum(mask.astype(jnp.int32)),
             "dropped": jnp.sum(new_carry["dropped"]),

@@ -1,20 +1,26 @@
 """Pattern query → batched TPU NFA (the north-star compilation path).
 
 Takes the same SiddhiQL the host oracle runs (compiler/ → query_api
-StateInputStream, reference grammar SiddhiQL.g4:200-345) and lowers an
-`every c0 -> c1 -> ... within t` PATTERN chain into an ops/nfa.py NfaSpec:
-per-state condition programs compiled by plan/expr_compiler.ExprCompiler with
-``xp=jax.numpy`` (so the same expression IR serves both paths), capture-lane
-allocation for cross-state references, and a host runtime that packs event
-batches into [P, T] partition lanes and decodes match buffers.
+StateInputStream, reference grammar SiddhiQL.g4:200-345) and lowers a
+PATTERN or SEQUENCE state tree into an ops/nfa.py NfaSpec: a chain of
+units (simple / count / logical / absent — reference
+util/parser/StateInputStreamParser.java:76-404), per-side condition
+programs compiled by plan/expr_compiler.ExprCompiler with ``xp=jax.numpy``
+(so the same expression IR serves both paths), capture-row allocation for
+cross-state references, and a host runtime that packs event batches into
+[P, T] partition lanes and decodes match buffers.
 
-Supported subset (v1, the BASELINE.json perf configs):
-  - PATTERN type with `every` chains: every e1=S[...] -> e2=S2[...] -> ...
+Supported algebra (the planner falls back to the host oracle
+core/pattern.py with a recorded reason for anything else):
+  - PATTERN and SEQUENCE chains `c0 -> c1 -> ...` / `c0, c1, ...`
+  - leading `every` over the first element or a prefix group
+  - kleene counts `<m:n>` / `*` / `+` / `?` at any chain position
+    (not consecutive, not leading-`<0:n>`, not directly before `not`)
+  - logical `and` / `or` pairs (non-absent sides)
+  - absent `not X[filter] for t` at non-leading positions
   - per-state filters referencing earlier captures (numeric attributes)
-  - top-level `within`
-  - select of captured attributes (`e1.price as p1`, `eN.x`)
-Everything else (logical/absent/kleene, strings in conditions) runs on the
-host oracle (core/pattern.py); the query planner picks per query.
+  - top-level `within` (or an `every`-group within spanning the chain)
+  - select of captured attributes (`e1.price as p1`, `e1[0].x`, `e1[last].x`)
 """
 from __future__ import annotations
 
@@ -25,78 +31,195 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..compiler import SiddhiCompiler
-from ..ops.nfa import NfaSpec, build_block_step, make_carry, pack_blocks
-from ..query_api import (EveryStateElement, Filter, NextStateElement, Query,
+from ..ops.nfa import (COUNT_INF, NfaSpec, UnitSpec, build_block_step,
+                       make_carry, make_timer_block, pack_blocks)
+from ..query_api import (AbsentStreamStateElement, CountStateElement,
+                         EveryStateElement, Filter, LogicalOp,
+                         LogicalStateElement, NextStateElement, Query,
                          StateInputStream, StateType, StreamStateElement)
 from ..query_api.definition import AttrType
-from ..query_api.expression import Variable
+from ..query_api.expression import (AttributeFunction, Constant, IsNull, Not,
+                                    TimeConstant, Variable)
 from ..utils.errors import SiddhiAppCreationError
 from .expr_compiler import EvalCtx, ExprCompiler, Scope
 
 _NUMERIC = (AttrType.INT, AttrType.LONG, AttrType.FLOAT, AttrType.DOUBLE)
 
 
-class _ChainState:
-    def __init__(self, idx: int, ref: str, stream_id: str, definition,
-                 filters):
-        self.idx = idx
+class _Side:
+    """One (stream, filter) condition — a side of a unit."""
+
+    def __init__(self, ref: str, stream_id: str, definition, filters):
         self.ref = ref
         self.stream_id = stream_id
         self.definition = definition
         self.filters = filters
+        self.row = -1            # capture row (assigned later)
+        self.cond_id = -1
 
 
-def _flatten_chain(sis: StateInputStream):
-    """Next(Every(A), Next(B, C)) → ([A, B, C], count0) where count0 is the
-    (min, max) of a leading kleene state; rejects non-chain shapes."""
-    from ..query_api import CountStateElement
-    out: List[StreamStateElement] = []
-    count0: List = [None]
+class _UnitDesc:
+    def __init__(self, kind: str, sides: List[_Side], min_count: int = 1,
+                 max_count: int = 1, waiting_ms: int = 0,
+                 is_and: bool = False):
+        self.kind = kind
+        self.sides = sides
+        self.min_count = min_count
+        self.max_count = max_count
+        self.waiting_ms = waiting_ms
+        self.is_and = is_and
 
-    def base(el, first: bool):
+
+def _reject(msg: str):
+    raise SiddhiAppCreationError("TPU NFA path: " + msg)
+
+
+def _flatten_next(el) -> List:
+    out = []
+
+    def rec(e):
+        if isinstance(e, NextStateElement):
+            rec(e.state)
+            rec(e.next)
+        else:
+            out.append(e)
+    rec(el)
+    return out
+
+
+class _Lowering:
+    """StateElement tree → unit-chain descriptors."""
+
+    def __init__(self, sis: StateInputStream, app):
+        self.app = app
+        self.units: List[_UnitDesc] = []
+        self.is_every = False
+        self.every_group_end = 0
+        self.group_within: Optional[int] = None
+        elements = _flatten_next(sis.state)
+        first = elements[0]
+        if isinstance(first, EveryStateElement):
+            self.is_every = True
+            inner = _flatten_next(first.state)
+            for el in inner:
+                self._lower_element(el)
+            self.every_group_end = len(self.units) - 1
+            if first.within_ms is not None:
+                if len(elements) > 1:
+                    _reject("`within` on a non-suffix `every` group is "
+                            "host-only")
+                self.group_within = first.within_ms
+            elements = elements[1:]
+        for el in elements:
+            if isinstance(el, EveryStateElement):
+                _reject("`every` is supported only on the leading element "
+                        "or prefix group")
+            self._lower_element(el)
+        self._validate()
+
+    def _side_of(self, el: StreamStateElement, idx_hint: int) -> _Side:
+        s = el.stream
+        sid = s.stream_id
+        if sid not in self.app.stream_definitions:
+            raise SiddhiAppCreationError(f"No stream '{sid}'")
+        d = self.app.stream_definitions[sid]
+        filters = [h.expr for h in s.handlers if isinstance(h, Filter)]
+        if any(not isinstance(h, Filter) for h in s.handlers):
+            _reject("only [filter] handlers in conditions")
+        self._n_sides = getattr(self, "_n_sides", 0) + 1
+        return _Side(s.stream_ref or f"__s{self._n_sides}", sid, d, filters)
+
+    def _lower_element(self, el):
+        i = len(self.units)
         if isinstance(el, CountStateElement):
-            if not first:
-                raise SiddhiAppCreationError(
-                    "TPU NFA path supports kleene counts only on the first "
-                    "chain element (A<m:n> -> B -> ...)")
-            if not el.min_count or el.min_count < 1:
-                raise SiddhiAppCreationError(
-                    "TPU NFA path: kleene min count must be >= 1 "
-                    "(zero-occurrence matches need the host oracle)")
-            count0[0] = (el.min_count, el.max_count)
-            return el.state
-        return el
-
-    def rec(el, first: bool):
-        if isinstance(el, NextStateElement):
-            rec(el.state, first)
-            rec(el.next, False)
-            return
-        el = base(el, first)
-        if isinstance(el, EveryStateElement):
-            inner = base(el.state, first)
-            if not first or not isinstance(inner, StreamStateElement):
-                raise SiddhiAppCreationError(
-                    "TPU NFA path supports `every` only on the first chain "
-                    "element")
-            out.append(inner)
+            inner = el.state
+            if not isinstance(inner, StreamStateElement) or \
+                    type(inner) is not StreamStateElement:
+                _reject("kleene counts apply to plain conditions only")
+            mn = el.min_count or 0
+            mx = el.max_count if el.max_count not in (None,
+                                                      CountStateElement.ANY) \
+                else COUNT_INF
+            if el.max_count == CountStateElement.ANY or el.max_count is None:
+                mx = COUNT_INF
+            if mn < 0 or (mx != COUNT_INF and mx < max(mn, 1)):
+                _reject(f"bad kleene bounds <{mn}:{mx}>")
+            self.units.append(_UnitDesc(
+                "count", [self._side_of(inner, i)], min_count=mn,
+                max_count=mx))
+        elif isinstance(el, LogicalStateElement):
+            for side_el in (el.state1, el.state2):
+                if not isinstance(side_el, StreamStateElement) or \
+                        type(side_el) is not StreamStateElement:
+                    _reject("logical pairs with absent/count sides are "
+                            "host-only")
+            if el.op not in (LogicalOp.AND, LogicalOp.OR):
+                _reject(f"logical op {el.op}")
+            self.units.append(_UnitDesc(
+                "logical",
+                [self._side_of(el.state1, i), self._side_of(el.state2, i)],
+                is_and=el.op == LogicalOp.AND))
+        elif isinstance(el, AbsentStreamStateElement):
+            if el.waiting_time_ms is None:
+                _reject("`not X` without `for t` is host-only")
+            self.units.append(_UnitDesc(
+                "absent", [self._side_of(el, i)],
+                waiting_ms=el.waiting_time_ms))
         elif isinstance(el, StreamStateElement):
             if type(el) is not StreamStateElement:
-                raise SiddhiAppCreationError(
-                    "TPU NFA path: absent states not supported")
-            out.append(el)
+                _reject(f"state element {type(el).__name__}")
+            self.units.append(_UnitDesc("simple", [self._side_of(el, i)]))
         else:
-            raise SiddhiAppCreationError(
-                f"TPU NFA path: unsupported state element "
-                f"{type(el).__name__}")
-    rec(sis.state, True)
-    return out, count0[0]
+            _reject(f"state element {type(el).__name__}")
+
+    def _validate(self):
+        units = self.units
+        if not units:
+            _reject("empty pattern")
+        if units[0].kind == "absent":
+            _reject("leading absent states are host-only")
+        if units[0].kind == "count" and units[0].min_count == 0:
+            _reject("leading kleene with min 0 is host-only")
+        for j in range(len(units) - 1):
+            if units[j].kind == "count" and units[j + 1].kind == "count":
+                _reject("consecutive kleene counts are host-only")
+            if units[j].kind == "count" and units[j + 1].kind == "absent":
+                _reject("a kleene count directly before `not` is host-only")
 
 
-def _walk_filter_constants(states) -> List:
+def _scan_vars(e, fn):
+    if isinstance(e, Variable):
+        fn(e)
+    for f in getattr(e, "__dataclass_fields__", {}):
+        v = getattr(e, f)
+        if isinstance(v, list):
+            for x in v:
+                if hasattr(x, "__dataclass_fields__"):
+                    _scan_vars(x, fn)
+        elif hasattr(v, "__dataclass_fields__"):
+            _scan_vars(v, fn)
+
+
+def _contains_guarded_null_ref(e, nullable_refs, inside=False) -> bool:
+    """True if a Not/IsNull wraps a reference to a maybe-unmatched row
+    (None-propagation differs from zero-filled lanes there)."""
+    if isinstance(e, (Not, IsNull)):
+        inside = True
+    if inside and isinstance(e, Variable) and e.stream_id in nullable_refs:
+        return True
+    for f in getattr(e, "__dataclass_fields__", {}):
+        v = getattr(e, f)
+        vs = v if isinstance(v, list) else [v]
+        for x in vs:
+            if hasattr(x, "__dataclass_fields__") and \
+                    _contains_guarded_null_ref(x, nullable_refs, inside):
+                return True
+    return False
+
+
+def _walk_filter_constants(units: List[_UnitDesc]) -> List:
     """Deterministic walk over all numeric Constant/TimeConstant nodes in
     the chain's filters (the per-pattern parameters of a pattern bank)."""
-    from ..query_api.expression import Constant, TimeConstant
     found: List = []
 
     def rec(e):
@@ -113,9 +236,10 @@ def _walk_filter_constants(states) -> List:
                         rec(x)
             elif hasattr(v, "__dataclass_fields__"):
                 rec(v)
-    for st in states:
-        for fe in st.filters:
-            rec(fe)
+    for u in units:
+        for side in u.sides:
+            for fe in side.filters:
+                rec(fe)
     return found
 
 
@@ -131,129 +255,182 @@ class CompiledPatternNFA:
         if query is None:
             query = self._pick_query(app, query_name)
         sis = query.input_stream
-        if not isinstance(sis, StateInputStream) or \
-                sis.state_type != StateType.PATTERN:
-            raise SiddhiAppCreationError("TPU NFA path needs a PATTERN query")
-        elements, count0 = _flatten_chain(sis)
-        self.count0 = count0
-        is_every = isinstance(
-            sis.state.state if isinstance(sis.state, NextStateElement)
-            else sis.state, EveryStateElement)
+        if not isinstance(sis, StateInputStream):
+            raise SiddhiAppCreationError(
+                "TPU NFA path needs a PATTERN/SEQUENCE query")
+        low = _Lowering(sis, app)
+        self.units = low.units
+        self.is_sequence = sis.state_type == StateType.SEQUENCE
+        is_every = low.is_every
+        within_ms = sis.within_ms
+        if low.group_within is not None:
+            within_ms = (low.group_within if within_ms is None
+                         else min(within_ms, low.group_within))
+        if self.is_sequence and self.units[0].kind == "count" and \
+                self.units[0].min_count == 0:
+            _reject("leading min-0 kleene in a sequence is host-only")
+        if self.is_sequence and any(u.kind == "absent" for u in self.units):
+            # the oracle's sequence-absent init/reset guards
+            # (AbsentStreamPreStateProcessor + SEQUENCE barriers) are not
+            # yet mirrored on the device — verified divergence
+            _reject("absent states in a sequence are host-only")
 
         # stream codes: order of first appearance
         self.stream_codes: Dict[str, int] = {}
-        states: List[_ChainState] = []
-        for i, el in enumerate(elements):
-            s = el.stream
-            sid = s.stream_id
-            if sid not in app.stream_definitions:
-                raise SiddhiAppCreationError(f"No stream '{sid}'")
-            if sid not in self.stream_codes:
-                self.stream_codes[sid] = len(self.stream_codes)
-            d = app.stream_definitions[sid]
-            filters = [h.expr for h in s.handlers if isinstance(h, Filter)]
-            if any(not isinstance(h, Filter) for h in s.handlers):
-                raise SiddhiAppCreationError(
-                    "TPU NFA path: only [filter] handlers in conditions")
-            states.append(_ChainState(i, s.stream_ref or f"e{i + 1}", sid, d,
-                                      filters))
-        self.states = states
-        S = len(states)
+        for u in self.units:
+            for side in u.sides:
+                if side.stream_id not in self.stream_codes:
+                    self.stream_codes[side.stream_id] = \
+                        len(self.stream_codes)
 
         # attribute schema: union over referenced streams; numeric only
         self.attr_names: List[str] = []
         self.attr_types: Dict[str, AttrType] = {}
-        for st in states:
-            for a in st.definition.attributes:
-                if a.name not in self.attr_types:
-                    if a.type not in _NUMERIC:
-                        continue  # non-numeric attrs unavailable on TPU path
-                    self.attr_names.append(a.name)
-                    self.attr_types[a.name] = a.type
+        for u in self.units:
+            for side in u.sides:
+                for a in side.definition.attributes:
+                    if a.name not in self.attr_types:
+                        if a.type not in _NUMERIC:
+                            continue    # non-numeric attrs stay host-side
+                        self.attr_names.append(a.name)
+                        self.attr_types[a.name] = a.type
 
-        # capture lanes: (state, attr, first|last) referenced by later
-        # filters or the select clause.  A leading kleene state keeps two
-        # banks (e1[0].x first-occurrence, e1[last].x latest); plain states
-        # alias both to one lane.
-        ref_to_idx = {st.ref: st.idx for st in states}
-        needed_f: List[set] = [set() for _ in range(S)]
-        needed_l: List[set] = [set() for _ in range(S)]
+        # ---- capture rows: one per capturing side
+        rows: List[_Side] = []
+        self.ref_to_unit: Dict[str, int] = {}
+        self.ref_to_side: Dict[str, _Side] = {}
+        for ui, u in enumerate(self.units):
+            for side in u.sides:
+                if u.kind != "absent":
+                    side.row = len(rows)
+                    rows.append(side)
+                if side.ref in self.ref_to_unit:
+                    _reject(f"duplicate state ref '{side.ref}'")
+                self.ref_to_unit[side.ref] = ui
+                self.ref_to_side[side.ref] = side
+        self.rows = rows
+        self.row_unit = [self.ref_to_unit[s.ref] for s in rows]
+        # rows whose captures may legitimately be absent in a match
+        self.nullable_rows: set = set()
+        for ui, u in enumerate(self.units):
+            if u.kind == "count" and u.min_count == 0:
+                self.nullable_rows.add(u.sides[0].row)
+            if u.kind == "logical" and not u.is_and:
+                for side in u.sides:
+                    self.nullable_rows.add(side.row)
+        self.nullable_refs = {s.ref for s in rows
+                              if s.row in self.nullable_rows}
 
-        def which_of(var: Variable, idx: int) -> str:
+        # ---- scan filters + select for cross-state references
+        needed_f: List[set] = [set() for _ in rows]
+        needed_l: List[set] = [set() for _ in rows]
+
+        def which_of(var: Variable, row: int) -> str:
             si = var.stream_index
+            unit = self.units[self.row_unit[row]]
             if si is None or si == 0:
                 return "f"
             if si == -1:
-                if idx == 0 and count0 is not None:
-                    return "l"
-                return "f"      # non-count states hold a single event
-            raise SiddhiAppCreationError(
-                f"TPU NFA path: only e[0]/e[last] capture indexing is "
-                f"supported (got index {si})")
+                return "l" if unit.kind == "count" else "f"
+            _reject(f"only e[0]/e[last] capture indexing is supported "
+                    f"(got index {si})")
 
-        def note(var: Variable, current_idx: Optional[int]):
+        def note(var: Variable, current_side: Optional[_Side]):
             if var.stream_id is None:
                 return
-            idx = ref_to_idx.get(var.stream_id)
-            if idx is None or idx == current_idx:
-                return
+            side = self.ref_to_side.get(var.stream_id)
+            if side is None:
+                # a bare stream-id qualifier is allowed when unambiguous
+                cands = [s for s in self.rows
+                         if s.stream_id == var.stream_id]
+                if len(cands) == 1 and (current_side is None or
+                                        cands[0] is not current_side):
+                    side = cands[0]
+                else:
+                    return
+            if current_side is not None and side is current_side:
+                if var.stream_index not in (None, 0) or \
+                        self.units[self.row_unit[side.row]].kind == "count" \
+                        and var.stream_index is not None:
+                    _reject("self-indexed references inside a kleene "
+                            "condition are host-only")
+                return              # binds to the current event
+            if side.row < 0:
+                _reject(f"'{var.stream_id}' is an absent state; it "
+                        f"captures nothing")
             if var.attribute not in self.attr_types:
-                raise SiddhiAppCreationError(
-                    f"TPU NFA path: captured attribute "
-                    f"'{var.stream_id}.{var.attribute}' is not numeric")
-            (needed_f if which_of(var, idx) == "f" else
-             needed_l)[idx].add(var.attribute)
+                _reject(f"captured attribute "
+                        f"'{var.stream_id}.{var.attribute}' is not numeric")
+            (needed_f if which_of(var, side.row) == "f" else
+             needed_l)[side.row].add(var.attribute)
 
-        def scan_expr(e, current_idx):
-            if isinstance(e, Variable):
-                note(e, current_idx)
-            for f in getattr(e, "__dataclass_fields__", {}):
-                v = getattr(e, f)
-                if isinstance(v, list):
-                    for x in v:
-                        if hasattr(x, "__dataclass_fields__"):
-                            scan_expr(x, current_idx)
-                elif hasattr(v, "__dataclass_fields__"):
-                    scan_expr(v, current_idx)
+        for ui, u in enumerate(self.units):
+            for side in u.sides:
+                for fe in side.filters:
+                    _scan_vars(fe, lambda v, _s=side: note(v, _s))
+                    if _contains_guarded_null_ref(fe, self.nullable_refs):
+                        _reject("not()/isNull() over a maybe-unmatched "
+                                "state is host-only")
+                    # unit-0 conditions must be capture-free (arming reads
+                    # lane 0); in particular a logical side referencing its
+                    # partner is host-only
+                    if ui == 0:
+                        def chk(v, _s=side):
+                            s2 = self.ref_to_side.get(v.stream_id or "")
+                            if s2 is not None and s2 is not _s:
+                                _reject("the first condition cannot "
+                                        "reference other captures")
+                        _scan_vars(fe, chk)
 
-        for st in states:
-            for fe in st.filters:
-                scan_expr(fe, st.idx)
         self.select_outputs: List[Tuple[str, int, str, str]] = []
         for oa in query.selector.attributes:
             e = oa.expr
             if not isinstance(e, Variable) or e.stream_id is None:
-                raise SiddhiAppCreationError(
-                    "TPU NFA path: select must be captured attributes "
-                    "(e1.attr as name)")
-            idx = ref_to_idx[e.stream_id]
+                _reject("select must be captured attributes "
+                        "(e1.attr as name)")
+            side = self.ref_to_side.get(e.stream_id)
+            if side is None or side.row < 0:
+                _reject(f"select references unknown or absent state "
+                        f"'{e.stream_id}'")
             if e.attribute not in self.attr_types:
-                raise SiddhiAppCreationError(
-                    f"TPU NFA path: selected attribute "
-                    f"'{e.stream_id}.{e.attribute}' is not numeric")
-            w = which_of(e, idx)
-            (needed_f if w == "f" else needed_l)[idx].add(e.attribute)
-            self.select_outputs.append((oa.rename, idx, e.attribute, w))
+                _reject(f"selected attribute "
+                        f"'{e.stream_id}.{e.attribute}' is not numeric")
+            w = which_of(e, side.row)
+            (needed_f if w == "f" else needed_l)[side.row].add(e.attribute)
+            self.select_outputs.append((oa.rename, side.row, e.attribute, w))
 
-        # lane layout per state: first-bank cols then last-bank cols; only
-        # the count state actually distinguishes them
-        cap_cols: List[List[str]] = []
+        # ---- lane layout per row: first bank ++ last bank ++ meta lanes
+        cap_cols: List[Tuple[str, ...]] = []
+        n_first: List[int] = []
+        n_lane: List[int] = []
+        matched_lane: List[int] = []
         self.cap_lane: Dict[Tuple[int, str, str], int] = {}
-        n_first0 = 0
-        for j in range(S):
-            fcols = sorted(needed_f[j])
-            lcols = sorted(needed_l[j]) if (j == 0 and count0 is not None) \
-                else []
-            if j == 0:
-                n_first0 = len(fcols)
-            cols = fcols + lcols
-            cap_cols.append(cols)
+        for r, side in enumerate(rows):
+            unit = self.units[self.row_unit[r]]
+            fcols = sorted(needed_f[r])
+            lcols = sorted(needed_l[r]) if unit.kind == "count" else []
+            cols = list(fcols) + list(lcols)
             for lane, a in enumerate(fcols):
-                self.cap_lane[(j, a, "f")] = lane
-                if not lcols:
-                    self.cap_lane[(j, a, "l")] = lane
+                self.cap_lane[(r, a, "f")] = lane
+                if a not in lcols:
+                    self.cap_lane[(r, a, "l")] = lane
             for lane, a in enumerate(lcols):
-                self.cap_lane[(j, a, "l")] = len(fcols) + lane
+                self.cap_lane[(r, a, "l")] = len(fcols) + lane
+                if a not in fcols:
+                    self.cap_lane[(r, a, "f")] = len(fcols) + lane
+            if unit.kind == "count":
+                n_lane.append(len(cols))
+                cols.append("__n")
+                matched_lane.append(-1)
+            elif unit.kind == "logical":
+                n_lane.append(-1)
+                matched_lane.append(len(cols))
+                cols.append("__matched")
+            else:
+                n_lane.append(-1)
+                matched_lane.append(-1)
+            n_first.append(len(fcols))
+            cap_cols.append(tuple(cols))
         C = max((len(c) for c in cap_cols), default=0)
 
         # optional pattern-bank parameterization: numeric filter constants
@@ -261,26 +438,51 @@ class CompiledPatternNFA:
         self._param_map: Dict[int, str] = {}
         self.param_names: List[str] = []
         if parameterize:
-            for j, c in enumerate(_walk_filter_constants(states)):
+            for j, c in enumerate(_walk_filter_constants(self.units)):
                 name = f"__param_{j}"
                 self._param_map[id(c)] = name
                 self.param_names.append(name)
 
-        # compile per-state condition programs against jnp
+        # ---- compile per-side condition programs against jnp
         cond_fns: List[Callable] = []
-        for st in states:
-            cond_fns.append(self._compile_condition(st, ref_to_idx))
+        unit_specs: List[UnitSpec] = []
+        self._n_lane = n_lane
+        self._matched_lane = matched_lane
+        for ui, u in enumerate(self.units):
+            ids = []
+            for side in u.sides:
+                side.cond_id = len(cond_fns)
+                cond_fns.append(self._compile_condition(side, n_slots,
+                                                        n_lane, matched_lane))
+                ids.append(side.cond_id)
+            a = u.sides[0]
+            b = u.sides[1] if len(u.sides) > 1 else None
+            unit_specs.append(UnitSpec(
+                kind=u.kind,
+                stream_a=self.stream_codes[a.stream_id],
+                cond_a=a.cond_id, row_a=a.row,
+                stream_b=self.stream_codes[b.stream_id] if b else -1,
+                cond_b=b.cond_id if b else -1,
+                row_b=b.row if b else -1,
+                is_and=u.is_and, min_count=u.min_count,
+                max_count=u.max_count, waiting_ms=u.waiting_ms))
 
+        # single-shot arming: non-every queries (both modes — a non-every
+        # sequence's one initial partial additionally dies on its first
+        # failed event, see ops/nfa.py), and every-leading-count patterns
+        # (the accumulator chain is shared with the re-arm clones)
+        arm_once = (not is_every) or \
+            (not self.is_sequence and self.units[0].kind == "count")
         self.spec = NfaSpec(
-            n_states=S, n_caps=C, n_slots=n_slots,
-            within_ms=sis.within_ms,
-            state_streams=np.asarray(
-                [self.stream_codes[st.stream_id] for st in states], np.int32),
-            cond_fns=cond_fns, cap_cols=cap_cols,
-            attr_names=self.attr_names, is_every=is_every,
-            count0_min=(count0[0] if count0 is not None else None),
-            count0_max=(count0[1] if count0 is not None else None),
-            n_first_lanes=n_first0)
+            units=tuple(unit_specs), n_rows=len(rows), n_caps=C,
+            n_slots=n_slots, within_ms=within_ms,
+            cond_fns=tuple(cond_fns), cap_cols=tuple(cap_cols),
+            n_first=tuple(n_first), n_lane=tuple(n_lane),
+            matched_lane=tuple(matched_lane),
+            attr_names=tuple(self.attr_names), is_every=is_every,
+            is_sequence=self.is_sequence, arm_once=arm_once,
+            every_group_end=low.every_group_end)
+        self.has_absent = any(u.kind == "absent" for u in self.units)
         self.n_partitions = n_partitions
         self.carry = make_carry(self.spec, n_partitions)
         self._step = jax.jit(build_block_step(self.spec), donate_argnums=0)
@@ -290,7 +492,7 @@ class CompiledPatternNFA:
         # silently
         import warnings
         warned = set()
-        for (_j, a, _w) in self.cap_lane:
+        for (_r, a, _w) in self.cap_lane:
             if self.attr_types.get(a) in (AttrType.INT, AttrType.LONG) and \
                     a not in warned:
                 warned.add(a)
@@ -301,7 +503,6 @@ class CompiledPatternNFA:
 
     @staticmethod
     def _pick_query(app, query_name) -> Query:
-        from ..query_api import find_annotation
         for el in app.execution_elements:
             if not isinstance(el, Query):
                 continue
@@ -309,31 +510,48 @@ class CompiledPatternNFA:
                 return el
         raise SiddhiAppCreationError(f"No query '{query_name}' in app")
 
-    def _compile_condition(self, st: _ChainState, ref_to_idx) -> Callable:
-        if not st.filters:
-            return lambda event, captures: jnp.ones(
-                (self.spec.n_slots,), bool)
+    def _compile_condition(self, side: _Side, n_slots: int,
+                           n_lane, matched_lane) -> Callable:
+        if not side.filters:
+            def true_fn(event, captures):
+                return jnp.ones((captures.shape[0],), bool)
+            return true_fn
         from ..query_api.expression import And
-        expr = st.filters[0]
-        for fe in st.filters[1:]:
+        expr = side.filters[0]
+        for fe in side.filters[1:]:
             expr = And(expr, fe)
+
+        # rows this condition references → validity gates for nullable rows
+        gate_rows: set = set()
+
+        def note_gate(v: Variable):
+            s2 = self.ref_to_side.get(v.stream_id or "")
+            if s2 is not None and s2 is not side and \
+                    s2.row in self.nullable_rows:
+                gate_rows.add(s2.row)
+        _scan_vars(expr, note_gate)
 
         scope = Scope()
         # current event attributes (scalars broadcast over K)
-        for a in st.definition.attributes:
+        for a in side.definition.attributes:
             if a.name not in self.attr_types:
                 continue
 
             def g(ctx, _a=a.name):
                 return ctx.columns[_a]
             scope.add(None, a.name, a.type, g)
-            scope.add(st.stream_id, a.name, a.type, g)
-            scope.add(st.ref, a.name, a.type, g)
-        # earlier captures: [K] lanes (first bank at index 0/None, last bank
-        # at index -1 for a leading kleene state)
-        for other in self.states:
-            if other.idx == st.idx:
+            scope.add(side.stream_id, a.name, a.type, g)
+            scope.add(side.ref, a.name, a.type, g)
+        # other states' captures: [K] lanes (first bank at index 0/None,
+        # last bank at index -1 for count rows)
+        for other in self.rows:
+            if other is side:
                 continue
+            qualifiers = [other.ref]
+            if len([s for s in self.rows
+                    if s.stream_id == other.stream_id]) == 1 and \
+                    other.stream_id != other.ref:
+                qualifiers.append(other.stream_id)
             for a in other.definition.attributes:
                 def gq(ctx, _r=other.ref, _a=a.name):
                     return ctx.qualified[(_r, 0)][_a]
@@ -341,29 +559,31 @@ class CompiledPatternNFA:
                 def gql(ctx, _r=other.ref, _a=a.name):
                     q = ctx.qualified.get((_r, -1))
                     return (q or ctx.qualified[(_r, 0)])[_a]
-                scope.add(other.ref, a.name, a.type, gq, index=0)
-                scope.add(other.ref, a.name, a.type, gq, index=None)
-                scope.add(other.ref, a.name, a.type, gql, index=-1)
+                for qn in qualifiers:
+                    scope.add(qn, a.name, a.type, gq, index=0)
+                    scope.add(qn, a.name, a.type, gq, index=None)
+                    scope.add(qn, a.name, a.type, gql, index=-1)
         if self._param_map:
             compiled = _ParamExprCompiler(scope, self._param_map).compile(
                 expr)
         else:
             compiled = ExprCompiler(scope, jnp).compile(expr)
         cap_lane = self.cap_lane
-        K = None  # resolved at trace time from captures shape
+        rows = self.rows
 
-        def fn(event, captures, _c=compiled, _st=st):
+        def fn(event, captures, _c=compiled, _side=side,
+               _gates=tuple(sorted(gate_rows))):
             k = captures.shape[0]
             qualified = {}
-            for other in self.states:
-                if other.idx == _st.idx:
+            for other in rows:
+                if other is _side:
                     continue
                 cols_f, cols_l = {}, {}
-                for (j, a, w), lane in cap_lane.items():
-                    if j != other.idx:
+                for (r, a, w), lane in cap_lane.items():
+                    if r != other.row:
                         continue
                     (cols_f if w == "f" else cols_l)[a] = \
-                        captures[:, j, lane]
+                        captures[:, r, lane]
                 qualified[(other.ref, 0)] = cols_f
                 if cols_l:
                     qualified[(other.ref, -1)] = cols_l
@@ -373,10 +593,13 @@ class CompiledPatternNFA:
                     cols_now[pn] = event[pn]
             ctx = EvalCtx(cols_now, jnp.full((k,), event["__ts"]), k,
                           qualified=qualified)
-            out = _c.fn(ctx)
-            out = jnp.asarray(out, bool)
+            out = jnp.asarray(_c.fn(ctx), bool)
             if out.ndim == 0:
                 out = jnp.broadcast_to(out, (k,))
+            for r in _gates:
+                vlane = self._n_lane[r] if self._n_lane[r] >= 0 \
+                    else self._matched_lane[r]
+                out = out & (captures[:, r, vlane] > 0)
             return out
         return fn
 
@@ -386,18 +609,11 @@ class CompiledPatternNFA:
         param lanes of this (parameterized) compile."""
         app = SiddhiCompiler.parse(app_string)
         query = self._pick_query(app, query_name)
-        elements, _count0 = _flatten_chain(query.input_stream)
-        if len(elements) != len(self.states):
+        low = _Lowering(query.input_stream, app)
+        if len(low.units) != len(self.units):
             raise SiddhiAppCreationError(
                 "pattern bank: app has a different chain length")
-        states = []
-        for i, el in enumerate(elements):
-            s = el.stream
-            d = app.stream_definitions[s.stream_id]
-            filters = [h.expr for h in s.handlers if isinstance(h, Filter)]
-            states.append(_ChainState(i, s.stream_ref or f"e{i + 1}",
-                                      s.stream_id, d, filters))
-        consts = _walk_filter_constants(states)
+        consts = _walk_filter_constants(low.units)
         if len(consts) != len(self.param_names):
             raise SiddhiAppCreationError(
                 "pattern bank: app has a different constant count")
@@ -425,13 +641,24 @@ class CompiledPatternNFA:
         pad = n_slots - self.spec.n_slots
         c = dict(self.carry)
         P = self.n_partitions
-        S, C = self.spec.n_states, max(self.spec.n_caps, 1)
-        c["slot_state"] = jnp.concatenate(
-            [c["slot_state"], jnp.full((P, pad), -1, jnp.int32)], axis=1)
-        c["slot_start"] = jnp.concatenate(
-            [c["slot_start"], jnp.zeros((P, pad), jnp.int32)], axis=1)
+        R, C = max(self.spec.n_rows, 1), max(self.spec.n_caps, 1)
+
+        def cat(key, fill, shape, dt):
+            c[key] = jnp.concatenate(
+                [c[key], jnp.full(shape, fill, dt)], axis=1)
+        cat("slot_state", -1, (P, pad), jnp.int32)
+        cat("slot_start", 0, (P, pad), jnp.int32)
+        cat("slot_enter", 0, (P, pad), jnp.int32)
+        cat("slot_seq", 0, (P, pad), jnp.int32)
         c["captures"] = jnp.concatenate(
-            [c["captures"], jnp.zeros((P, pad, S, C), jnp.float32)], axis=1)
+            [c["captures"], jnp.zeros((P, pad, R, C), jnp.float32)], axis=1)
+        if "cnt_cur" in c:
+            cat("cnt_cur", 0, (P, pad), jnp.int32)
+            cat("cnt_prev", -1, (P, pad), jnp.int32)
+        if "lmask" in c:
+            cat("lmask", 0, (P, pad), jnp.int32)
+        if "deadline" in c:
+            cat("deadline", 0, (P, pad), jnp.int32)
         self.carry = c
         self.spec = self.spec._replace(n_slots=n_slots)
         self._step = jax.jit(build_block_step(self.spec), donate_argnums=0)
@@ -440,6 +667,21 @@ class CompiledPatternNFA:
         """Device reduction: the fullest partition's live-partial count."""
         return int(jnp.max(jnp.sum(
             (self.carry["slot_state"] >= 0).astype(jnp.int32), axis=1)))
+
+    def min_pending_deadline(self) -> Optional[int]:
+        """Earliest absent-state deadline over all live slots (absolute
+        ms), or None — drives host TIMER scheduling."""
+        if not self.has_absent:
+            return None
+        absent = np.asarray([u.kind == "absent" for u in self.spec.units] +
+                            [False], bool)
+        st = self.carry["slot_state"]
+        waiting = jnp.asarray(absent)[jnp.clip(st, 0, len(self.spec.units))]
+        waiting = waiting & (st >= 0)
+        if not bool(jnp.any(waiting)):
+            return None
+        dl = jnp.where(waiting, self.carry["deadline"], np.int32(2 ** 31 - 1))
+        return int(jnp.min(dl)) + (self.base_ts or 0)
 
     def current_state(self) -> Dict[str, Any]:
         return {"carry": {k: np.asarray(v) for k, v in self.carry.items()},
@@ -457,9 +699,22 @@ class CompiledPatternNFA:
                                  donate_argnums=0)
 
     def process_block(self, block: Dict[str, np.ndarray]):
-        """Run one [P, T] packed block; returns decoded matches."""
-        self.carry, (mask, caps, ts) = self._step(self.carry, block)
-        return mask, caps, ts
+        """Run one [P, T] packed block; returns raw match buffers."""
+        self.carry, (mask, caps, ts, enter, seq) = self._step(self.carry,
+                                                             block)
+        return mask, caps, ts, enter, seq
+
+    def process_timer(self, now_ms: int):
+        """Inject one virtual TIMER row at absolute time now_ms (absent
+        deadlines + within expiry between real events)."""
+        if self.base_ts is None:
+            self.base_ts = now_ms
+        self._maybe_rebase(now_ms, now_ms)
+        block = make_timer_block(self.n_partitions, now_ms - self.base_ts,
+                                 self.attr_names)
+        mask, caps, ts, enter, seq = self.process_block(
+            {k: jnp.asarray(v) for k, v in block.items()})
+        return self.decode_matches(mask, caps, ts, enter, seq)
 
     def process_events(self, partition_ids: np.ndarray,
                        columns: Dict[str, np.ndarray],
@@ -487,8 +742,8 @@ class CompiledPatternNFA:
                             np.asarray(timestamps), codes,
                             self.n_partitions, base_ts=self.base_ts,
                             pad_t_pow2=pad_t_pow2)
-        mask, caps, ts = self.process_block(block)
-        return self.decode_matches(mask, caps, ts)
+        mask, caps, ts, enter, seq = self.process_block(block)
+        return self.decode_matches(mask, caps, ts, enter, seq)
 
     def _ts_safe_max(self) -> int:
         # keep ts - slot_start inside int32 even for a slot clamped to
@@ -499,7 +754,7 @@ class CompiledPatternNFA:
     def _maybe_rebase(self, ts_min: int, ts_max: int) -> None:
         """Timestamps ride int32 ms offsets from base_ts, which overflows
         after ~24.8 days of stream time.  Rebase the origin onto this batch
-        and shift the carried start/accumulator timestamps to match."""
+        and shift the carried start/deadline timestamps to match."""
         safe = self._ts_safe_max()
         if ts_max - self.base_ts <= safe:
             return
@@ -509,42 +764,59 @@ class CompiledPatternNFA:
                 "stream time; int32 timestamp offsets cannot represent it")
         delta = ts_min - self.base_ts
         carry = dict(self.carry)
-        # inactive slots / idle accumulators hold stale values but are gated
-        # on slot_state>=0 / acc_ctr>0, so a uniform shift is safe; clamp in
-        # int64 so an arbitrarily large delta can't wrap int32 — anything
-        # older than `within` is expired regardless of how old, and
-        # -(within+1) reads as expired at every ts >= 0 without the expiry
-        # subtraction ever leaving int32 range (see _ts_safe_max)
+        # inactive slots hold stale values but are gated on slot_state>=0,
+        # so a uniform shift is safe; clamp in int64 so an arbitrarily
+        # large delta can't wrap int32 — anything older than `within` is
+        # expired regardless of how old, and -(within+1) reads as expired
+        # at every ts >= 0 without the expiry subtraction ever leaving
+        # int32 range (see _ts_safe_max)
         lo = -(self.spec.within_ms + 1) \
             if self.spec.within_ms is not None else 0
 
-        def shift(v):
+        def shift(v, lo_v):
             s = np.asarray(v, np.int64) - delta
-            return jnp.asarray(np.maximum(s, lo).astype(np.int32))
-        carry["slot_start"] = shift(carry["slot_start"])
-        if "acc_ts" in carry:
-            carry["acc_ts"] = shift(carry["acc_ts"])
+            return jnp.asarray(np.maximum(s, lo_v).astype(np.int32))
+        carry["slot_start"] = shift(carry["slot_start"], lo)
+        carry["slot_enter"] = shift(carry["slot_enter"], lo)
+        if "deadline" in carry:
+            # a deadline already due stays due at any clamp ≥ lo
+            carry["deadline"] = shift(carry["deadline"], lo)
         self.carry = carry
         self.base_ts += delta
 
-    def decode_matches(self, mask, caps, ts):
+    def decode_matches(self, mask, caps, ts, enter=None, seq=None):
         mask = np.asarray(mask)          # [P, T, K]
-        caps = np.asarray(caps)          # [P, T, K, S, C]
+        caps = np.asarray(caps)          # [P, T, K, R, C]
         ts = np.asarray(ts)
+        enter = np.asarray(enter) if enter is not None else \
+            np.zeros_like(ts)
+        seq = np.asarray(seq) if seq is not None else np.zeros_like(ts)
         out = []
+        order = []
         ps, tts, ks = np.nonzero(mask)
         for p, t, k in zip(ps, tts, ks):
             vals = {}
-            for name, idx, attr, which in self.select_outputs:
-                lane = self.cap_lane[(idx, attr, which)]
-                v = float(caps[p, t, k, idx, lane])
+            for name, row, attr, which in self.select_outputs:
+                if row in self.nullable_rows:
+                    vlane = self._n_lane[row] if self._n_lane[row] >= 0 \
+                        else self._matched_lane[row]
+                    if caps[p, t, k, row, vlane] <= 0:
+                        vals[name] = None
+                        continue
+                lane = self.cap_lane[(row, attr, which)]
+                v = float(caps[p, t, k, row, lane])
                 at = self.attr_types.get(attr)
                 if at in (AttrType.INT, AttrType.LONG):
                     v = int(round(v))
                 vals[name] = v
             out.append((int(p), int(ts[p, t, k]) + (self.base_ts or 0),
                         vals))
-        out.sort(key=lambda m: m[1])
+            order.append((int(enter[p, t, k]), int(seq[p, t, k])))
+        # oracle order: completion time, then the last unit's pending-list
+        # insertion order (when each partial entered the final unit, ties
+        # broken by arm sequence)
+        out = [m for _o, m in sorted(
+            zip(order, out), key=lambda x: (x[1][1], x[0][0], x[0][1]))]
         return out
 
 
@@ -612,7 +884,7 @@ class CompiledPatternBank:
         # carry bytes × ~16 for scan/vmap intermediates (measured on v5e:
         # N=1000 P=10k K=8 S=2 C=1 wants ~22G)
         bytes_per_pattern = n_partitions * n_slots * (
-            4 + 4 + 4 * spec.n_states * max(spec.n_caps, 1)) * 16
+            4 + 4 + 4 * max(spec.n_rows, 1) * max(spec.n_caps, 1)) * 16
         budget = 8 << 30      # leave headroom below ~16G HBM
         chunk = max(1, budget // max(bytes_per_pattern, 1))
         for c in (500, 250, 200, 125, 100, 50, 25, 20, 10, 5, 4, 2, 1):

@@ -149,6 +149,13 @@ class DevicePatternRuntime:
                  for (name, _idx, attr, _w) in self.nfa.select_outputs]
         out_def = StreamDefinition(target, attrs)
         self.head = qr._finish_device_chain(out_def, factory)
+        # outputs decoding from maybe-unmatched rows (or-sides, min-0
+        # kleene) can be None → those columns ride object dtype
+        self._nullable_out = {name for (name, row, _a, _w)
+                              in self.nfa.select_outputs
+                              if row in self.nfa.nullable_rows}
+        self._scheduled_deadline = -1
+        self._shutdown = False
 
         # one receiver per distinct input stream, on the global junctions
         for stream_id, code in self.nfa.stream_codes.items():
@@ -204,20 +211,57 @@ class DevicePatternRuntime:
             pids, cols, np.asarray(data.timestamps, np.int64),
             stream_codes=np.full(n, stream_code, np.int32),
             pad_t_pow2=True)
+        self._emit(matches)
+        if self.nfa.has_absent:
+            self._schedule_absent()
+
+    def _emit(self, matches) -> None:
+        from ..core.event import EventChunk
         if not matches:
             return
         names = [o[0] for o in self.nfa.select_outputs]
         out_cols: Dict[str, np.ndarray] = {}
         for (name, _idx, attr, _w) in self.nfa.select_outputs:
-            dt = self._dtype_for(self.nfa.attr_types[attr])
-            out_cols[name] = np.asarray([m[2][name] for m in matches], dt)
+            vals = [m[2][name] for m in matches]
+            if name in self._nullable_out:
+                col = np.empty(len(vals), object)
+                col[:] = vals
+            else:
+                col = np.asarray(vals,
+                                 self._dtype_for(self.nfa.attr_types[attr]))
+            out_cols[name] = col
         ts = np.asarray([m[1] for m in matches], np.int64)
         self.head.process(EventChunk.from_columns(names, ts, out_cols))
+
+    # -------------------------------------------------- absent-state timers
+
+    def _schedule_absent(self) -> None:
+        """Arm a host TIMER at the earliest pending `not … for t` deadline
+        (≙ AbsentStreamPreStateProcessor scheduling wakeups via
+        util/Scheduler.java)."""
+        dl = self.nfa.min_pending_deadline()
+        if dl is None or dl == self._scheduled_deadline or self._shutdown:
+            return
+        self._scheduled_deadline = dl
+        app_ctx = self.qr.app_runtime.app_ctx
+
+        def fire(now, _dl=dl):
+            if self._shutdown:
+                return
+            with self.qr.lock:
+                matches = self.nfa.process_timer(max(now, _dl))
+                self._emit(matches)
+                self._scheduled_deadline = -1
+                self._schedule_absent()
+        app_ctx.scheduler.notify_at(dl, fire)
 
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> None:
         pass
+
+    def shutdown(self) -> None:
+        self._shutdown = True
 
     # ------------------------------------------------------------ snapshot
 
@@ -230,6 +274,9 @@ class DevicePatternRuntime:
         self.key_lanes = dict(state["key_lanes"])
         # force the overflow guard to re-sync against the restored carry
         self._ub_active = self.nfa.spec.n_slots
+        if self.nfa.has_absent:
+            self._scheduled_deadline = -1
+            self._schedule_absent()
 
 
 class DeviceWindowedAggRuntime:

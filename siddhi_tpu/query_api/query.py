@@ -143,8 +143,11 @@ class NextStateElement(StateElement):
 
 @dataclass
 class EveryStateElement(StateElement):
-    """``every (...)`` — re-arm on each match start."""
+    """``every (...)`` — re-arm on each match start.  within_ms is the
+    group-scoped ``every (...) within t`` bound (SiddhiQL.g4: EVERY
+    '(' chain ')' within_time?)."""
     state: StateElement = None
+    within_ms: Optional[int] = None
 
 
 class LogicalOp(Enum):

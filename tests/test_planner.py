@@ -84,25 +84,39 @@ def test_unsupported_shapes_fall_back_with_reason():
             from every e1=A[v > 1.0] -> e2=A[v > e1.v]
             select e1.s as s1, e2.v as v2 insert into Out;
         """,
-        "logical_and": """
+        "non_leading_every": """
             define stream A (v float);
-            define stream B (w float);
             @info(name='q')
-            from every (e1=A[v > 0.0] and e2=B[w > 0.0]) -> e3=A[v > 10.0]
-            select e1.v as v1, e3.v as v3 insert into Out;
+            from e1=A[v > 0.0] -> every e2=A[v > e1.v]
+            select e1.v as v1, e2.v as v2 insert into Out;
         """,
-        "absent": """
+        "leading_absent": """
             define stream A (v float);
             define stream B (w float);
             @info(name='q')
-            from every e1=A[v > 0.0] -> not B[w > e1.v] for 1 sec
+            from not B[w > 0.0] for 1 sec -> e2=A[v > 0.0]
+            select e2.v as v2 insert into Out;
+        """,
+        "logical_absent_side": """
+            define stream A (v float);
+            define stream B (w float);
+            @info(name='q')
+            from e1=A[v > 0.0] -> not B[w > 0.0] and e3=A[v > 10.0]
             select e1.v as v1 insert into Out;
         """,
-        "sequence": """
+        "consecutive_counts": """
             define stream A (v float);
+            define stream B (w float);
             @info(name='q')
-            from every e1=A[v > 0.0], e2=A[v > e1.v]
-            select e1.v as v1, e2.v as v2 insert into Out;
+            from every e1=A[v > 0.0]<1:2> -> e2=A[v < 0.0]<1:2>
+                -> e3=B[w > 0.0]
+            select e3.w as w3 insert into Out;
+        """,
+        "pattern_group_by": """
+            define stream A (k int, v float);
+            @info(name='q')
+            from every e1=A[v > 0.0] -> e2=A[v > e1.v]
+            select e1.v as v1, e2.v as v2 group by k insert into Out;
         """,
     }
     for name, app in cases.items():

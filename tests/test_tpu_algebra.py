@@ -1,0 +1,412 @@
+"""Device/host conformance for the extended compiled-NFA algebra
+(VERDICT r1 item 3): logical and/or pairs, absent `not … for t`, SEQUENCE
+strict contiguity, non-leading kleene counts, every-prefix groups — each
+construct the planner compiles must produce byte-identical output to the
+host oracle (reference semantics: query/input/stream/state/*).
+"""
+import numpy as np
+import pytest
+
+from siddhi_tpu import QueryCallback, SiddhiManager, StreamCallback
+
+STREAMS = """
+define stream A (k int, v float);
+define stream B (k int, w float);
+"""
+
+
+def run_app(app, sends, engine=None, until=None):
+    prefix = f"@app:engine('{engine}') " if engine else ""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(prefix + app)
+    out = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: out.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    for sid, row, ts in sends:
+        rt.get_input_handler(sid).send(row, timestamp=ts)
+    if until is not None:      # playback apps: advance virtual time
+        rt.app_ctx.timestamp_generator.observe_event_time(until)
+        rt.app_ctx.scheduler.advance_to(until)
+    backend = rt.query_runtimes["q"].backend
+    reason = rt.query_runtimes["q"].backend_reason
+    rt.shutdown()
+    return backend, reason, out
+
+
+def assert_parity(app, sends, until=None):
+    bh, _, host = run_app(app, sends, engine="host", until=until)
+    bd, reason, dev = run_app(app, sends, until=until)
+    assert bh == "host"
+    assert bd == "device", f"did not plan onto the device: {reason}"
+    assert host == dev, f"host={host} dev={dev}"
+
+
+def A(ts, k, v):
+    return ("A", [k, v], ts)
+
+
+def B(ts, k, w):
+    return ("B", [k, w], ts)
+
+
+# --------------------------------------------------------------- logical
+
+def test_logical_and_two_streams():
+    app = STREAMS + """
+        @info(name='q')
+        from every (e1=A[v > 10.0] and e2=B[w > 5.0]) -> e3=A[v > 50.0]
+        select e1.v as v1, e2.w as w2, e3.v as v3 insert into Out;
+    """
+    sends = [A(1000, 1, 20.0), B(1100, 1, 7.0), A(1200, 1, 60.0),
+             B(1300, 1, 9.0), A(1400, 1, 30.0), A(1500, 1, 70.0)]
+    assert_parity(app, sends)
+
+
+def test_logical_and_same_stream_single_event_completes():
+    app = STREAMS + """
+        @info(name='q')
+        from every (e1=A[v > 10.0] and e2=A[k == 3]) -> e3=A[v > 50.0]
+        select e1.v as v1, e2.v as v2, e3.v as v3 insert into Out;
+    """
+    # the first event satisfies BOTH sides at once
+    sends = [A(1000, 3, 20.0), A(1100, 1, 60.0),
+             A(1200, 3, 5.0), A(1300, 1, 12.0), A(1400, 9, 99.0)]
+    assert_parity(app, sends)
+
+
+def test_logical_and_first_side_wins():
+    app = STREAMS + """
+        @info(name='q')
+        from every (e1=A[v > 10.0] and e2=B[w > 5.0])
+        select e1.v as v1, e2.w as w2 insert into Out;
+    """
+    # two A's before the B: the FIRST capture sticks
+    sends = [A(1000, 1, 20.0), A(1100, 1, 30.0), B(1200, 1, 8.0),
+             A(1300, 1, 40.0), B(1400, 1, 9.0)]
+    assert_parity(app, sends)
+
+
+def test_logical_or_null_side_decodes_none():
+    app = STREAMS + """
+        @info(name='q')
+        from every (e1=A[v > 10.0] or e2=B[w > 5.0])
+        select e1.v as v1, e2.w as w2 insert into Out;
+    """
+    sends = [A(1000, 1, 20.0), B(1100, 1, 8.0), A(1200, 1, 5.0),
+             B(1300, 1, 6.5)]
+    assert_parity(app, sends)
+
+
+def test_logical_or_then_chain_with_guarded_ref():
+    app = STREAMS + """
+        @info(name='q')
+        from every (e1=A[v > 10.0] or e2=B[w > 5.0]) -> e3=A[v > e1.v]
+        select e1.v as v1, e3.v as v3 insert into Out;
+    """
+    # when the or fired on the B side, e1.v is null → e3 filter never true
+    sends = [B(1000, 1, 8.0), A(1100, 1, 50.0), A(1200, 1, 20.0),
+             A(1300, 1, 25.0)]
+    assert_parity(app, sends)
+
+
+def test_logical_within_expiry():
+    app = STREAMS + """
+        @info(name='q')
+        from every (e1=A[v > 10.0] and e2=B[w > 5.0]) -> e3=A[v > 50.0]
+            within 1 sec
+        select e1.v as v1, e3.v as v3 insert into Out;
+    """
+    sends = [A(1000, 1, 20.0), B(1100, 1, 7.0), A(2500, 1, 60.0),
+             A(2600, 1, 21.0), B(2700, 1, 7.5), A(2800, 1, 61.0)]
+    assert_parity(app, sends)
+
+
+# ----------------------------------------------------------------- counts
+
+def test_nonleading_count_bounds():
+    app = STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 50.0] -> e2=A[v < 10.0]<2:3> -> e3=A[v > 50.0]
+        select e1.v as v1, e2[0].v as first2, e2[last].v as last2,
+               e3.v as v3
+        insert into Out;
+    """
+    sends = [A(1000, 1, 60.0), A(1100, 1, 1.0), A(1200, 1, 2.0),
+             A(1300, 1, 3.0), A(1400, 1, 70.0),
+             A(1500, 1, 61.0), A(1600, 1, 4.0), A(1700, 1, 71.0)]
+    assert_parity(app, sends)
+
+
+def test_nonleading_count_live_append_until_next():
+    # after min is reached the kleene keeps absorbing while e3 pends;
+    # e2[last] reflects every append up to the closing event
+    app = STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 50.0] -> e2=A[v < 10.0]<1:4> -> e3=A[v > 50.0]
+        select e2[0].v as first2, e2[last].v as last2 insert into Out;
+    """
+    sends = [A(1000, 1, 60.0), A(1100, 1, 1.0), A(1200, 1, 2.0),
+             A(1300, 1, 3.0), A(1400, 1, 70.0)]
+    assert_parity(app, sends)
+
+
+def test_nonleading_star_zero_occurrence():
+    app = STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 50.0] -> e2=A[v < 10.0]* -> e3=B[w > 0.0]
+        select e1.v as v1, e2[0].v as first2, e3.w as w3 insert into Out;
+    """
+    # match with zero e2 events (B follows A directly) and with some
+    sends = [A(1000, 1, 60.0), B(1100, 1, 5.0),
+             A(1200, 1, 61.0), A(1300, 1, 2.0), A(1400, 1, 3.0),
+             B(1500, 1, 6.0)]
+    assert_parity(app, sends)
+
+
+def test_trailing_count_matches_at_min():
+    app = STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 50.0] -> e2=A[v < 10.0]<2:4>
+        select e1.v as v1, e2[0].v as first2, e2[last].v as last2
+        insert into Out;
+    """
+    sends = [A(1000, 1, 60.0), A(1100, 1, 1.0), A(1200, 1, 2.0),
+             A(1300, 1, 3.0)]
+    assert_parity(app, sends)
+
+
+def test_count_within_expiry():
+    app = STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 50.0] -> e2=A[v < 10.0]<2:3> -> e3=A[v > 50.0]
+            within 1 sec
+        select e1.v as v1, e3.v as v3 insert into Out;
+    """
+    sends = [A(1000, 1, 60.0), A(1100, 1, 1.0), A(2500, 1, 2.0),
+             A(2600, 1, 61.0), A(2700, 1, 3.0), A(2800, 1, 4.0),
+             A(2900, 1, 70.0)]
+    assert_parity(app, sends)
+
+
+# ----------------------------------------------------------------- absent
+
+def test_absent_fires_after_wait():
+    app = "@app:playback " + STREAMS + """
+        @info(name='q')
+        from e1=A[v > 20.0] -> not B[w > e1.v] for 1 sec
+        select e1.v as v1 insert into Out;
+    """
+    assert_parity(app, [A(1000, 1, 25.0)], until=2100)
+
+
+def test_absent_suppressed_by_arrival():
+    app = "@app:playback " + STREAMS + """
+        @info(name='q')
+        from e1=A[v > 20.0] -> not B[w > e1.v] for 1 sec
+        select e1.v as v1 insert into Out;
+    """
+    assert_parity(app, [A(1000, 1, 25.0), B(1500, 1, 30.0)], until=2100)
+
+
+def test_absent_arrival_below_filter_does_not_suppress():
+    app = "@app:playback " + STREAMS + """
+        @info(name='q')
+        from e1=A[v > 20.0] -> not B[w > e1.v] for 1 sec
+        select e1.v as v1 insert into Out;
+    """
+    assert_parity(app, [A(1000, 1, 25.0), B(1500, 1, 10.0)], until=2100)
+
+
+def test_absent_middle_then_next_state():
+    app = "@app:playback " + STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 20.0] -> not B[w > 0.0] for 1 sec
+            -> e3=A[v > 50.0]
+        select e1.v as v1, e3.v as v3 insert into Out;
+    """
+    sends = [A(1000, 1, 25.0), A(2500, 1, 60.0),
+             A(3000, 1, 26.0), B(3200, 1, 5.0), A(4500, 1, 61.0)]
+    assert_parity(app, sends, until=5000)
+
+
+# -------------------------------------------------------------- sequences
+
+def test_sequence_basic_strict():
+    app = STREAMS + """
+        @info(name='q')
+        from e1=A[v > 20.0], e2=A[v > e1.v]
+        select e1.v as v1, e2.v as v2 insert into Out;
+    """
+    # the interleaved low event breaks contiguity
+    sends = [A(1000, 1, 25.0), A(1100, 1, 5.0), A(1200, 1, 30.0),
+             A(1300, 1, 40.0)]
+    assert_parity(app, sends)
+
+
+def test_sequence_every():
+    app = STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 20.0], e2=A[v > e1.v]
+        select e1.v as v1, e2.v as v2 insert into Out;
+    """
+    sends = [A(1000, 1, 25.0), A(1100, 1, 30.0), A(1200, 1, 40.0),
+             A(1300, 1, 10.0), A(1400, 1, 50.0), A(1500, 1, 60.0)]
+    assert_parity(app, sends)
+
+
+def test_sequence_two_streams_strict_across_streams():
+    app = STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 20.0], e2=B[w > 0.0]
+        select e1.v as v1, e2.w as w2 insert into Out;
+    """
+    # an intervening A event must break the contiguity of a pending pair
+    sends = [A(1000, 1, 25.0), A(1100, 1, 2.0), B(1200, 1, 5.0),
+             A(1300, 1, 30.0), B(1400, 1, 6.0)]
+    assert_parity(app, sends)
+
+
+def test_sequence_nonleading_plus():
+    app = STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 50.0], e2=A[v < 10.0]+, e3=A[v > 50.0]
+        select e1.v as v1, e2[0].v as first2, e2[last].v as last2,
+               e3.v as v3
+        insert into Out;
+    """
+    sends = [A(1000, 1, 60.0), A(1100, 1, 1.0), A(1200, 1, 2.0),
+             A(1300, 1, 70.0),
+             A(1400, 1, 61.0), A(1500, 1, 20.0), A(1600, 1, 71.0)]
+    assert_parity(app, sends)
+
+
+def test_sequence_nonleading_star():
+    app = STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 50.0], e2=A[v < 10.0]*, e3=B[w > 0.0]
+        select e1.v as v1, e3.w as w3 insert into Out;
+    """
+    sends = [A(1000, 1, 60.0), B(1100, 1, 5.0),
+             A(1200, 1, 61.0), A(1300, 1, 2.0), B(1400, 1, 6.0),
+             A(1500, 1, 62.0), A(1600, 1, 20.0), B(1700, 1, 7.0)]
+    assert_parity(app, sends)
+
+
+def test_sequence_or_pair():
+    app = STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 20.0], e2=A[v > e1.v] or e3=A[k == 7]
+        select e1.v as v1, e2.v as v2, e3.v as v3 insert into Out;
+    """
+    sends = [A(1000, 1, 25.0), A(1100, 7, 2.0), A(1200, 1, 30.0),
+             A(1300, 1, 40.0)]
+    assert_parity(app, sends)
+
+
+def test_sequence_within():
+    app = STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 20.0], e2=A[v > e1.v] within 1 sec
+        select e1.v as v1, e2.v as v2 insert into Out;
+    """
+    sends = [A(1000, 1, 25.0), A(2500, 1, 30.0), A(2600, 1, 40.0)]
+    assert_parity(app, sends)
+
+
+# ----------------------------------------------------- every-prefix groups
+
+def test_every_full_chain_group():
+    app = STREAMS + """
+        @info(name='q')
+        from every (e1=A[v > 10.0] -> e2=A[v > e1.v])
+        select e1.v as v1, e2.v as v2 insert into Out;
+    """
+    # one partial in flight at a time; re-arms only after completion
+    sends = [A(1000, 1, 20.0), A(1100, 1, 30.0), A(1200, 1, 25.0),
+             A(1300, 1, 40.0), A(1400, 1, 11.0), A(1500, 1, 50.0)]
+    assert_parity(app, sends)
+
+
+def test_every_group_within():
+    app = STREAMS + """
+        @info(name='q')
+        from every (e1=A[v > 10.0] -> e2=A[v > e1.v]) within 1 sec
+        select e1.v as v1, e2.v as v2 insert into Out;
+    """
+    sends = [A(1000, 1, 20.0), A(2500, 1, 30.0), A(2600, 1, 40.0)]
+    assert_parity(app, sends)
+
+
+def test_logical_unnamed_sides_plan_onto_device():
+    # synthesized refs for unnamed sides must not collide
+    app = STREAMS + """
+        @info(name='q')
+        from every (A[v > 10.0] and B[w > 5.0]) -> e3=A[v > 50.0]
+        select e3.v as v3 insert into Out;
+    """
+    sends = [A(1000, 1, 20.0), B(1100, 1, 7.0), A(1200, 1, 60.0)]
+    assert_parity(app, sends)
+
+
+def test_sequence_absent_falls_back_to_host():
+    # sequence-absent init/reset guards are not mirrored on the device
+    app = "@app:playback " + STREAMS + """
+        @info(name='q')
+        from e1=A[v > 10.0], not B[w > 0.0] for 1 sec, e3=A[v > 50.0]
+        select e1.v as v1, e3.v as v3 insert into Out;
+    """
+    backend, reason, _ = run_app(app, [A(1000, 1, 20.0)], until=2500)
+    assert backend == "host"
+    assert "host-only" in reason
+
+
+# ------------------------------------------------------------------- fuzz
+
+FUZZ_APPS = [
+    STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 60.0] -> e2=A[v < 30.0]<1:3> -> e3=A[v > 60.0]
+            within 2 sec
+        select e1.v as v1, e2[0].v as f2, e2[last].v as l2, e3.v as v3
+        insert into Out;
+    """,
+    STREAMS + """
+        @info(name='q')
+        from every (e1=A[v > 60.0] and e2=B[w > 60.0]) -> e3=A[v > 80.0]
+            within 2 sec
+        select e1.v as v1, e2.w as w2, e3.v as v3 insert into Out;
+    """,
+    STREAMS + """
+        @info(name='q')
+        from every (e1=A[v > 70.0] or e2=B[w > 70.0]) -> e3=B[w > 80.0]
+        select e1.v as v1, e2.w as w2, e3.w as w3 insert into Out;
+    """,
+    STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 50.0], e2=A[v < 50.0]*, e3=A[v > 90.0]
+        select e1.v as v1, e3.v as v3 insert into Out;
+    """,
+    STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 50.0], e2=B[w > e1.v]
+        select e1.v as v1, e2.w as w2 insert into Out;
+    """,
+]
+
+
+@pytest.mark.parametrize("app_i", range(len(FUZZ_APPS)))
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fuzz_parity(app_i, seed):
+    rng = np.random.default_rng(1000 * app_i + seed)
+    sends = []
+    ts = 1_000_000
+    for _ in range(60):
+        ts += int(rng.integers(50, 400))
+        if rng.random() < 0.7:
+            sends.append(A(ts, int(rng.integers(0, 3)),
+                           float(np.round(rng.uniform(0, 100), 1))))
+        else:
+            sends.append(B(ts, int(rng.integers(0, 3)),
+                           float(np.round(rng.uniform(0, 100), 1))))
+    assert_parity(FUZZ_APPS[app_i], sends)
