@@ -608,6 +608,24 @@ class Parser:
         within_ms = None
         if self.try_kw("within"):
             within_ms = self._parse_time_value()
+        if within_ms is None and len(elements) == 1 and \
+                not isinstance(state, EveryStateElement):
+            # `(chain) within t` — the group spans the whole pattern, so
+            # the group-scoped within IS the pattern within
+            w = getattr(state, "within_ms", None)
+            if w is not None:
+                state.within_ms = None
+                within_ms = w
+        for el in elements:
+            # a group-scoped within on a partial non-every group has no
+            # runtime support — surface it rather than dropping it silently
+            if not isinstance(el, EveryStateElement) and \
+                    getattr(el, "within_ms", None) is not None:
+                t = self.peek()
+                raise SiddhiParserException(
+                    "`within` on a partial pattern group is not supported; "
+                    "attach it to the whole pattern or an `every` group",
+                    t.line, t.col)
         return StateInputStream(state_type=state_type, state=state,
                                 within_ms=within_ms)
 
@@ -617,7 +635,7 @@ class Parser:
             # `every (...) within t`: the group-scoped within parsed inside
             # parse_pattern_unit rides the every element
             w = getattr(inner, "within_ms", None)
-            if w is not None and not isinstance(inner, StateInputStream):
+            if w is not None:
                 inner.within_ms = None
                 return EveryStateElement(state=inner, within_ms=w)
             return EveryStateElement(state=inner)
@@ -679,10 +697,16 @@ class Parser:
     def _maybe_count(self, base: StreamStateElement):
         ANY = CountStateElement.ANY
         if self.at_op("<"):
-            # lookahead to confirm <m:n> (avoid treating compare ops)
-            if self.peek(1).kind in ("INT", "LONG"):
+            # lookahead to confirm <m:n> / <m> / <:n> / <m:>
+            # (avoid treating compare ops)
+            if self.peek(1).kind in ("INT", "LONG") or \
+                    (self.at_op(":", k=1) and
+                     self.peek(2).kind in ("INT", "LONG")):
                 self.eat_op("<")
-                mn = int(self.next().value)
+                if self.peek().kind in ("INT", "LONG"):
+                    mn = int(self.next().value)
+                else:
+                    mn = 0              # <:n> — max-only bound
                 mx = mn
                 if self.try_op(":"):
                     if self.peek().kind in ("INT", "LONG"):

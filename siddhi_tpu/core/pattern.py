@@ -367,12 +367,13 @@ class StateUnit:
         se.timestamp = row[0]
         if cnt >= self.min_count:
             if self.state_type == StateType.SEQUENCE:
+                # reference CountPostStateProcessor.process SEQUENCE branch:
+                # forward + self re-add only — no every clone (sequences
+                # restart via per-event start re-init)
                 if self.is_last:
                     self.engine.collect_match(se)
                 if self.next_pre is not None:
                     self.next_pre.add_state(se)
-                if self.next_every_pre is not None:
-                    self.next_every_pre.add_every_state(se)
                 if cnt != self.max_count:
                     self.add_state(se)
             elif cnt == self.min_count:
@@ -704,16 +705,20 @@ class StateStreamRuntime:
 
     # -------------------------------------------------- expression scopes
 
-    def _max_index_used(self) -> int:
-        """Highest e1[i] index mentioned anywhere in the query."""
+    def _index_range_used(self) -> Tuple[int, int]:
+        """(highest, lowest) e1[i] index mentioned anywhere in the query
+        (lowest covers `e1[last-N]` → -1-N; one extra for the self-state
+        shift below)."""
         from ..query_api.expression import Variable
-        hi = 4
+        hi, lo = 4, -3
 
         def scan(e):
-            nonlocal hi
-            if isinstance(e, Variable) and e.stream_index is not None \
-                    and e.stream_index >= 0:
-                hi = max(hi, e.stream_index)
+            nonlocal hi, lo
+            if isinstance(e, Variable) and e.stream_index is not None:
+                if e.stream_index >= 0:
+                    hi = max(hi, e.stream_index)
+                else:
+                    lo = min(lo, e.stream_index - 1)
             for f in getattr(e, "__dataclass_fields__", {}):
                 v = getattr(e, f)
                 if isinstance(v, list):
@@ -730,10 +735,15 @@ class StateStreamRuntime:
             for h in u._handlers:
                 if isinstance(h, Filter):
                     scan(h.expr)
-        return hi
+        return hi, lo
 
     def _register_qualified(self, scope: Scope, skip_unit=None,
-                            max_idx: int = 4):
+                            max_idx: int = 4, min_idx: int = -3,
+                            self_unit=None):
+        """self_unit: inside a state's own condition, negative indexes
+        exclude the just-appended candidate event — the reference keeps the
+        raw LAST index for same-state references instead of shifting it to
+        the chain tail (ExpressionParser.java:1366, StateEvent.java:158)."""
         stream_count: Dict[str, int] = {}
         for u in self.units:
             stream_count[u.stream_id] = stream_count.get(u.stream_id, 0) + 1
@@ -743,11 +753,13 @@ class StateStreamRuntime:
             qualifiers = [u.ref]
             if stream_count[u.stream_id] == 1 and u.stream_id != u.ref:
                 qualifiers.append(u.stream_id)
-            idxs = list(range(0, max_idx + 1)) + [-1, -2, -3]
+            idxs = list(range(0, max_idx + 1)) + \
+                list(range(-1, min_idx - 1, -1))
             for a in u.definition.attributes:
                 for q in qualifiers:
                     for i in idxs:
-                        def g(ctx, _q=q, _i=i, _a=a.name):
+                        eff = i - 1 if (u is self_unit and i < 0) else i
+                        def g(ctx, _q=q, _i=eff, _a=a.name):
                             d = ctx.qualified.get((_q, _i))
                             if d is None:
                                 return np.asarray([None], object)
@@ -755,8 +767,9 @@ class StateStreamRuntime:
                         scope.add(q, a.name, a.type, g, index=i)
 
     def _compile_filters(self, factory):
-        max_idx = self._max_index_used()
+        max_idx, min_idx = self._index_range_used()
         self._max_idx = max_idx
+        self._min_idx = min_idx
         for u in self.units:
             filters = [h for h in u._handlers if isinstance(h, Filter)]
             others = [h for h in u._handlers if not isinstance(h, Filter)]
@@ -768,7 +781,8 @@ class StateStreamRuntime:
                 u.filter = None
                 continue
             scope = Scope()
-            self._register_qualified(scope, skip_unit=None, max_idx=max_idx)
+            self._register_qualified(scope, skip_unit=None, max_idx=max_idx,
+                                     min_idx=min_idx, self_unit=u)
             # current-event bindings override for this unit (added last)
             for a in u.definition.attributes:
                 def g(ctx, _a=a.name):
@@ -786,7 +800,8 @@ class StateStreamRuntime:
     def _selector_scope(self):
         scope = Scope()
         max_idx = getattr(self, "_max_idx", 4)
-        self._register_qualified(scope, max_idx=max_idx)
+        min_idx = getattr(self, "_min_idx", -3)
+        self._register_qualified(scope, max_idx=max_idx, min_idx=min_idx)
         # unqualified fallback: first unit defining each attribute
         seen: Dict[str, StateUnit] = {}
         union_attrs: List[Attribute] = []
@@ -853,12 +868,17 @@ class StateStreamRuntime:
                                    if x is not u]:
                 qualifiers.append(u.stream_id)
             rows = e if isinstance(e, list) else ([e] if e is not None else [])
+            min_idx = getattr(self, "_min_idx", -3) - 1
             for name in qualifiers:
+                # a duplicated reference resolves to the FIRST unit carrying
+                # it (reference position lookup breaks at the first
+                # meta-stream hit, ExpressionParser.java parseVariable)
                 for i, row in enumerate(rows):
-                    q[(name, i)] = row[1]
+                    if (name, i) not in q:
+                        q[(name, i)] = row[1]
                 n = len(rows)
-                for neg in (-1, -2, -3):
-                    if n + neg >= 0:
+                for neg in range(-1, min_idx - 1, -1):
+                    if n + neg >= 0 and (name, neg) not in q:
                         q[(name, neg)] = rows[n + neg][1]
         return q
 

@@ -352,6 +352,10 @@ class SiddhiAppRuntime:
             t.stop()
         for j in self.junctions.values():
             j.stop()
+        for qr in self.query_runtimes.values():
+            dev = getattr(qr, "device_runtime", None)
+            if dev is not None and hasattr(dev, "shutdown"):
+                dev.shutdown()   # stops absent-state timer callbacks
         self.app_ctx.scheduler.shutdown()
         self.app_ctx.timestamp_generator.shutdown()
         if self.app_ctx.statistics_manager:

@@ -140,8 +140,6 @@ class _Lowering:
             mx = el.max_count if el.max_count not in (None,
                                                       CountStateElement.ANY) \
                 else COUNT_INF
-            if el.max_count == CountStateElement.ANY or el.max_count is None:
-                mx = COUNT_INF
             if mn < 0 or (mx != COUNT_INF and mx < max(mn, 1)):
                 _reject(f"bad kleene bounds <{mn}:{mx}>")
             self.units.append(_UnitDesc(

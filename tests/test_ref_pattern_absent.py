@@ -1,0 +1,287 @@
+"""Port of the reference absent-pattern conformance suite
+(query/pattern/absent/AbsentPatternTestCase.java, 43 @Tests — the 24
+distinct shapes; the remainder are timing permutations of these).
+Reference Thread.sleep timings become explicit playback timestamps with
+`__advance__` rows firing the scheduler between events.
+"""
+from ref_harness import run_query
+
+S12 = """
+define stream Stream1 (symbol string, price float, volume int);
+define stream Stream2 (symbol string, price float, volume int);
+"""
+S123 = S12 + "define stream Stream3 (symbol string, price float, volume int);\n"
+S1234 = S123 + "define stream Stream4 (symbol string, price float, volume int);\n"
+Q = "@info(name = 'query1') "
+
+ADV = lambda ts: ("__advance__", None, ts)
+
+
+def pq(app, sends, expected, advance_to=None):
+    run_query(app, sends, expected, playback=True, advance_to=advance_to)
+
+
+def test_absent_1_fires_after_wait():
+    pq(S12 + Q + """
+        from e1=Stream1[price>20] -> not Stream2[price>e1.price] for 1 sec
+        select e1.symbol as symbol1 insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100], 1000)],
+        [("WSO2",)], advance_to=2200)
+
+
+def test_absent_2_arrival_after_wait_is_fine():
+    pq(S12 + Q + """
+        from e1=Stream1[price>20] -> not Stream2[price>e1.price] for 1 sec
+        select e1.symbol as symbol1 insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100], 1000), ADV(2100),
+         ("Stream2", ["IBM", 58.7, 100], 2150)],
+        [("WSO2",)], advance_to=2200)
+
+
+def test_absent_3_arrival_within_wait_suppresses():
+    pq(S12 + Q + """
+        from e1=Stream1[price>20] -> not Stream2[price>e1.price] for 1 sec
+        select e1.symbol as symbol1 insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100], 1000),
+         ("Stream2", ["IBM", 58.7, 100], 1100)],
+        [], advance_to=2200)
+
+
+def test_absent_4_arrival_below_filter_ignored():
+    pq(S12 + Q + """
+        from e1=Stream1[price>20] -> not Stream2[price>e1.price] for 1 sec
+        select e1.symbol as symbol1 insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100], 1000),
+         ("Stream2", ["IBM", 50.7, 100], 1100)],
+        [("WSO2",)], advance_to=2200)
+
+
+def test_absent_5_leading_quiet_then_match():
+    pq(S12 + Q + """
+        from not Stream1[price>20] for 1 sec -> e2=Stream2[price>30]
+        select e2.symbol as symbol insert into OutputStream;""",
+        [ADV(1200), ("Stream2", ["IBM", 58.7, 100], 1250)],
+        [("IBM",)], advance_to=2000)
+
+
+def test_absent_6_leading_reset_by_arrival():
+    pq(S12 + Q + """
+        from not Stream1[price>20] for 1 sec -> e2=Stream2[price>30]
+        select e2.symbol as symbol insert into OutputStream;""",
+        [("Stream1", ["WSO2", 59.6, 100], 100), ADV(2200),
+         ("Stream2", ["IBM", 58.7, 100], 2250)],
+        [("IBM",)], advance_to=3000)
+
+
+def test_absent_7_leading_arrival_below_filter_then_quick_e2():
+    pq(S12 + Q + """
+        from not Stream1[price>20] for 1 sec -> e2=Stream2[price>30]
+        select e2.symbol as symbol insert into OutputStream;""",
+        [("Stream1", ["WSO2", 5.6, 100], 100),
+         ("Stream2", ["IBM", 58.7, 100], 200)],
+        [], advance_to=2000)
+
+
+def test_absent_8_leading_arrival_then_quick_e2():
+    pq(S12 + Q + """
+        from not Stream1[price>20] for 1 sec -> e2=Stream2[price>30]
+        select e2.symbol as symbol insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100], 100),
+         ("Stream2", ["IBM", 58.7, 100], 200)],
+        [], advance_to=2000)
+
+
+def test_absent_9_trailing_suppressed():
+    pq(S123 + Q + """
+        from e1=Stream1[price>10] -> e2=Stream2[price>20]
+             -> not Stream3[price>30] for 1 sec
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 15.6, 100], 1000),
+         ("Stream2", ["IBM", 28.7, 100], 1100),
+         ("Stream3", ["GOOGLE", 55.7, 100], 1200)],
+        [], advance_to=2500)
+
+
+def test_absent_10_trailing_below_filter():
+    pq(S123 + Q + """
+        from e1=Stream1[price>10] -> e2=Stream2[price>20]
+             -> not Stream3[price>30] for 1 sec
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 15.6, 100], 1000),
+         ("Stream2", ["IBM", 28.7, 100], 1100),
+         ("Stream3", ["GOOGLE", 25.7, 100], 1200)],
+        [("WSO2", "IBM")], advance_to=2500)
+
+
+def test_absent_11_trailing_fires():
+    pq(S123 + Q + """
+        from e1=Stream1[price>10] -> e2=Stream2[price>20]
+             -> not Stream3[price>30] for 1 sec
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 15.6, 100], 1000),
+         ("Stream2", ["IBM", 28.7, 100], 1100)],
+        [("WSO2", "IBM")], advance_to=2500)
+
+
+def test_absent_12_middle_fires_then_next():
+    pq(S123 + Q + """
+        from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+             -> e3=Stream3[price>30]
+        select e1.symbol as symbol1, e3.symbol as symbol3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 15.6, 100], 1000), ADV(2200),
+         ("Stream3", ["GOOGLE", 55.7, 100], 2250)],
+        [("WSO2", "GOOGLE")], advance_to=3000)
+
+
+def test_absent_13_middle_below_filter_arrival():
+    pq(S123 + Q + """
+        from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+             -> e3=Stream3[price>30]
+        select e1.symbol as symbol1, e3.symbol as symbol3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 15.6, 100], 1000),
+         ("Stream2", ["IBM", 8.7, 100], 1100), ADV(2300),
+         ("Stream3", ["GOOGLE", 55.7, 100], 2350)],
+        [("WSO2", "GOOGLE")], advance_to=3000)
+
+
+def test_absent_14_middle_arrival_suppresses():
+    pq(S123 + Q + """
+        from e1=Stream1[price>10] -> not Stream2[price>20] for 1 sec
+             -> e3=Stream3[price>30]
+        select e1.symbol as symbol1, e3.symbol as symbol3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 15.6, 100], 1000),
+         ("Stream2", ["IBM", 28.7, 100], 1100),
+         ("Stream3", ["GOOGLE", 55.7, 100], 1200)],
+        [], advance_to=2500)
+
+
+def test_absent_15_leading_not_confirmed_before_e2():
+    pq(S123 + Q + """
+        from not Stream1[price>10] for 1 sec -> e2=Stream2[price>20]
+             -> e3=Stream3[price>30]
+        select e2.symbol as symbol2, e3.symbol as symbol3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 15.6, 100], 100),
+         ("Stream2", ["IBM", 28.7, 100], 200),
+         ("Stream3", ["GOOGLE", 55.7, 100], 300)],
+        [], advance_to=2000)
+
+
+def test_absent_16_leading_quiet_then_chain():
+    pq(S123 + Q + """
+        from not Stream1[price>10] for 1 sec -> e2=Stream2[price>20]
+             -> e3=Stream3[price>30]
+        select e2.symbol as symbol2, e3.symbol as symbol3
+        insert into OutputStream;""",
+        [ADV(2200), ("Stream2", ["IBM", 28.7, 100], 2250),
+         ("Stream3", ["GOOGLE", 55.7, 100], 2350)],
+        [("IBM", "GOOGLE")], advance_to=3000)
+
+
+def test_absent_17_leading_below_filter_then_chain():
+    pq(S123 + Q + """
+        from not Stream1[price>10] for 1 sec -> e2=Stream2[price>20]
+             -> e3=Stream3[price>30]
+        select e2.symbol as symbol2, e3.symbol as symbol3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 5.6, 100], 600), ADV(1200),
+         ("Stream2", ["IBM", 28.7, 100], 1250),
+         ("Stream3", ["GOOGLE", 55.7, 100], 1350)],
+        [("IBM", "GOOGLE")], advance_to=3000)
+
+
+def test_absent_18_leading_rearmed_after_arrival():
+    pq(S123 + Q + """
+        from not Stream1[price>10] for 1 sec -> e2=Stream2[price>20]
+             -> e3=Stream3[price>30]
+        select e2.symbol as symbol2, e3.symbol as symbol3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 25.6, 100], 100), ADV(1300),
+         ("Stream2", ["IBM", 28.7, 100], 1350),
+         ("Stream3", ["GOOGLE", 55.7, 100], 1450)],
+        [("IBM", "GOOGLE")], advance_to=3000)
+
+
+def test_absent_19_trailing_after_three():
+    pq(S1234 + Q + """
+        from e1=Stream1[price>10] -> e2=Stream2[price>20]
+             -> e3=Stream3[price>30] -> not Stream4[price>40] for 1 sec
+        select e1.symbol as symbol1, e2.symbol as symbol2,
+               e3.symbol as symbol3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 15.6, 100], 1000),
+         ("Stream2", ["IBM", 28.7, 100], 1100),
+         ("Stream3", ["GOOGLE", 35.7, 100], 1200)],
+        [("WSO2", "IBM", "GOOGLE")], advance_to=2500)
+
+
+def test_absent_20_trailing_after_three_suppressed():
+    pq(S1234 + Q + """
+        from e1=Stream1[price>10] -> e2=Stream2[price>20]
+             -> e3=Stream3[price>30] -> not Stream4[price>40] for 1 sec
+        select e1.symbol as symbol1, e2.symbol as symbol2,
+               e3.symbol as symbol3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 15.6, 100], 1000),
+         ("Stream2", ["IBM", 28.7, 100], 1100),
+         ("Stream3", ["GOOGLE", 35.7, 100], 1200),
+         ("Stream4", ["ORACLE", 44.7, 100], 1300)],
+        [], advance_to=2500)
+
+
+def test_absent_21_middle_then_fourth():
+    pq(S1234 + Q + """
+        from e1=Stream1[price>10] -> e2=Stream2[price>20]
+             -> not Stream3[price>30] for 1 sec -> e4=Stream4[price>40]
+        select e1.symbol as symbol1, e2.symbol as symbol2,
+               e4.symbol as symbol4
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 15.6, 100], 1000),
+         ("Stream2", ["IBM", 28.7, 100], 1100), ADV(2300),
+         ("Stream4", ["ORACLE", 44.7, 100], 2350)],
+        [("WSO2", "IBM", "ORACLE")], advance_to=3000)
+
+
+def test_absent_22_middle_poisoned_then_fourth():
+    pq(S1234 + Q + """
+        from e1=Stream1[price>10] -> e2=Stream2[price>20]
+             -> not Stream3[price>30] for 1 sec -> e4=Stream4[price>40]
+        select e1.symbol as symbol1, e2.symbol as symbol2,
+               e4.symbol as symbol4
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 15.6, 100], 1000),
+         ("Stream2", ["IBM", 28.7, 100], 1100),
+         ("Stream3", ["GOOGLE", 38.7, 100], 1200), ADV(2400),
+         ("Stream4", ["ORACLE", 44.7, 100], 2450)],
+        [], advance_to=3000)
+
+
+def test_absent_23_leading_not_confirmed_chain_fails():
+    pq(S1234 + Q + """
+        from not Stream1[price>10] for 1 sec -> e2=Stream2[price>20]
+             -> e3=Stream3[price>30] -> e4=Stream4[price>40]
+        select e2.symbol as symbol2, e3.symbol as symbol3,
+               e4.symbol as symbol4
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 15.6, 100], 100),
+         ("Stream2", ["IBM", 28.7, 100], 200),
+         ("Stream3", ["GOOGLE", 38.7, 100], 300),
+         ("Stream4", ["ORACLE", 44.7, 100], 400)],
+        [], advance_to=2000)
+
+
+def test_absent_24_two_absents():
+    pq(S1234 + Q + """
+        from not Stream1[price>10] for 1 sec -> e2=Stream2[price>20]
+             -> not Stream3[price>30] for 1 sec -> e4=Stream4[price>40]
+        select e2.symbol as symbol2, e4.symbol as symbol4
+        insert into OutputStream;""",
+        [ADV(1200), ("Stream2", ["IBM", 28.7, 100], 1250), ADV(2400),
+         ("Stream4", ["ORACLE", 44.7, 100], 2450)],
+        [("IBM", "ORACLE")], advance_to=3500)

@@ -1,0 +1,307 @@
+"""Port of the reference pattern conformance suites
+query/pattern/CountPatternTestCase.java (15 @Tests) and
+query/pattern/WithinPatternTestCase.java (7 @Tests).
+Sleep-based reference timings become explicit event timestamps.
+"""
+from ref_harness import run_query
+
+S12 = """
+define stream Stream1 (symbol string, price float, volume int);
+define stream Stream2 (symbol string, price float, volume int);
+"""
+EV = "define stream EventStream (symbol string, price float, volume int);\n"
+S1 = "define stream Stream1 (symbol string, price float, volume int);\n"
+Q = "@info(name = 'query1') "
+
+_CNT25 = S12 + Q + """
+    from e1=Stream1[price>20] <2:5> -> e2=Stream2[price>20]
+    select e1[0].price as price1_0, e1[1].price as price1_1,
+           e1[2].price as price1_2, e1[3].price as price1_3,
+           e2.price as price2
+    insert into OutputStream;"""
+
+
+def test_count_1_gap_in_run():
+    run_query(_CNT25,
+        [("Stream1", ["WSO2", 25.6, 100]), ("Stream1", ["GOOG", 47.6, 100]),
+         ("Stream1", ["GOOG", 13.7, 100]), ("Stream1", ["GOOG", 47.8, 100]),
+         ("Stream2", ["IBM", 45.7, 100]), ("Stream2", ["IBM", 55.7, 100])],
+        [(25.6, 47.6, 47.8, None, 45.7)])
+
+
+def test_count_2_closes_at_min():
+    run_query(_CNT25,
+        [("Stream1", ["WSO2", 25.6, 100]), ("Stream1", ["GOOG", 47.6, 100]),
+         ("Stream1", ["GOOG", 13.7, 100]), ("Stream2", ["IBM", 45.7, 100]),
+         ("Stream1", ["GOOG", 47.8, 100]), ("Stream2", ["IBM", 55.7, 100])],
+        [(25.6, 47.6, None, None, 45.7)])
+
+
+def test_count_3_min_reached_after_first_close_attempt():
+    run_query(_CNT25,
+        [("Stream1", ["WSO2", 25.6, 100]), ("Stream2", ["IBM", 45.7, 100]),
+         ("Stream1", ["GOOG", 47.8, 100]), ("Stream2", ["IBM", 55.7, 100])],
+        [(25.6, 47.8, None, None, 55.7)])
+
+
+def test_count_4_below_min_no_match():
+    run_query(_CNT25,
+        [("Stream1", ["WSO2", 25.6, 100]), ("Stream2", ["IBM", 45.7, 100])],
+        [])
+
+
+def test_count_5_max_stops_absorbing():
+    run_query(_CNT25,
+        [("Stream1", ["WSO2", 25.6, 100]), ("Stream1", ["GOOG", 47.6, 100]),
+         ("Stream1", ["GOOG", 23.7, 100]), ("Stream1", ["GOOG", 24.7, 100]),
+         ("Stream1", ["GOOG", 25.7, 100]), ("Stream1", ["WSO2", 27.6, 100]),
+         ("Stream2", ["IBM", 45.7, 100]), ("Stream1", ["GOOG", 47.8, 100]),
+         ("Stream2", ["IBM", 55.7, 100])],
+        [(25.6, 47.6, 23.7, 24.7, 45.7)])
+
+
+def test_count_6_next_filter_on_indexed_capture():
+    run_query(S12 + Q + """
+        from e1=Stream1[price>20] <2:5> -> e2=Stream2[price>e1[1].price]
+        select e1[0].price as price1_0, e1[1].price as price1_1,
+               e2.price as price2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 25.6, 100]), ("Stream1", ["GOOG", 47.6, 100]),
+         ("Stream2", ["IBM", 45.7, 100]), ("Stream2", ["IBM", 55.7, 100])],
+        [(25.6, 47.6, 55.7)])
+
+
+def test_count_7_zero_min_immediate():
+    run_query(S12 + Q + """
+        from e1=Stream1[price>20] <0:5> -> e2=Stream2[price>20]
+        select e1[0].price as price1_0, e1[1].price as price1_1,
+               e2.price as price2
+        insert into OutputStream;""",
+        [("Stream2", ["IBM", 45.7, 100])],
+        [(None, None, 45.7)])
+
+
+def test_count_8_zero_min_with_events():
+    run_query(S12 + Q + """
+        from e1=Stream1[price>20] <0:5> -> e2=Stream2[price>e1[0].price]
+        select e1[0].price as price1_0, e1[1].price as price1_1,
+               e2.price as price2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 25.6, 100]), ("Stream1", ["GOOG", 7.6, 100]),
+         ("Stream2", ["IBM", 45.7, 100])],
+        [(25.6, None, 45.7)])
+
+
+def test_count_9_star_mid_chain():
+    run_query(EV + Q + """
+        from e1 = EventStream [price >= 50 and volume > 100]
+             -> e2 = EventStream [price <= 40] <0:5>
+             -> e3 = EventStream [volume <= 70]
+        select e1.symbol as symbol1, e2[0].symbol as symbol2,
+               e3.symbol as symbol3
+        insert into StockQuote;""",
+        [("EventStream", ["IBM", 75.6, 105]),
+         ("EventStream", ["GOOG", 21.0, 81]),
+         ("EventStream", ["WSO2", 176.6, 65])],
+        [("IBM", "GOOG", "WSO2")], stream="StockQuote")
+
+
+def test_count_10_max_only_first_closes():
+    run_query(EV + Q + """
+        from e1 = EventStream [price >= 50 and volume > 100]
+             -> e2 = EventStream [price <= 40] <:5>
+             -> e3 = EventStream [volume <= 70]
+        select e1.symbol as symbol1, e2[0].symbol as symbol2,
+               e3.symbol as symbol3
+        insert into StockQuote;""",
+        [("EventStream", ["IBM", 75.6, 105]),
+         ("EventStream", ["GOOG", 21.0, 61]),
+         ("EventStream", ["WSO2", 21.0, 61])],
+        [("IBM", None, "GOOG")], stream="StockQuote")
+
+
+def test_count_11_max_only_last_index():
+    run_query(EV + Q + """
+        from e1 = EventStream [price >= 50 and volume > 100]
+             -> e2 = EventStream [price <= 40] <:5>
+             -> e3 = EventStream [volume <= 70]
+        select e1.symbol as symbol1, e2[last].symbol as symbol2,
+               e3.symbol as symbol3
+        insert into StockQuote;""",
+        [("EventStream", ["IBM", 75.6, 105]),
+         ("EventStream", ["GOOG", 21.0, 61]),
+         ("EventStream", ["WSO2", 21.0, 61])],
+        [("IBM", None, "GOOG")], stream="StockQuote")
+
+
+def test_count_12_last_index_filled():
+    run_query(EV + Q + """
+        from e1 = EventStream [price >= 50 and volume > 100]
+             -> e2 = EventStream [price <= 40] <:5>
+             -> e3 = EventStream [volume <= 70]
+        select e1.symbol as symbol1, e2[last].symbol as symbol2,
+               e3.symbol as symbol3
+        insert into StockQuote;""",
+        [("EventStream", ["IBM", 75.6, 105]),
+         ("EventStream", ["GOOG", 21.0, 91]),
+         ("EventStream", ["FB", 21.0, 81]),
+         ("EventStream", ["WSO2", 21.0, 61])],
+        [("IBM", "FB", "WSO2")], stream="StockQuote")
+
+
+def test_count_13_self_symbol_match_sliding():
+    run_query(EV + Q + """
+        from every e1 = EventStream
+             -> e2 = EventStream [e1.symbol==e2.symbol]<4:6>
+        select e1.volume as volume1, e2[0].volume as volume2,
+               e2[1].volume as volume3, e2[2].volume as volume4,
+               e2[3].volume as volume5, e2[4].volume as volume6,
+               e2[5].volume as volume7
+        insert into StockQuote;""",
+        [("EventStream", ["IBM", 75.6, 100]),
+         ("EventStream", ["IBM", 75.6, 200]),
+         ("EventStream", ["IBM", 75.6, 300]),
+         ("EventStream", ["GOOG", 21.0, 91]),
+         ("EventStream", ["IBM", 75.6, 400]),
+         ("EventStream", ["IBM", 75.6, 500]),
+         ("EventStream", ["GOOG", 21.0, 91]),
+         ("EventStream", ["IBM", 75.6, 600]),
+         ("EventStream", ["IBM", 75.6, 700]),
+         ("EventStream", ["IBM", 75.6, 800]),
+         ("EventStream", ["GOOG", 21.0, 91]),
+         ("EventStream", ["IBM", 75.6, 900])],
+        [(100, 200, 300, 400, 500, None, None),
+         (200, 300, 400, 500, 600, None, None),
+         (300, 400, 500, 600, 700, None, None),
+         (400, 500, 600, 700, 800, None, None),
+         (500, 600, 700, 800, 900, None, None)], stream="StockQuote")
+
+
+def test_count_14_zero_min_two_collected():
+    run_query(S12 + Q + """
+        from e1=Stream1[price>20] <0:5> -> e2=Stream2[price>e1[0].price]
+        select e1[0].price as price1_0, e1[1].price as price1_1,
+               e1[2].price as price1_2, e2.price as price2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 25.6, 100]), ("Stream1", ["WSO2", 23.6, 100]),
+         ("Stream1", ["GOOG", 7.6, 100]), ("Stream2", ["IBM", 45.7, 100])],
+        [(25.6, 23.6, None, 45.7)])
+
+
+def test_count_15_exact_count_then_absent_and():
+    run_query(S12 + Q + """
+        from every e1=Stream1[price>20] -> e2=Stream1[price>20]<2>
+             -> not Stream1[price>20] and e3=Stream2
+        select e1.price as price1_0, e2[0].price as price2_0,
+               e2[1].price as price2_1, e2[2].price as price2_2,
+               e3.price as price2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 25.6, 100]), ("Stream1", ["WSO2", 23.6, 100]),
+         ("Stream1", ["WSO2", 23.6, 100]), ("Stream1", ["GOOG", 27.6, 100]),
+         ("Stream1", ["GOOG", 28.6, 100]), ("Stream2", ["IBM", 45.7, 100])],
+        [(23.6, 27.6, 28.6, None, 45.7)])
+
+
+# ---------------------------------------------- WithinPatternTestCase
+
+def test_within_1_first_partial_expires():
+    run_query(S12 + Q + """
+        from every e1=Stream1[price>20] -> e2=Stream2[price>e1.price]
+            within 1 sec
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100], 1000),
+         ("Stream1", ["GOOG", 54.0, 100], 2500),
+         ("Stream2", ["IBM", 55.7, 100], 2600)],
+        [("GOOG", "IBM")])
+
+
+def test_within_2_group_syntax():
+    run_query(S12 + Q + """
+        from (every e1=Stream1[price>20] -> e2=Stream2[price>e1.price])
+            within 1 sec
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100], 1000),
+         ("Stream1", ["GOOG", 54.0, 100], 2500),
+         ("Stream2", ["IBM", 55.7, 100], 2600)],
+        [("GOOG", "IBM")])
+
+
+def test_within_3_nested_group():
+    run_query(S12 + Q + """
+        from (every (e1=Stream1[price>20] -> e3=Stream1[price>20])
+              -> e2=Stream2[price>e1.price]) within 2 sec
+        select e1.price as price1, e3.price as price3, e2.price as price2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100], 1000),
+         ("Stream1", ["GOOG", 54.0, 100], 1600),
+         ("Stream1", ["WSO2", 53.6, 100], 2200),
+         ("Stream1", ["GOOG", 53.0, 100], 3100),
+         ("Stream2", ["IBM", 57.7, 100], 3700)],
+        [(53.6, 53.0, 57.7)])
+
+
+def test_within_4_expired_restart():
+    run_query(S1 + Q + """
+        from every (e1=Stream1 -> e2=Stream1[symbol == e1.symbol])
+            within 5 sec
+        select e1.symbol as symbol1, e1.volume as volume1,
+               e2.symbol as symbol2, e2.volume as volume2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100], 1000),
+         ("Stream1", ["WSO2", 55.7, 150], 7500),
+         ("Stream1", ["WSO2", 58.7, 200], 8100),
+         ("Stream1", ["WSO2", 58.7, 250], 8200)],
+        [("WSO2", 150, "WSO2", 200)])
+
+
+def test_within_5_three_state_group():
+    run_query(S1 + Q + """
+        from every (e1=Stream1 -> e2=Stream1[symbol == e1.symbol]
+             -> e3=Stream1[symbol == e2.symbol]) within 5 sec
+        select e1.symbol as symbol1, e1.volume as volume1,
+               e2.symbol as symbol2, e2.volume as volume2,
+               e3.symbol as symbol3, e3.volume as volume3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100], 1000),
+         ("Stream1", ["WSO2", 56.6, 150], 1100),
+         ("Stream1", ["WSO2", 57.7, 200], 7500),
+         ("Stream1", ["WSO2", 58.7, 250], 8100),
+         ("Stream1", ["WSO2", 57.7, 300], 8200),
+         ("Stream1", ["WSO2", 59.7, 350], 8300)],
+        [("WSO2", 200, "WSO2", 250, "WSO2", 300)])
+
+
+def test_within_6_two_rounds():
+    run_query(S1 + Q + """
+        from every (e1=Stream1 -> e2=Stream1[symbol == e1.symbol]
+             -> e3=Stream1[symbol == e2.symbol]) within 5 sec
+        select e1.symbol as symbol1, e1.volume as volume1,
+               e2.symbol as symbol2, e2.volume as volume2,
+               e3.symbol as symbol3, e3.volume as volume3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100], 1000),
+         ("Stream1", ["WSO2", 55.7, 150], 1100),
+         ("Stream1", ["WSO2", 58.7, 200], 1200),
+         ("Stream1", ["WSO2", 58.7, 210], 1300),
+         ("Stream1", ["WSO2", 58.7, 250], 1900),
+         ("Stream1", ["WSO2", 58.7, 260], 2000),
+         ("Stream1", ["WSO2", 58.7, 270], 2100)],
+        [("WSO2", 100, "WSO2", 150, "WSO2", 200),
+         ("WSO2", 210, "WSO2", 250, "WSO2", 260)])
+
+
+def test_within_7_expiry_then_chain():
+    run_query(S1 + Q + """
+        from every (e1=Stream1 -> e2=Stream1[symbol == e1.symbol]
+             -> e3=Stream1[symbol == e2.symbol]) within 5 sec
+        select e1.symbol as symbol1, e1.volume as volume1,
+               e2.symbol as symbol2, e2.volume as volume2,
+               e3.symbol as symbol3, e3.volume as volume3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100], 1000),
+         ("Stream1", ["WSO2", 56.6, 150], 7500),
+         ("Stream1", ["WSO2", 57.7, 200], 7600),
+         ("Stream1", ["WSO2", 58.7, 250], 8200)],
+        [("WSO2", 150, "WSO2", 200, "WSO2", 250)])

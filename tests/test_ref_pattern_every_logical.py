@@ -1,0 +1,327 @@
+"""Port of the reference pattern conformance suites
+query/pattern/EveryPatternTestCase.java (9 @Tests) and
+query/pattern/LogicalPatternTestCase.java (19 @Tests).
+Expected payloads are the reference's own assertions; ref_harness re-runs
+each app on the device engine when the planner compiles it.
+"""
+from ref_harness import run_query
+
+S12 = """
+define stream Stream1 (symbol string, price float, volume int);
+define stream Stream2 (symbol string, price float, volume int);
+"""
+S12B = """
+define stream Stream1 (symbol string, price float, volume int);
+define stream Stream2 (symbol string, price1 float, volume int);
+"""
+S123 = S12 + "define stream Stream3 (symbol string, price float, volume int);\n"
+S1 = "define stream Stream1 (symbol string, price float, volume int);\n"
+Q = "@info(name = 'query1') "
+
+
+# ------------------------------------------------ EveryPatternTestCase
+
+def test_every_1_plain_chain():
+    run_query(S12 + Q + """
+        from e1=Stream1[price>20] -> e2=Stream2[price>e1.price]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 55.7, 100])],
+        [("WSO2", "IBM")])
+
+
+def test_every_2_no_every_single_match():
+    run_query(S12B + Q + """
+        from e1=Stream1[price>20] -> e2=Stream2[price1>e1.price]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["GOOG", 55.6, 100]),
+         ("Stream2", ["IBM", 55.7, 100])],
+        [("WSO2", "IBM")])
+
+
+def test_every_3_two_partials_one_closer():
+    run_query(S12B + Q + """
+        from every e1=Stream1[price>20] -> e2=Stream2[price1>e1.price]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["GOOG", 55.6, 100]),
+         ("Stream2", ["IBM", 55.7, 100])],
+        [("WSO2", "IBM"), ("GOOG", "IBM")])
+
+
+def test_every_4_prefix_group():
+    run_query(S12 + Q + """
+        from every ( e1=Stream1[price>20] -> e3=Stream1[price>20] )
+             -> e2=Stream2[price>e1.price]
+        select e1.price as price1, e3.price as price3, e2.price as price2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["GOOG", 54.0, 100]),
+         ("Stream2", ["IBM", 57.7, 100])],
+        [(55.6, 54.0, 57.7)])
+
+
+def test_every_5_prefix_group_two_rounds():
+    run_query(S12 + Q + """
+        from every ( e1=Stream1[price>20] -> e3=Stream1[price>20] )
+             -> e2=Stream2[price>e1.price]
+        select e1.price as price1, e3.price as price3, e2.price as price2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["GOOG", 54.0, 100]),
+         ("Stream1", ["WSO2", 53.6, 100]), ("Stream1", ["GOOG", 53.0, 100]),
+         ("Stream2", ["IBM", 57.7, 100])],
+        [(55.6, 54.0, 57.7), (53.6, 53.0, 57.7)])
+
+
+def test_every_6_mid_chain_group():
+    run_query(S12 + Q + """
+        from e4=Stream1[symbol=='MSFT']
+             -> every ( e1=Stream1[price>20] -> e3=Stream1[price>20] )
+             -> e2=Stream2[price>e1.price]
+        select e1.price as price1, e3.price as price3, e2.price as price2
+        insert into OutputStream;""",
+        [("Stream1", ["MSFT", 55.6, 100]), ("Stream1", ["WSO2", 55.7, 100]),
+         ("Stream1", ["GOOG", 54.0, 100]), ("Stream1", ["WSO2", 53.6, 100]),
+         ("Stream1", ["GOOG", 53.0, 100]), ("Stream2", ["IBM", 57.7, 100])],
+        [(55.7, 54.0, 57.7), (53.6, 53.0, 57.7)])
+
+
+def test_every_7_whole_chain_group():
+    run_query(S1 + Q + """
+        from every ( e1=Stream1[price>20] -> e3=Stream1[price>20] )
+        select e1.price as price1, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["MSFT", 55.6, 100]), ("Stream1", ["WSO2", 57.6, 100]),
+         ("Stream1", ["GOOG", 54.0, 100]), ("Stream1", ["WSO2", 53.6, 100])],
+        [(55.6, 57.6), (54.0, 53.6)])
+
+
+def test_every_8_single_state():
+    run_query(S1 + Q + """
+        from every e1=Stream1[price>20]
+        select e1.price as price1
+        insert into OutputStream;""",
+        [("Stream1", ["MSFT", 55.6, 100]), ("Stream1", ["WSO2", 57.6, 100])],
+        [(55.6,), (57.6,)])
+
+
+def test_every_9_duplicate_ref_overwrite():
+    run_query(S1 + Q + """
+        from every e1=Stream1[symbol == 'MSFT'] -> e1=Stream1[symbol == 'WSO2']
+        select e1.price as price1
+        insert into OutputStream;""",
+        [("Stream1", ["MSFT", 55.6, 100]), ("Stream1", ["MSFT", 77.6, 100]),
+         ("Stream1", ["WSO2", 57.6, 100])],
+        [(55.6,), (77.6,)])
+
+
+# ---------------------------------------------- LogicalPatternTestCase
+
+def test_logical_1_or_first_side():
+    run_query(S12 + Q + """
+        from e1=Stream1[price > 20]
+             -> e2=Stream2[price > e1.price] or e3=Stream2['IBM' == symbol]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["GOOG", 59.6, 100])],
+        [("WSO2", "GOOG")])
+
+
+def test_logical_2_or_second_side_null_first():
+    run_query(S12 + Q + """
+        from e1=Stream1[price > 20]
+             -> e2=Stream2[price > e1.price] or e3=Stream2['IBM' == symbol]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 10.7, 100])],
+        [("WSO2", None)])
+
+
+def test_logical_3_or_single_shot():
+    run_query(S12 + Q + """
+        from e1=Stream1[price > 20]
+             -> e2=Stream2[price > e1.price] or e3=Stream2['IBM' == symbol]
+        select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 72.7, 100]),
+         ("Stream2", ["IBM", 75.7, 100])],
+        [("WSO2", 72.7, None)])
+
+
+def test_logical_4_and_two_events():
+    run_query(S12 + Q + """
+        from e1=Stream1[price > 20]
+             -> e2=Stream2[price > e1.price] and e3=Stream2['IBM' == symbol]
+        select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["GOOG", 72.7, 100]),
+         ("Stream2", ["IBM", 4.7, 100])],
+        [("WSO2", 72.7, 4.7)])
+
+
+def test_logical_5_and_same_event_both_sides():
+    run_query(S12 + Q + """
+        from e1=Stream1[price > 20]
+             -> e2=Stream2[price > e1.price] and e3=Stream2['IBM' == symbol]
+        select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 72.7, 100]),
+         ("Stream2", ["IBM", 75.7, 100])],
+        [("WSO2", 72.7, 72.7)])
+
+
+def test_logical_6_and_cross_streams():
+    run_query(S12 + Q + """
+        from e1=Stream1[price > 20]
+             -> e2=Stream2[price > e1.price] and e3=Stream1['IBM' == symbol]
+        select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 72.7, 100]),
+         ("Stream1", ["IBM", 75.7, 100])],
+        [("WSO2", 72.7, 75.7)])
+
+
+def test_logical_7_leading_and():
+    run_query(S12 + Q + """
+        from e1=Stream1[price > 20] and e2=Stream2[price >30]
+             -> e3=Stream2['IBM' == symbol]
+        select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["GOOG", 72.7, 100]),
+         ("Stream2", ["IBM", 4.7, 100])],
+        [("WSO2", 72.7, 4.7)])
+
+
+def test_logical_8_leading_or_first():
+    run_query(S12 + Q + """
+        from e1=Stream1[price > 20] or e2=Stream2[price >30]
+             -> e3=Stream2['IBM' == symbol]
+        select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["GOOG", 72.7, 100]),
+         ("Stream2", ["IBM", 4.7, 100])],
+        [("WSO2", None, 4.7)])
+
+
+def test_logical_9_leading_or_second():
+    run_query(S12 + Q + """
+        from e1=Stream1[price > 20] or e2=Stream2[price >30]
+             -> e3=Stream2['IBM' == symbol]
+        select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream2", ["GOOG", 72.7, 100]), ("Stream2", ["IBM", 4.7, 100])],
+        [(None, 72.7, 4.7)])
+
+
+def test_logical_10_leading_or_direct():
+    run_query(S12 + Q + """
+        from e1=Stream1[price > 20] or e2=Stream2[price >30]
+             -> e3=Stream2['IBM' == symbol]
+        select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 4.7, 100])],
+        [("WSO2", None, 4.7)])
+
+
+def test_logical_11_every_then_and_pair():
+    run_query(S123 + Q + """
+        from every e1=Stream1[price >20]
+             -> e2=Stream2['IBM' == symbol] and e3=Stream3['WSO2' == symbol]
+        select e1.price as price1, e2.price as price2, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["IBM", 25.5, 100]), ("Stream1", ["IBM", 59.65, 100]),
+         ("Stream2", ["IBM", 45.5, 100]), ("Stream3", ["WSO2", 46.56, 100])],
+        [(25.5, 45.5, 46.56), (59.65, 45.5, 46.56)], unordered=True)
+
+
+def test_logical_12_every_then_or_pair():
+    run_query(S123 + Q + """
+        from every e1=Stream1[price >20]
+             -> e2=Stream2['IBM' == symbol] or e3=Stream3['WSO2' == symbol]
+        select e1.price as price1, e2.price as price2, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["IBM", 25.5, 100]), ("Stream1", ["IBM", 59.65, 100]),
+         ("Stream2", ["IBM", 45.5, 100])],
+        [(25.5, 45.5, None), (59.65, 45.5, None)], unordered=True)
+
+
+def test_logical_13_bare_and():
+    run_query(S12 + Q + """
+        from e1=Stream1[price > 20] and e2=Stream2[price >30]
+        select e1.symbol as symbol1, e2.price as price2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 25.0, 100]), ("Stream2", ["IBM", 35.0, 100]),
+         ("Stream1", ["GOOGLE", 45.0, 100]),
+         ("Stream2", ["ORACLE", 55.0, 100])],
+        [("WSO2", 35.0)])
+
+
+def test_logical_14_bare_or():
+    run_query(S12 + Q + """
+        from e1=Stream1[price > 20] or e2=Stream2[price >30]
+        select e1.symbol as symbol1, e2.price as price2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 25.0, 100]), ("Stream2", ["IBM", 35.0, 100]),
+         ("Stream2", ["ORACLE", 45.0, 100])],
+        [("WSO2", None)])
+
+
+def test_logical_15_every_and():
+    run_query(S12 + Q + """
+        from every (e1=Stream1[price > 20] and e2=Stream2[price >30])
+        select e1.symbol as symbol1, e2.price as price2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 25.0, 100]), ("Stream2", ["IBM", 35.0, 100]),
+         ("Stream1", ["GOOGLE", 45.0, 100]),
+         ("Stream2", ["ORACLE", 55.0, 100])],
+        [("WSO2", 35.0), ("GOOGLE", 55.0)])
+
+
+def test_logical_16_every_or():
+    run_query(S12 + Q + """
+        from every (e1=Stream1[price > 20] or e2=Stream2[price >30])
+        select e1.symbol as symbol1, e2.price as price2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 25.0, 100]), ("Stream2", ["IBM", 35.0, 100]),
+         ("Stream2", ["ORACLE", 45.0, 100])],
+        [("WSO2", None), (None, 35.0), (None, 45.0)])
+
+
+def test_logical_17_or_within_expired():
+    run_query(S12 + Q + """
+        from e1=Stream1[price > 20]
+             -> e2=Stream2[price > e1.price] or e3=Stream2['IBM' == symbol]
+             within 1 sec
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100], 1000),
+         ("Stream2", ["GOOG", 59.6, 100], 2200)],
+        [])
+
+
+def test_logical_18_and_within_expired():
+    run_query(S12 + Q + """
+        from e1=Stream1[price > 20]
+             -> e2=Stream2[price > e1.price] and e3=Stream2['IBM' == symbol]
+             within 1 sec
+        select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100], 1000),
+         ("Stream2", ["GOOG", 72.7, 100], 2200),
+         ("Stream2", ["IBM", 4.7, 100], 2300)],
+        [])
+
+
+def test_logical_19_every_and_pair_then_next():
+    run_query(S123 + Q + """
+        from every (e1=Stream1[price>10] and e2=Stream2[price>20])
+             -> e3=Stream3[price>30]
+        select e1.symbol as symbol1, e2.symbol as symbol2,
+               e3.symbol as symbol3
+        insert into OutputStream;""",
+        [("Stream1", ["ORACLE", 15.0, 100]),
+         ("Stream2", ["MICROSOFT", 45.0, 100]),
+         ("Stream1", ["IBM", 55.0, 100]), ("Stream2", ["WSO2", 65.0, 100]),
+         ("Stream3", ["GOOGLE", 75.0, 100])],
+        [("ORACLE", "MICROSOFT", "GOOGLE"), ("IBM", "WSO2", "GOOGLE")],
+        unordered=True)

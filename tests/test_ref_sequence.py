@@ -1,0 +1,370 @@
+"""Port of the reference sequence conformance suite
+(siddhi-core/src/test/java/io/siddhi/core/query/sequence/SequenceTestCase.java,
+32 @Test methods; testQuery17 does not exist upstream).  Expected payloads
+are the reference's own assertions.  ref_harness additionally re-runs each
+app with engine auto and asserts backend-identical output whenever the
+planner compiles it to the device.
+"""
+from ref_harness import run_query
+
+S12 = """
+define stream Stream1 (symbol string, price float, volume int);
+define stream Stream2 (symbol string, price float, volume int);
+"""
+S123 = S12 + "define stream Stream3 (symbol string, price float, volume int);\n"
+STOCK_TWITTER = """
+define stream StockStream (symbol string, price float, volume int);
+define stream TwitterStream (symbol string, count int);
+"""
+SS12 = """
+define stream StockStream1 (symbol string, price float, volume int);
+define stream StockStream2 (symbol string, price float, volume int);
+"""
+
+Q = "@info(name = 'query1') "
+
+
+def test_seq_1_basic():
+    run_query(S12 + Q + """
+        from e1=Stream1[price>20],e2=Stream2[price>e1.price]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 55.7, 100])],
+        [("WSO2", "IBM")])
+
+
+def test_seq_2_every_restart():
+    run_query(S12 + Q + """
+        from every e1=Stream1[price>20], e2=Stream2[price>e1.price]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["GOOG", 57.6, 100]),
+         ("Stream2", ["IBM", 65.7, 100])],
+        [("GOOG", "IBM")])
+
+
+def test_seq_3_trailing_star():
+    run_query(S12 + Q + """
+        from every e1=Stream1[price>20], e2=Stream2[price>e1.price]*
+        select e1.symbol as symbol1, e2[0].symbol as symbol2,
+               e2[1].symbol as symbol3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["IBM", 55.7, 100])],
+        [("WSO2", None, None), ("IBM", None, None)])
+
+
+def test_seq_4_leading_star_two_collected():
+    run_query(S12 + Q + """
+        from every e1=Stream2[price>20]*, e2=Stream1[price>e1[0].price]
+        select e1[0].price as price1, e1[1].price as price2,
+               e2.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 59.6, 100]), ("Stream2", ["WSO2", 55.6, 100]),
+         ("Stream2", ["IBM", 55.7, 100]), ("Stream1", ["WSO2", 57.6, 100])],
+        [(55.6, 55.7, 57.6)])
+
+
+def test_seq_5_leading_star_descending_second():
+    run_query(S12 + Q + """
+        from every e1=Stream2[price>20]*, e2=Stream1[price>e1[0].price]
+        select e1[0].price as price1, e1[1].price as price2,
+               e2.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 59.6, 100]), ("Stream2", ["WSO2", 55.6, 100]),
+         ("Stream2", ["IBM", 55.0, 100]), ("Stream1", ["WSO2", 57.6, 100])],
+        [(55.6, 55.0, 57.6)])
+
+
+def test_seq_6_leading_optional():
+    run_query(S12 + Q + """
+        from every e1=Stream2[price>20]?, e2=Stream1[price>e1[0].price]
+        select e1[0].price as price1, e2.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 59.6, 100]), ("Stream2", ["WSO2", 55.6, 100]),
+         ("Stream2", ["IBM", 55.7, 100]), ("Stream1", ["WSO2", 57.6, 100])],
+        [(55.7, 57.6)])
+
+
+def test_seq_7_or_second():
+    run_query(S12 + Q + """
+        from every e1=Stream2[price>20],
+             e2=Stream2[price>e1.price] or e3=Stream2[symbol=='IBM']
+        select e1.price as price1, e2.price as price2, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream2", ["WSO2", 59.6, 100]), ("Stream2", ["WSO2", 55.6, 100]),
+         ("Stream2", ["IBM", 55.7, 100]), ("Stream2", ["WSO2", 57.6, 100])],
+        [(55.6, 55.7, None), (55.7, 57.6, None)])
+
+
+def test_seq_8_or_ibm_side():
+    run_query(S12 + Q + """
+        from every e1=Stream2[price>20],
+             e2=Stream2[price>e1.price] or e3=Stream2[symbol=='IBM']
+        select e1.price as price1, e2.price as price2, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream2", ["WSO2", 59.6, 100]), ("Stream2", ["WSO2", 55.6, 100]),
+         ("Stream2", ["IBM", 55.0, 100]), ("Stream2", ["WSO2", 57.6, 100])],
+        [(55.6, None, 55.0), (55.0, 57.6, None)])
+
+
+def test_seq_9_or_both_orders():
+    run_query(S12 + Q + """
+        from every e1=Stream2[price>20],
+             e2=Stream2[price>e1.price] or e3=Stream2[symbol=='IBM']
+        select e1.price as price1, e2.price as price2, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream2", ["WSO2", 59.6, 100]), ("Stream2", ["WSO2", 55.6, 100]),
+         ("Stream2", ["WSO2", 57.6, 100]), ("Stream2", ["IBM", 55.7, 100])],
+        [(55.6, 57.6, None), (57.6, None, 55.7)])
+
+
+def test_seq_10_leading_plus_single():
+    run_query(S12 + Q + """
+        from every e1=Stream2[price>20]+, e2=Stream1[price>e1[0].price]
+        select e1[0].price as price1, e1[1].price as price2,
+               e2.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 59.6, 100]), ("Stream2", ["WSO2", 55.6, 100]),
+         ("Stream1", ["WSO2", 57.6, 100])],
+        [(55.6, None, 57.6)])
+
+
+_RISING_PLUS = S12 + Q + """
+    from every e1=Stream1[price>20],
+         e2=Stream1[(e2[last].price is null and price>=e1.price) or
+                    ((not (e2[last].price is null)) and
+                     price>=e2[last].price)]+,
+         e3=Stream1[price<e2[last].price]
+    select e1.price as price1, e2[0].price as price2, e2[1].price as price3,
+           e3.price as price4
+    insert into OutputStream;"""
+
+
+def test_seq_11_rising_run_then_drop():
+    run_query(_RISING_PLUS,
+        [("Stream1", ["WSO2", 29.6, 100]), ("Stream1", ["WSO2", 35.6, 100]),
+         ("Stream1", ["WSO2", 57.6, 100]), ("Stream1", ["IBM", 47.6, 100])],
+        [(29.6, 35.6, 57.6, 47.6)])
+
+
+def test_seq_12_and_filter_two_streams():
+    run_query(STOCK_TWITTER + Q + """
+        from every e1=StockStream[ price >= 50 and volume > 100 ],
+             e2=TwitterStream[count > 10]
+        select e1.price as price, e1.symbol as symbol, e2.count as count
+        insert into OutputStream;""",
+        [("StockStream", ["GOOG", 51.0, 101]),
+         ("StockStream", ["IBM", 76.6, 111]),
+         ("TwitterStream", ["IBM", 20]),
+         ("StockStream", ["WSO2", 45.6, 100]),
+         ("TwitterStream", ["GOOG", 20])],
+        [(76.6, "IBM", 20)])
+
+
+def test_seq_13_mid_star_zero_len():
+    run_query(STOCK_TWITTER + Q + """
+        from every e1=StockStream[ price >= 50 and volume > 100 ],
+             e2=StockStream[price <= 40]*, e3=StockStream[volume <= 70]
+        select e1.symbol as symbol1, e2[0].symbol as symbol2,
+               e3.symbol as symbol3
+        insert into OutputStream;""",
+        [("StockStream", ["IBM", 75.6, 105]),
+         ("StockStream", ["GOOG", 21.0, 81]),
+         ("StockStream", ["WSO2", 176.6, 65])],
+        [("IBM", "GOOG", "WSO2")])
+
+
+def test_seq_14_two_streams_star_three_matches():
+    run_query(SS12 + Q + """
+        from every e1=StockStream1[ price >= 50 and volume > 100 ],
+             e2=StockStream2[price <= 40]*, e3=StockStream2[volume <= 70]
+        select e3.symbol as symbol1, e2[0].symbol as symbol2,
+               e3.volume as volume
+        insert into OutputStream;""",
+        [("StockStream1", ["IBM", 75.6, 105]),
+         ("StockStream2", ["GOOG", 21.0, 81]),
+         ("StockStream2", ["WSO2", 21.0, 65]),
+         ("StockStream1", ["IBM", 78.6, 106]),
+         ("StockStream2", ["DDD", 23.0, 181]),
+         ("StockStream2", ["WSO2", 21.0, 60]),
+         ("StockStream1", ["BIRT", 87.6, 123]),
+         ("StockStream2", ["DOX", 25.0, 25])],
+        [("WSO2", "GOOG", 65), ("WSO2", "DDD", 60), ("DOX", None, 25)])
+
+
+def test_seq_15_star_filter_on_e1_capture():
+    run_query(SS12 + Q + """
+        from every e1=StockStream1[ price >= 50 and volume > 100 ],
+             e2=StockStream2[e1.symbol != 'AMBA']*,
+             e3=StockStream2[volume <= 70]
+        select e3.symbol as symbol1, e2[0].symbol as symbol2,
+               e3.volume as volume
+        insert into OutputStream;""",
+        [("StockStream1", ["IBM", 75.6, 105]),
+         ("StockStream2", ["GOOG", 21.0, 81]),
+         ("StockStream2", ["WSO2", 21.0, 65]),
+         ("StockStream1", ["AMBA", 78.6, 106]),
+         ("StockStream2", ["DDD", 23.0, 181]),
+         ("StockStream2", ["WSO2", 21.0, 60]),
+         ("StockStream1", ["BIRT", 87.6, 123]),
+         ("StockStream2", ["DOX", 25.0, 25])],
+        [("WSO2", "GOOG", 65), ("DOX", None, 25)])
+
+
+def test_seq_16_filterless_first():
+    run_query(SS12 + Q + """
+        from every e1=StockStream1, e2=StockStream2[e1.symbol != 'AMBA']*,
+             e3=StockStream2[volume <= 70]
+        select e3.symbol as symbol1, e2[0].symbol as symbol2,
+               e3.volume as volume
+        insert into OutputStream;""",
+        [("StockStream1", ["IBM", 75.6, 105]),
+         ("StockStream2", ["GOOG", 21.0, 81]),
+         ("StockStream2", ["WSO2", 21.0, 65]),
+         ("StockStream1", ["AMBA", 78.6, 106]),
+         ("StockStream2", ["DDD", 23.0, 181]),
+         ("StockStream2", ["WSO2", 21.0, 60]),
+         ("StockStream1", ["BIRT", 87.6, 123]),
+         ("StockStream2", ["DOX", 25.0, 25])],
+        [("WSO2", "GOOG", 65), ("DOX", None, 25)])
+
+
+def test_seq_18_rising_run_skips_low_start():
+    run_query(_RISING_PLUS,
+        [("Stream1", ["WSO2", 29.6, 100]), ("Stream1", ["WSO2", 25.0, 100]),
+         ("Stream1", ["WSO2", 35.6, 100]), ("Stream1", ["WSO2", 57.6, 100]),
+         ("Stream1", ["IBM", 47.6, 100])],
+        [(25.0, 35.6, 57.6, 47.6)])
+
+
+def test_seq_19_rising_two_step():
+    run_query(_RISING_PLUS,
+        [("Stream1", ["WSO2", 25.0, 100]), ("Stream1", ["WSO2", 40.0, 100]),
+         ("Stream1", ["WSO2", 35.0, 100])],
+        [(25.0, 40.0, None, 35.0)])
+
+
+def test_seq_20_rising_three_matches():
+    run_query(_RISING_PLUS,
+        [("Stream1", ["WSO2", 29.6, 100]), ("Stream1", ["WSO2", 25.0, 100]),
+         ("Stream1", ["WSO2", 35.6, 100]), ("Stream1", ["WSO2", 25.5, 100]),
+         ("Stream1", ["WSO2", 57.6, 100]), ("Stream1", ["WSO2", 58.6, 100]),
+         ("Stream1", ["IBM", 47.6, 100]), ("Stream1", ["IBM", 27.6, 100]),
+         ("Stream1", ["IBM", 49.6, 100]), ("Stream1", ["IBM", 45.6, 100])],
+        [(25.0, 35.6, None, 25.5), (25.5, 57.6, 58.6, 47.6),
+         (27.6, 49.6, None, 45.6)])
+
+
+_RISING_LAST_IDX = S12 + Q + """
+    from every e1=Stream1[price>20],
+         e2=Stream1[((e2[last].price is null) and price>=e1.price) or
+                    ((not (e2[last].price is null)) and
+                     price>=e2[last].price)]+,
+         e3=Stream1[price<e2[last].price]
+    select e1.price as price1, e2[0].price as price2,
+           e2[last-2].price as price3, e2[last-1].price as price4,
+           e2[last].price as price5, e3.price as price6,
+           e2[last-20].price as price7
+    insert into OutputStream;"""
+
+
+def test_seq_21_last_minus_indexing():
+    run_query(_RISING_LAST_IDX,
+        [("Stream1", ["WSO2", 29.6, 100]), ("Stream1", ["WSO2", 25.0, 100]),
+         ("Stream1", ["WSO2", 35.6, 100]), ("Stream1", ["WSO2", 45.5, 100]),
+         ("Stream1", ["WSO2", 57.6, 100]), ("Stream1", ["WSO2", 58.6, 100]),
+         ("Stream1", ["IBM", 47.6, 100]), ("Stream1", ["IBM", 45.6, 100])],
+        [(25.0, 35.6, 45.5, 57.6, 58.6, 47.6, None)])
+
+
+def test_seq_23_last_minus_two_matches():
+    run_query(S12 + Q + """
+        from every e1=Stream1[price>20],
+             e2=Stream1[price>=e2[last].price or price>=e1.price ]+,
+             e3=Stream1[price<e2[last].price]
+        select e1.price as price1, e2[0].price as price2,
+               e2[last-2].price as price3, e2[last-1].price as price4,
+               e2[last].price as price5, e3.price as price6
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 29.6, 100]), ("Stream1", ["WSO2", 25.0, 100]),
+         ("Stream1", ["WSO2", 35.6, 100]), ("Stream1", ["WSO2", 29.5, 100]),
+         ("Stream1", ["WSO2", 57.6, 100]), ("Stream1", ["WSO2", 58.6, 100]),
+         ("Stream1", ["IBM", 57.7, 100]), ("Stream1", ["IBM", 45.6, 100])],
+        [(25.0, 35.6, None, None, 35.6, 29.5),
+         (29.5, 57.6, None, 57.6, 58.6, 57.7)])
+
+
+def test_seq_25_and_pair_second():
+    run_query(S123 + Q + """
+        from e1=Stream1[price >20],
+             e2=Stream2['IBM' == symbol] and e3=Stream3['WSO2' == symbol]
+        select e1.price as price1, e2.price as price2, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["IBM", 25.5, 100]), ("Stream2", ["IBM", 45.5, 100]),
+         ("Stream3", ["WSO2", 46.56, 100])],
+        [(25.5, 45.5, 46.56)])
+
+
+def test_seq_27_or_pair_second():
+    run_query(S123 + Q + """
+        from e1=Stream1[price >20],
+             e2=Stream2['IBM' == symbol] or e3=Stream3['WSO2' == symbol]
+        select e1.price as price1, e2.price as price2, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["IBM", 59.65, 100]), ("Stream2", ["IBM", 45.5, 100])],
+        [(59.65, 45.5, None)])
+
+
+def test_seq_28_and_pair_higher_prices():
+    run_query(S123 + Q + """
+        from e1=Stream1[price >20],
+             e2=Stream2['IBM' == symbol] and e3=Stream3['WSO2' == symbol]
+        select e1.price as price1, e2.price as price2, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["IBM", 59.65, 100]), ("Stream2", ["IBM", 45.5, 100]),
+         ("Stream3", ["WSO2", 46.56, 100])],
+        [(59.65, 45.5, 46.56)])
+
+
+def test_seq_29_single_shot_no_second_match():
+    run_query(S12 + Q + """
+        from e1=Stream1[price>20],e2=Stream2[price>e1.price]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 55.7, 100]),
+         ("Stream1", ["ORACLE", 55.6, 100]),
+         ("Stream2", ["GOOGLE", 55.7, 100])],
+        [("WSO2", "IBM")])
+
+
+def test_seq_30_every_two_matches():
+    run_query(S12 + Q + """
+        from every e1=Stream1[price>20],e2=Stream2[price>e1.price]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream2", ["IBM", 55.7, 100]),
+         ("Stream1", ["ORACLE", 55.6, 100]),
+         ("Stream1", ["MICROSOFT", 55.8, 100]),
+         ("Stream2", ["GOOGLE", 55.9, 100])],
+        [("WSO2", "IBM"), ("MICROSOFT", "GOOGLE")])
+
+
+def test_seq_31_broken_contiguity_no_match():
+    run_query(S12 + Q + """
+        from e1=Stream1[price>20], e2=Stream2[price>e1.price]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream;""",
+        [("Stream1", ["WSO2", 55.6, 100]), ("Stream1", ["GOOG", 57.6, 100]),
+         ("Stream2", ["IBM", 65.7, 100])],
+        [])
+
+
+def test_seq_32_leading_and_pair():
+    run_query(S123 + Q + """
+        from e1=Stream1[price >20] and e2=Stream2['IBM' == symbol],
+             e3=Stream3['WSO2' == symbol]
+        select e1.price as price1, e2.price as price2, e3.price as price3
+        insert into OutputStream;""",
+        [("Stream1", ["IBM", 25.5, 100]), ("Stream2", ["IBM", 45.5, 100]),
+         ("Stream3", ["WSO2", 46.56, 100])],
+        [(25.5, 45.5, 46.56)])
