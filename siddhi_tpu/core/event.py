@@ -68,16 +68,22 @@ class EventChunk:
     for multi-stream events — the columnar analogue of the reference's
     StateEvent (join/pattern output rows, event/state/StateEvent.java)."""
 
-    __slots__ = ("timestamps", "types", "columns", "names", "qualified")
+    __slots__ = ("timestamps", "types", "columns", "names", "qualified",
+                 "is_batch")
 
     def __init__(self, names: Sequence[str], timestamps: np.ndarray,
                  types: np.ndarray, columns: Dict[str, np.ndarray],
-                 qualified: Optional[Dict] = None):
+                 qualified: Optional[Dict] = None, is_batch: bool = False):
         self.names = list(names)
         self.timestamps = timestamps
         self.types = types
         self.columns = columns
         self.qualified = qualified
+        # batch-marked chunks summarize in aggregated selects (reference
+        # ComplexEventChunk.isBatch, set by tumbling-batch windows); the
+        # transforms below all carry it so intervening processors (filters,
+        # stream functions) don't strip batch semantics
+        self.is_batch = is_batch
 
     # ------------------------------------------------------------ constructors
 
@@ -151,34 +157,35 @@ class EventChunk:
     def mask(self, m: np.ndarray) -> "EventChunk":
         return EventChunk(self.names, self.timestamps[m], self.types[m],
                           {k: v[m] for k, v in self.columns.items()},
-                          _sel_qualified(self.qualified, m))
+                          _sel_qualified(self.qualified, m), self.is_batch)
 
     def take(self, idx: np.ndarray) -> "EventChunk":
         return EventChunk(self.names, self.timestamps[idx], self.types[idx],
                           {k: v[idx] for k, v in self.columns.items()},
-                          _sel_qualified(self.qualified, idx))
+                          _sel_qualified(self.qualified, idx), self.is_batch)
 
     def slice(self, start: int, stop: int) -> "EventChunk":
         return EventChunk(self.names, self.timestamps[start:stop],
                           self.types[start:stop],
                           {k: v[start:stop] for k, v in self.columns.items()},
-                          _sel_qualified(self.qualified, slice(start, stop)))
+                          _sel_qualified(self.qualified, slice(start, stop)),
+                          self.is_batch)
 
     def with_types(self, t: int) -> "EventChunk":
         return EventChunk(self.names, self.timestamps,
                           np.full(len(self), t, np.int8), self.columns,
-                          self.qualified)
+                          self.qualified, self.is_batch)
 
     def with_timestamps(self, ts: np.ndarray) -> "EventChunk":
         return EventChunk(self.names, np.asarray(ts, np.int64), self.types,
-                          self.columns, self.qualified)
+                          self.columns, self.qualified, self.is_batch)
 
     def rename(self, names: Sequence[str]) -> "EventChunk":
         assert len(names) == len(self.names)
         return EventChunk(list(names), self.timestamps, self.types,
                           {new: self.columns[old]
                            for old, new in zip(self.names, names)},
-                          self.qualified)
+                          self.qualified, self.is_batch)
 
     def only(self, *event_types: int) -> "EventChunk":
         m = np.isin(self.types, event_types)
@@ -187,7 +194,8 @@ class EventChunk:
     def copy(self) -> "EventChunk":
         return EventChunk(self.names, self.timestamps.copy(), self.types.copy(),
                           {k: v.copy() for k, v in self.columns.items()},
-                          _sel_qualified(self.qualified, slice(None)))
+                          _sel_qualified(self.qualified, slice(None)),
+                          self.is_batch)
 
     @staticmethod
     def concat(chunks: Sequence["EventChunk"]) -> "EventChunk":
@@ -218,7 +226,10 @@ class EventChunk:
             np.concatenate([c.timestamps for c in chunks]),
             np.concatenate([c.types for c in chunks]),
             {n: np.concatenate([c.columns[n] for c in chunks]) for n in names},
-            qualified)
+            qualified,
+            # conservative: merging a batch flush with non-batch traffic
+            # (e.g. async junction re-batching) must not batch-mark the result
+            all(c.is_batch for c in chunks))
 
     def __repr__(self):
         return (f"EventChunk(n={len(self)}, names={self.names}, "

@@ -30,6 +30,33 @@ from .selector import QuerySelector
 from .window import WindowProcessor, create_window_processor
 
 
+def _expr_has_aggregate(e) -> bool:
+    """Walk an expression IR tree for aggregator AttributeFunctions."""
+    from dataclasses import fields, is_dataclass
+
+    from ..query_api.expression import AttributeFunction, Expression
+    from .aggregator import is_aggregator
+    if e is None:
+        return False
+    if isinstance(e, AttributeFunction) and \
+            is_aggregator(e.namespace, e.name, len(e.args)):
+        return True
+    if isinstance(e, (list, tuple)):
+        return any(_expr_has_aggregate(x) for x in e)
+    if is_dataclass(e) and isinstance(e, Expression):
+        return any(_expr_has_aggregate(getattr(e, f.name))
+                   for f in fields(e))
+    return False
+
+
+def _selector_has_aggregates(selector) -> bool:
+    """IR-level aggregate detection (works on both the host path, where a
+    QuerySelector exists, and the device path, where the select clause is
+    folded into the kernel) — drives snapshot-limiter dispatch (reference
+    WrappedSnapshotOutputRateLimiter.init's aggregateAttributePositionList)."""
+    return any(_expr_has_aggregate(oa.expr) for oa in selector.attributes)
+
+
 class ProcessStreamReceiver:
     """Junction entry point for a query; holds the query lock
     (reference query/input/ProcessStreamReceiver.java; debugger check at the
@@ -219,11 +246,32 @@ class QueryRuntime:
         q = self.query
         app = self.app_runtime
         group_names = [v.attribute for v in q.selector.group_by]
-        self.rate_limiter = build_rate_limiter(q.output_rate, app.app_ctx,
-                                               group_names)
+        self.rate_limiter = build_rate_limiter(
+            q.output_rate, app.app_ctx, group_names,
+            windowed=self._query_is_windowed(q),
+            has_aggregates=_selector_has_aggregates(q.selector))
         self.output_processor = self._make_output(q, factory)
         self.output_processor.query_name = self.name
         self.output_processor.app_ctx = app.app_ctx
+
+    def _query_is_windowed(self, q: Query) -> bool:
+        """Reference QueryParser marks a query 'windowed' when its (or either
+        join side's) handler chain contains a window, or it reads a named
+        window — drives snapshot-limiter dispatch
+        (WrappedSnapshotOutputRateLimiter.java:86)."""
+        app = self.app_runtime
+
+        def single(s) -> bool:
+            if not isinstance(s, SingleInputStream):
+                return False
+            if any(isinstance(h, WindowHandler) for h in s.handlers):
+                return True
+            return app.has_named_window(s.stream_id)
+
+        ins = q.input_stream
+        if isinstance(ins, JoinInputStream):
+            return single(ins.left) or single(ins.right)
+        return single(ins)
 
     def _finish_device_chain(self, output_definition: StreamDefinition,
                              factory):

@@ -139,33 +139,64 @@ class _TimeLimiter(Processor):
 
 
 class SnapshotRateLimiter(Processor):
-    """`output snapshot every T` — re-emits the latest value per group on each
-    tick (reference ratelimit/snapshot/**)."""
+    """`output snapshot every T`.
 
-    def __init__(self, ms: int, app_ctx, group_by_names: Optional[List[str]]):
+    Reference dispatch (ratelimit/snapshot/WrappedSnapshotOutputRateLimiter
+    .java:86-125): windowed query WITHOUT aggregators re-emits the full
+    current window contents each tick (WindowedPerSnapshotOutputRateLimiter
+    .java:75-104 — CURRENT adds, EXPIRED removes the first equal event, RESET
+    clears); queries with aggregators (or no window) re-emit the latest value
+    per group-by key (GroupByPerSnapshotOutputRateLimiter / PerSnapshot…)."""
+
+    def __init__(self, ms: int, app_ctx, group_by_names: Optional[List[str]],
+                 windowed: bool = False, has_aggregates: bool = True):
         super().__init__()
         self.ms = ms
         self.app_ctx = app_ctx
         self.group_by_names = group_by_names or []
+        self.window_mode = windowed and not has_aggregates
         self.snapshot: Dict[Tuple, EventChunk] = {}
+        self.window_events: List[EventChunk] = []   # single-row chunks
         self._armed = False
+
+    @staticmethod
+    def _row_key(chunk: EventChunk, i: int) -> Tuple:
+        return tuple(np.asarray(chunk.columns[c][i]).item()
+                     for c in sorted(chunk.columns))
 
     def process(self, chunk: EventChunk):
         if chunk.is_empty:
             return
-        cur = chunk.only(CURRENT)
-        for i in range(len(cur)):
-            key = tuple(cur.columns[g][i] for g in self.group_by_names
-                        if g in cur.columns)
-            self.snapshot[key] = cur.slice(i, i + 1)
+        if self.window_mode:
+            # the QuerySelector upstream masks chunks to CURRENT|EXPIRED, so
+            # window tracking needs only add/remove (batch windows clear via
+            # their per-row EXPIRED emission, never via RESET)
+            for i in range(len(chunk)):
+                t = chunk.types[i]
+                if t == CURRENT:
+                    self.window_events.append(chunk.slice(i, i + 1))
+                elif t == EXPIRED:
+                    key = self._row_key(chunk, i)
+                    for j, row in enumerate(self.window_events):
+                        if self._row_key(row, 0) == key:
+                            del self.window_events[j]
+                            break
+        else:
+            cur = chunk.only(CURRENT)
+            for i in range(len(cur)):
+                key = tuple(cur.columns[g][i] for g in self.group_by_names
+                            if g in cur.columns)
+                self.snapshot[key] = cur.slice(i, i + 1)
         now = int(chunk.timestamps[-1])
         if not self._armed:
             self._armed = True
             self.app_ctx.scheduler.notify_at(now + self.ms, self._tick)
 
     def _tick(self, now: int):
-        if self.snapshot:
-            out = EventChunk.concat(list(self.snapshot.values()))
+        rows = self.window_events if self.window_mode \
+            else list(self.snapshot.values())
+        if rows:
+            out = EventChunk.concat(list(rows))
             out = out.with_timestamps(np.full(len(out), now, np.int64))
             self.send_next(out)
             self.app_ctx.scheduler.notify_at(now + self.ms, self._tick)
@@ -174,13 +205,16 @@ class SnapshotRateLimiter(Processor):
 
 
 def build_rate_limiter(rate: Optional[OutputRate], app_ctx,
-                       group_by_names: Optional[List[str]]) -> Processor:
+                       group_by_names: Optional[List[str]],
+                       windowed: bool = False,
+                       has_aggregates: bool = True) -> Processor:
     if rate is None:
         return PassThroughRateLimiter()
     mode = {OutputRateType.ALL: "all", OutputRateType.FIRST: "first",
             OutputRateType.LAST: "last"}.get(rate.type, "all")
     if rate.type == OutputRateType.SNAPSHOT:
-        return SnapshotRateLimiter(rate.every_ms, app_ctx, group_by_names)
+        return SnapshotRateLimiter(rate.every_ms, app_ctx, group_by_names,
+                                   windowed, has_aggregates)
     if rate.every_events is not None:
         return _EventCountLimiter(rate.every_events, mode, group_by_names)
     return _TimeLimiter(rate.every_ms, mode, app_ctx, group_by_names)

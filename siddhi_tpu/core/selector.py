@@ -134,8 +134,10 @@ class QuerySelector(Processor):
         ctx = EvalCtx(dict(chunk.columns), chunk.timestamps, n,
                       qualified=chunk.qualified)
 
+        key_cols: Optional[List[np.ndarray]] = None
         if self.agg_specs:
-            self._run_aggregators(chunk, ctx, data_mask, reset_mask)
+            key_cols = [np.asarray(g.fn(ctx)) for g in self.group_by]
+            self._run_aggregators(chunk, ctx, data_mask, reset_mask, key_cols)
 
         out_cols: Dict[str, np.ndarray] = {}
         for name, ce in zip(self.out_names, self.out_exprs):
@@ -152,6 +154,7 @@ class QuerySelector(Processor):
         out = EventChunk(self.out_names, chunk.timestamps, chunk.types,
                          out_cols)
         out = out.mask(data_mask)
+        keep_idx = np.flatnonzero(data_mask)
         if out.is_empty:
             return
 
@@ -161,8 +164,25 @@ class QuerySelector(Processor):
             if hm.ndim == 0:
                 hm = np.full(len(out), bool(hm))
             out = out.mask(hm)
+            keep_idx = keep_idx[hm]
             if out.is_empty:
                 return
+
+        if self.agg_specs and getattr(chunk, "is_batch", False):
+            # batch-marked chunks (lengthBatch/timeBatch/externalTimeBatch/
+            # batch windows) summarize: one aggregated row per batch — the
+            # last event, or the last per group key in first-seen key order
+            # (reference QuerySelector.processInBatchNoGroupBy /
+            # processInBatchGroupBy)
+            if self.group_by:
+                picks: Dict[Tuple, int] = {}
+                for pos, oi in enumerate(keep_idx):
+                    key = tuple(kc[oi].item() if hasattr(kc[oi], "item")
+                                else kc[oi] for kc in key_cols)
+                    picks[key] = pos        # dict keeps first-seen key order
+                out = out.take(np.asarray(list(picks.values()), np.int64))
+            else:
+                out = out.take(np.asarray([len(out) - 1], np.int64))
 
         if self.order_by:
             keys = []
@@ -183,10 +203,10 @@ class QuerySelector(Processor):
             out = out.slice(0, self.limit)
         self.send_next(out)
 
-    def _run_aggregators(self, chunk, ctx, data_mask, reset_mask):
+    def _run_aggregators(self, chunk, ctx, data_mask, reset_mask, key_cols):
         n = len(chunk)
-        # evaluate group keys + agg args over the whole batch once
-        key_cols = [np.asarray(g.fn(ctx)) for g in self.group_by]
+        # group keys (key_cols) were evaluated once in process(); agg args
+        # evaluated over the whole batch once here
         arg_vals = [spec.arg.fn(ctx) if spec.arg is not None else None
                     for spec in self.agg_specs]
         from .event import dtype_for

@@ -179,11 +179,12 @@ class LengthBatchWindowProcessor(WindowProcessor):
     def on_data(self, chunk: EventChunk):
         pending = EventChunk.concat([self.buffer, chunk]) \
             if self.buffer is not None else chunk
-        outs = []
+        flushes = []
         while len(pending) >= self.length:
             batch = pending.slice(0, self.length)
             pending = pending.slice(self.length, len(pending))
             ts = int(batch.timestamps[-1])
+            outs = []
             if self.expired_batch is not None:
                 outs.append(self.expired_batch.with_types(EXPIRED)
                             .with_timestamps(np.full(len(self.expired_batch),
@@ -191,9 +192,15 @@ class LengthBatchWindowProcessor(WindowProcessor):
             outs.append(_reset_row(batch, ts))
             outs.append(batch.with_types(CURRENT))
             self.expired_batch = batch
+            flushes.append(outs)
         self.buffer = pending if len(pending) else None
-        if outs:
-            self.send_next(EventChunk.concat(outs))
+        # one chunk PER batch flush — aggregated selects summarize each
+        # batch-marked chunk to a single row (reference setBatch(true)), so
+        # merging flushes would drop all but the last batch's aggregate
+        for outs in flushes:
+            out = EventChunk.concat(outs)
+            out.is_batch = True
+            self.send_next(out)
 
     def current_state(self):
         s = super().current_state()
@@ -344,7 +351,9 @@ class TimeBatchWindowProcessor(WindowProcessor):
             outs.append(batch.with_types(CURRENT))
         self.expired_batch = batch
         if outs:
-            self.send_next(EventChunk.concat(outs))
+            out = EventChunk.concat(outs)
+            out.is_batch = True
+            self.send_next(out)
 
     def _on_timer(self, now: int):
         def run():
@@ -468,8 +477,10 @@ class ExternalTimeBatchWindowProcessor(WindowProcessor):
                 self.window_end += self.window_ms
             row = chunk.slice(i, i + 1)
             self._buf_append(row)
-        if outs:
-            self.send_next(EventChunk.concat(outs))
+        # one chunk per window flush (see LengthBatchWindowProcessor.on_data)
+        for out in outs:
+            out.is_batch = True
+            self.send_next(out)
 
     def _flush(self, ts: int) -> Optional[EventChunk]:
         outs = []
@@ -557,7 +568,9 @@ class BatchWindowProcessor(WindowProcessor):
         outs.append(_reset_row(chunk, ts))
         outs.append(chunk.with_types(CURRENT))
         self.buffer = chunk.with_types(CURRENT)
-        self.send_next(EventChunk.concat(outs))
+        out = EventChunk.concat(outs)
+        out.is_batch = True
+        self.send_next(out)
 
 
 # ===================================================================== session
@@ -708,8 +721,10 @@ class FrequentWindowProcessor(WindowProcessor):
                 self.latest[k] = row
                 outs.append(row)
             else:
-                # decrement all; evict zeros
-                outs.append(row)
+                # new key at capacity: decrement the resident keys, evict
+                # zeros (EXPIRED); admit the new key only if space opened,
+                # else drop the arriving event unemitted (reference
+                # FrequentWindowProcessor.process)
                 evicted = []
                 for kk in list(self.counts):
                     self.counts[kk] -= 1
@@ -719,6 +734,10 @@ class FrequentWindowProcessor(WindowProcessor):
                         evicted.append(ev.with_types(EXPIRED)
                                        .with_timestamps(row.timestamps))
                 outs.extend(evicted)
+                if len(self.counts) < self.count:
+                    self.counts[k] = 1
+                    self.latest[k] = row
+                    outs.append(row)
         self.send_next(EventChunk.concat(outs))
 
     def current_state(self):

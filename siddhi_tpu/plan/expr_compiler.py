@@ -125,6 +125,11 @@ class Scope:
             if also_unqualified and (None, None, a.name) not in self._entries:
                 self.add(None, a.name, a.type, getter)
 
+    def resolves(self, stream_id: Optional[str], attr: str) -> bool:
+        """True when (stream_id, attr) binds to a column in this scope."""
+        return (stream_id, None, attr) in self._entries or \
+            (stream_id, 0, attr) in self._entries
+
     def resolve(self, var: Variable) -> Tuple[Getter, AttrType]:
         keys = []
         if var.stream_id is not None:
@@ -307,6 +312,13 @@ class ExprCompiler:
         xp = self.xp
         if e.expr is None:
             sid, idx = e.stream_id, e.stream_index
+            # `a is null` on a bare identifier is ambiguous: a pattern
+            # state-ref check or an attribute null-check.  The reference
+            # resolves by name at parse time (ExpressionParser IsNull
+            # branch); here, an identifier that resolves as a plain
+            # attribute in scope compiles to the attribute check.
+            if idx is None and self.scope.resolves(None, sid):
+                return self._compile_is_null(IsNull(Variable(sid)))
 
             def fn(ctx):
                 q = ctx.qualified.get((sid, idx if idx is not None else 0))

@@ -1,0 +1,206 @@
+"""Filter conformance matrix modeled on the reference filter suites
+(query/FilterTestCase1.java 81 @Tests + FilterTestCase2.java 41 @Tests):
+every comparison operator against every numeric literal suffix
+(int / 50L / 50f / 50d) and attribute type, plus bool/string equality,
+and/or/not combinations, arithmetic in conditions, and literal-first
+orderings.  Each case runs on the host engine and — numeric shapes —
+re-runs compiled on the device engine with identical output asserted.
+"""
+import pytest
+
+from ref_harness import run_query
+
+CSE = ("define stream cse (symbol string, price float, volume long, "
+       "quantity int, available bool, ratio double);\n")
+Q = "@info(name = 'query1') "
+
+ROWS = [
+    ("WSO2", 50.0, 100, 5, True, 8.5),
+    ("IBM", 72.5, 40, 2, False, 1.25),
+    ("ORACLE", 35.0, 200, 9, True, 0.5),
+]
+
+
+def _run_filter(cond, expected_symbols):
+    run_query(CSE + Q + f"""
+        from cse[{cond}] select symbol, volume insert into out;""",
+        [("cse", list(r)) for r in ROWS],
+        [(r[0], r[2]) for r in ROWS if r[0] in expected_symbols])
+
+
+# op × literal-suffix matrix over a long attribute (reference testFilterQuery
+# 4-30: volume > 50L / 50f / 50d / 45 …)
+CMP_CASES = [
+    ("volume > 50", {"WSO2", "ORACLE"}),
+    ("volume > 50L", {"WSO2", "ORACLE"}),
+    ("volume > 50f", {"WSO2", "ORACLE"}),
+    ("volume > 50d", {"WSO2", "ORACLE"}),
+    ("volume >= 100", {"WSO2", "ORACLE"}),
+    ("volume >= 100L", {"WSO2", "ORACLE"}),
+    ("volume >= 200f", {"ORACLE"}),
+    ("volume >= 200d", {"ORACLE"}),
+    ("volume < 100", {"IBM"}),
+    ("volume < 100L", {"IBM"}),
+    ("volume < 100.0f", {"IBM"}),
+    ("volume < 100d", {"IBM"}),
+    ("volume <= 100", {"WSO2", "IBM"}),
+    ("volume <= 100L", {"WSO2", "IBM"}),
+    ("volume <= 40f", {"IBM"}),
+    ("volume <= 40d", {"IBM"}),
+    ("volume == 100", {"WSO2"}),
+    ("volume == 100L", {"WSO2"}),
+    ("volume == 40f", {"IBM"}),
+    ("volume == 200d", {"ORACLE"}),
+    ("volume != 100", {"IBM", "ORACLE"}),
+    ("volume != 100L", {"IBM", "ORACLE"}),
+    ("volume != 40f", {"WSO2", "ORACLE"}),
+    ("volume != 200d", {"WSO2", "IBM"}),
+    # literal-first orderings (reference: `70 > price`, `150 > volume`)
+    ("70 > price", {"WSO2", "ORACLE"}),
+    ("150 > volume", {"WSO2", "IBM"}),
+    ("100 == volume", {"WSO2"}),
+    ("100 != volume", {"IBM", "ORACLE"}),
+    ("40 <= volume", {"WSO2", "IBM", "ORACLE"}),
+    ("200 <= volume", {"ORACLE"}),
+]
+
+
+@pytest.mark.parametrize("cond,expected", CMP_CASES,
+                         ids=[c[0] for c in CMP_CASES])
+def test_filter_long_matrix(cond, expected):
+    _run_filter(cond, expected)
+
+
+# float attribute vs every suffix (reference testFilterQuery 31-55)
+FLOAT_CASES = [
+    ("price > 50", {"IBM"}),
+    ("price > 50L", {"IBM"}),
+    ("price > 50f", {"IBM"}),
+    ("price > 50d", {"IBM"}),
+    ("price >= 50.0", {"WSO2", "IBM"}),
+    ("price < 50", {"ORACLE"}),
+    ("price <= 50", {"WSO2", "ORACLE"}),
+    ("price == 50.0", {"WSO2"}),
+    ("price == 50", {"WSO2"}),
+    ("price != 50.0", {"IBM", "ORACLE"}),
+    ("price != 35L", {"WSO2", "IBM"}),
+]
+
+
+@pytest.mark.parametrize("cond,expected", FLOAT_CASES,
+                         ids=[c[0] for c in FLOAT_CASES])
+def test_filter_float_matrix(cond, expected):
+    _run_filter(cond, expected)
+
+
+# int attribute matrix (quantity)
+INT_CASES = [
+    ("quantity > 4", {"WSO2", "ORACLE"}),
+    ("quantity > 4L", {"WSO2", "ORACLE"}),
+    ("quantity > 4f", {"WSO2", "ORACLE"}),
+    ("quantity > 4d", {"WSO2", "ORACLE"}),
+    ("quantity == 2", {"IBM"}),
+    ("quantity != 2", {"WSO2", "ORACLE"}),
+    ("quantity <= 5", {"WSO2", "IBM"}),
+]
+
+
+@pytest.mark.parametrize("cond,expected", INT_CASES,
+                         ids=[c[0] for c in INT_CASES])
+def test_filter_int_matrix(cond, expected):
+    _run_filter(cond, expected)
+
+
+# double attribute matrix (ratio)
+DOUBLE_CASES = [
+    ("ratio > 1.0", {"WSO2", "IBM"}),
+    ("ratio > 1", {"WSO2", "IBM"}),
+    ("ratio > 1L", {"WSO2", "IBM"}),
+    ("ratio > 1.0f", {"WSO2", "IBM"}),
+    ("ratio < 1.0d", {"ORACLE"}),
+    ("ratio == 0.5", {"ORACLE"}),
+    ("ratio != 0.5", {"WSO2", "IBM"}),
+]
+
+
+@pytest.mark.parametrize("cond,expected", DOUBLE_CASES,
+                         ids=[c[0] for c in DOUBLE_CASES])
+def test_filter_double_matrix(cond, expected):
+    _run_filter(cond, expected)
+
+
+# bool + string (reference: `available != true`, symbol comparisons)
+BOOL_STR_CASES = [
+    ("available == true", {"WSO2", "ORACLE"}),
+    ("available != true", {"IBM"}),
+    ("available == false", {"IBM"}),
+    ("symbol == 'WSO2'", {"WSO2"}),
+    ("symbol != 'WSO2'", {"IBM", "ORACLE"}),
+    ("'IBM' == symbol", {"IBM"}),
+]
+
+
+@pytest.mark.parametrize("cond,expected", BOOL_STR_CASES,
+                         ids=[c[0] for c in BOOL_STR_CASES])
+def test_filter_bool_string_matrix(cond, expected):
+    _run_filter(cond, expected)
+
+
+# logical combinations (reference testFilterQuery 23, 56-81)
+LOGIC_CASES = [
+    ("volume > 12L and price < 56", {"WSO2", "ORACLE"}),
+    ("symbol != 'WSO2' and volume != 55L and price != 72.5f", {"ORACLE"}),
+    ("volume != 100 and volume != 70d", {"IBM", "ORACLE"}),
+    ("price != 53.6d or price != 87", {"WSO2", "IBM", "ORACLE"}),
+    ("volume != 40f and volume != 400", {"WSO2", "ORACLE"}),
+    ("price > 40 or volume > 150", {"WSO2", "IBM", "ORACLE"}),
+    ("not (price > 40)", {"ORACLE"}),
+    ("not (price > 40) and volume > 100", {"ORACLE"}),
+    ("volume > 50 and (price > 40 or quantity > 8)", {"WSO2", "ORACLE"}),
+    ("true", {"WSO2", "IBM", "ORACLE"}),
+    ("false", set()),
+]
+
+
+@pytest.mark.parametrize("cond,expected", LOGIC_CASES,
+                         ids=[str(i) for i in range(len(LOGIC_CASES))])
+def test_filter_logical_matrix(cond, expected):
+    _run_filter(cond, expected)
+
+
+# arithmetic inside conditions (reference FilterTestCase2: add/sub/mul/div/mod
+# per type)
+MATH_CASES = [
+    ("price + 10 > 80", {"IBM"}),
+    ("price - 10 < 30", {"ORACLE"}),
+    ("price * 2 > 120", {"IBM"}),
+    ("price / 2 < 20", {"ORACLE"}),
+    ("volume % 3 == 1", {"WSO2", "IBM"}),
+    ("volume + quantity > 150", {"ORACLE"}),
+    ("volume * quantity >= 500", {"WSO2", "ORACLE"}),
+    ("price + ratio > 58", {"WSO2", "IBM"}),
+    ("quantity - 1 == 1", {"IBM"}),
+    ("volume / 2 == 50", {"WSO2"}),
+]
+
+
+@pytest.mark.parametrize("cond,expected", MATH_CASES,
+                         ids=[c[0] for c in MATH_CASES])
+def test_filter_math_matrix(cond, expected):
+    _run_filter(cond, expected)
+
+
+def test_filter_select_projection_math():
+    run_query(CSE + Q + """
+        from cse[volume >= 100]
+        select symbol, price * 2 as doubled, volume + quantity as vq
+        insert into out;""",
+        [("cse", list(r)) for r in ROWS],
+        [("WSO2", 100.0, 105), ("ORACLE", 70.0, 209)])
+
+
+def test_filter_no_condition_passthrough():
+    run_query(CSE + Q + """
+        from cse select symbol insert into out;""",
+        [("cse", list(r)) for r in ROWS],
+        [("WSO2",), ("IBM",), ("ORACLE",)])

@@ -1,0 +1,138 @@
+"""Built-in function conformance matrix modeled on the reference executor
+function tests (executor/function/* — cast, convert, coalesce, ifThenElse,
+instanceOf×6, UUID, maximum/minimum, default, math:/str: namespaces —
+and query/extension/ custom function registration).
+"""
+import pytest
+
+from ref_harness import run_query
+
+CSE = ("define stream cse (symbol string, price float, volume long, "
+       "quantity int, available bool, ratio double);\n")
+Q = "@info(name = 'query1') "
+ROW = ("WSO2", 50.0, 100, 5, True, 2.25)
+
+
+def _run_select(select_expr, expected_value):
+    run_query(CSE + Q + f"""
+        from cse select {select_expr} as v insert into out;""",
+        [("cse", list(ROW))],
+        [(expected_value,)])
+
+
+SELECT_CASES = [
+    ("coalesce(symbol, 'none')", "WSO2"),
+    ("ifThenElse(price > 40.0, 'high', 'low')", "high"),
+    ("ifThenElse(price < 40.0, 'high', 'low')", "low"),
+    ("cast(quantity, 'long')", 5),
+    ("cast(price, 'double')", 50.0),
+    ("cast(volume, 'string')", "100"),
+    ("convert(price, 'int')", 50),
+    ("convert(quantity, 'float')", 5.0),
+    ("maximum(price, ratio)", 50.0),
+    ("minimum(price, ratio)", 2.25),
+    ("maximum(quantity, volume)", 100),
+    ("default(symbol, 'X')", "WSO2"),
+    ("instanceOfInteger(quantity)", True),
+    ("instanceOfInteger(price)", False),
+    ("instanceOfLong(volume)", True),
+    ("instanceOfFloat(price)", True),
+    ("instanceOfDouble(ratio)", True),
+    ("instanceOfBoolean(available)", True),
+    ("instanceOfString(symbol)", True),
+    ("instanceOfString(volume)", False),
+    ("math:abs(0.0f - price)", 50.0),
+    ("math:ceil(ratio)", 3.0),
+    ("math:floor(ratio)", 2.0),
+    ("math:sqrt(quantity)", 2.23606797749979),
+    ("math:round(ratio)", 2.0),
+    ("math:power(quantity, 2)", 25.0),
+    ("str:concat(symbol, '-', 'X')", "WSO2-X"),
+    ("str:length(symbol)", 4),
+    ("str:upper(symbol)", "WSO2"),
+    ("str:lower(symbol)", "wso2"),
+    ("str:trim(' a ')", "a"),
+    ("str:reverse(symbol)", "2OSW"),
+    ("str:contains(symbol, 'SO')", True),
+    ("quantity + volume * 2", 205),
+    ("(quantity + volume) * 2", 210),
+    ("volume % 30", 10),
+]
+
+
+@pytest.mark.parametrize("expr,expected", SELECT_CASES,
+                         ids=[c[0] for c in SELECT_CASES])
+def test_function_select(expr, expected):
+    _run_select(expr, expected)
+
+
+def test_uuid_is_unique_string():
+    got = []
+    from siddhi_tpu import SiddhiManager, StreamCallback
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(CSE + Q + """
+        from cse select UUID() as u insert into out;""")
+    rt.add_callback("out", StreamCallback(
+        lambda evs: got.extend(e.data[0] for e in evs)))
+    rt.start()
+    h = rt.get_input_handler("cse")
+    h.send(list(ROW))
+    h.send(list(ROW))
+    rt.shutdown()
+    assert len(got) == 2 and got[0] != got[1]
+    assert all(isinstance(u, str) and len(u) == 36 for u in got)
+
+
+def test_event_timestamp():
+    run_query(CSE + Q + """
+        from cse select eventTimestamp() as ts insert into out;""",
+        [("cse", list(ROW), 123456)],
+        [(123456,)])
+
+
+def test_is_null_condition():
+    run_query("""
+        define stream S (a string, b int);
+        @info(name = 'query1')
+        from S[not (a is null)] select a, b insert into out;""",
+        [("S", [None, 1]), ("S", ["x", 2])],
+        [("x", 2)])
+
+
+def test_in_table_condition():
+    run_query("""
+        define stream Seed (s string);
+        define stream S (s string);
+        define table T (s string);
+        from Seed select s insert into T;
+        @info(name = 'query1')
+        from S[S.s in T] select s insert into out;""",
+        [("Seed", ["ok"]), ("S", ["ok"]), ("S", ["nope"])],
+        [("ok",)])
+
+
+def test_custom_function_extension():
+    # ≙ reference query/extension CustomFunctionExtension via
+    # siddhiManager.setExtension
+    import numpy as np
+
+    from siddhi_tpu import SiddhiManager, StreamCallback
+    from siddhi_tpu.utils.extension import FunctionExtension
+
+    class Tripple(FunctionExtension):
+        def apply(self, vals):
+            return np.asarray([None if v is None else v * 3
+                               for v in np.asarray(vals, object)], object)
+
+    m = SiddhiManager()
+    m.set_extension("custom:tripple", Tripple)
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (v int);
+        from S select custom:tripple(v) as t insert into out;""")
+    got = []
+    rt.add_callback("out", StreamCallback(
+        lambda evs: got.extend(e.data[0] for e in evs)))
+    rt.start()
+    rt.get_input_handler("S").send([7])
+    rt.shutdown()
+    assert got == [21]

@@ -1,0 +1,250 @@
+"""Join and partition conformance tests modeled on the reference suites
+(query/join/JoinTestCase.java, query/join/OuterJoinTestCase.java,
+query/partition/PartitionTestCase1.java, PatternPartitionTestCase.java,
+SequencePartitionTestCase.java).
+"""
+from ref_harness import run_query
+
+CSE_TW = """
+define stream cse (symbol string, price float, volume int);
+define stream twitter (user string, tweet string, company string);
+"""
+Q = "@info(name = 'query1') "
+
+
+def test_join_time_windows_on_condition():
+    run_query(CSE_TW + Q + """
+        from cse#window.time(1 sec) join twitter#window.time(1 sec)
+            on cse.symbol == twitter.company
+        select cse.symbol as symbol, twitter.tweet, cse.price
+        insert into out;""",
+        [("cse", ["WSO2", 55.6, 100], 1000),
+         ("twitter", ["User1", "Hello World", "WSO2"], 1100),
+         ("cse", ["IBM", 75.6, 100], 1200),
+         ("cse", ["WSO2", 57.6, 100], 1700)],
+        [("WSO2", "Hello World", 55.6), ("WSO2", "Hello World", 57.6)],
+        playback=True, advance_to=4000)
+
+
+def test_join_with_aliases():
+    run_query(CSE_TW + Q + """
+        from cse#window.time(1 sec) as a join twitter#window.time(1 sec) as b
+            on a.symbol == b.company
+        select a.symbol as symbol, b.tweet, a.price
+        insert into out;""",
+        [("cse", ["WSO2", 55.6, 100], 1000),
+         ("twitter", ["User1", "Hello World", "WSO2"], 1100),
+         ("cse", ["IBM", 75.6, 100], 1200),
+         ("cse", ["WSO2", 57.6, 100], 1700)],
+        [("WSO2", "Hello World", 55.6), ("WSO2", "Hello World", 57.6)],
+        playback=True, advance_to=4000)
+
+
+def test_self_join():
+    run_query("""
+        define stream cse (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cse#window.time(500 milliseconds) as a
+             join cse#window.time(500 milliseconds) as b
+            on a.symbol == b.symbol
+        select a.symbol as symbol, a.price as priceA, b.price as priceB
+        insert into out;""",
+        [("cse", ["IBM", 75.6, 100], 1000),
+         ("cse", ["WSO2", 57.6, 100], 1010)],
+        [("IBM", 75.6, 75.6), ("WSO2", 57.6, 57.6)],
+        playback=True, advance_to=3000)
+
+
+def test_join_length_windows():
+    run_query(CSE_TW + Q + """
+        from cse#window.length(1) join twitter#window.length(1)
+            on cse.symbol == twitter.company
+        select cse.symbol as symbol, twitter.tweet, cse.price
+        insert into out;""",
+        [("cse", ["WSO2", 55.6, 100]),
+         ("twitter", ["User1", "Hello World", "WSO2"]),
+         ("cse", ["IBM", 75.6, 100]),
+         ("cse", ["WSO2", 57.6, 100])],
+        [("WSO2", "Hello World", 55.6), ("WSO2", "Hello World", 57.6)])
+
+
+def test_join_unidirectional():
+    # only the left side triggers output
+    run_query(CSE_TW + Q + """
+        from cse#window.length(2) unidirectional
+             join twitter#window.length(2)
+            on cse.symbol == twitter.company
+        select cse.symbol as symbol, twitter.tweet
+        insert into out;""",
+        [("twitter", ["User1", "t1", "WSO2"]),
+         ("cse", ["WSO2", 55.6, 100]),
+         ("twitter", ["User2", "t2", "WSO2"])],
+        [("WSO2", "t1")])
+
+
+def test_left_outer_join_unmatched_left():
+    run_query(CSE_TW + Q + """
+        from cse#window.length(2) left outer join twitter#window.length(2)
+            on cse.symbol == twitter.company
+        select cse.symbol as symbol, twitter.tweet
+        insert into out;""",
+        [("cse", ["WSO2", 55.6, 100]),
+         ("twitter", ["User1", "t1", "WSO2"]),
+         ("cse", ["IBM", 75.6, 100])],
+        [("WSO2", None), ("WSO2", "t1"), ("IBM", None)])
+
+
+def test_right_outer_join_unmatched_right():
+    run_query(CSE_TW + Q + """
+        from cse#window.length(2) right outer join twitter#window.length(2)
+            on cse.symbol == twitter.company
+        select twitter.tweet, cse.symbol as symbol
+        insert into out;""",
+        [("twitter", ["User1", "t1", "GOOG"]),
+         ("cse", ["WSO2", 55.6, 100])],
+        [("t1", None)])
+
+
+def test_full_outer_join():
+    run_query(CSE_TW + Q + """
+        from cse#window.length(2) full outer join twitter#window.length(2)
+            on cse.symbol == twitter.company
+        select cse.symbol as symbol, twitter.tweet
+        insert into out;""",
+        [("cse", ["WSO2", 55.6, 100]),
+         ("twitter", ["User1", "t1", "GOOG"])],
+        [("WSO2", None), (None, "t1")])
+
+
+def test_join_stream_with_table():
+    run_query("""
+        define stream S (symbol string, qty int);
+        define table T (symbol string, price float);
+        @info(name='insQ') from S[qty < 0] select symbol, 1.0f as price
+            insert into T;
+        @info(name = 'query1')
+        from S[qty > 0] join T on S.symbol == T.symbol
+        select S.symbol as symbol, T.price, S.qty
+        insert into out;""",
+        [("S", ["WSO2", -1]), ("S", ["WSO2", 5])],
+        [("WSO2", 1.0, 5)])
+
+
+# ------------------------------------------------------------ partitions
+
+def test_partition_isolated_sums():
+    run_query("""
+        define stream cse (symbol string, price float, volume int);
+        partition with (symbol of cse)
+        begin
+            @info(name = 'query1')
+            from cse select symbol, sum(price) as total insert into out;
+        end;""",
+        [("cse", ["WSO2", 10.0, 1]), ("cse", ["IBM", 20.0, 1]),
+         ("cse", ["WSO2", 30.0, 1]), ("cse", ["IBM", 40.0, 1])],
+        [("WSO2", 10.0), ("IBM", 20.0), ("WSO2", 40.0), ("IBM", 60.0)])
+
+
+def test_partition_window_per_key():
+    run_query("""
+        define stream cse (symbol string, price float, volume int);
+        partition with (symbol of cse)
+        begin
+            @info(name = 'query1')
+            from cse#window.length(2) select symbol, sum(volume) as t
+            insert into out;
+        end;""",
+        [("cse", ["A", 1.0, 10]), ("cse", ["B", 1.0, 20]),
+         ("cse", ["A", 1.0, 30]), ("cse", ["A", 1.0, 50])],
+        [("A", 10), ("B", 20), ("A", 40), ("A", 80)])
+
+
+def test_partition_range():
+    run_query("""
+        define stream cse (symbol string, price float, volume int);
+        partition with (price < 100 as 'cheap' or price >= 100 as 'pricey'
+                        of cse)
+        begin
+            @info(name = 'query1')
+            from cse select symbol, count() as n insert into out;
+        end;""",
+        [("cse", ["A", 50.0, 1]), ("cse", ["B", 150.0, 1]),
+         ("cse", ["C", 60.0, 1])],
+        [("A", 1), ("B", 1), ("C", 2)])
+
+
+def test_pattern_partition_per_key():
+    # reference PatternPartitionTestCase: partials never cross keys
+    run_query("""
+        define stream A (symbol string, v float);
+        partition with (symbol of A)
+        begin
+            @info(name = 'query1')
+            from every e1=A[v > 10.0] -> e2=A[v > e1.v]
+            select e1.v as v1, e2.v as v2 insert into out;
+        end;""",
+        [("A", ["X", 20.0]), ("A", ["Y", 30.0]), ("A", ["X", 25.0]),
+         ("A", ["Y", 5.0]), ("A", ["Y", 35.0])],
+        [(20.0, 25.0), (30.0, 35.0)])
+
+
+def test_sequence_partition_per_key():
+    # reference SequencePartitionTestCase: contiguity is per key
+    run_query("""
+        define stream A (symbol string, v float);
+        partition with (symbol of A)
+        begin
+            @info(name = 'query1')
+            from every e1=A[v > 10.0], e2=A[v > e1.v]
+            select e1.v as v1, e2.v as v2 insert into out;
+        end;""",
+        [("A", ["X", 20.0]), ("A", ["Y", 1.0]), ("A", ["X", 25.0]),
+         ("A", ["Y", 30.0]), ("A", ["Y", 35.0])],
+        [(20.0, 25.0), (30.0, 35.0)])
+
+
+def test_partition_inner_stream():
+    run_query("""
+        define stream cse (symbol string, price float, volume int);
+        partition with (symbol of cse)
+        begin
+            from cse select symbol, price insert into #inner;
+            @info(name = 'query1')
+            from #inner[price > 15.0] select symbol, price insert into out;
+        end;""",
+        [("cse", ["A", 10.0, 1]), ("cse", ["B", 20.0, 1]),
+         ("cse", ["A", 30.0, 1])],
+        [("B", 20.0), ("A", 30.0)])
+
+
+def test_group_by_two_keys():
+    run_query("""
+        define stream cse (symbol string, kind int, volume int);
+        @info(name = 'query1')
+        from cse select symbol, kind, sum(volume) as t
+        group by symbol, kind insert into out;""",
+        [("cse", ["A", 1, 10]), ("cse", ["A", 2, 20]),
+         ("cse", ["A", 1, 30]), ("cse", ["B", 1, 40])],
+        [("A", 1, 10), ("A", 2, 20), ("A", 1, 40), ("B", 1, 40)])
+
+
+def test_order_by_limit():
+    run_query("""
+        define stream cse (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cse#window.lengthBatch(4)
+        select symbol, price order by price desc limit 2
+        insert into out;""",
+        [("cse", ["A", 10.0, 1]), ("cse", ["B", 40.0, 1]),
+         ("cse", ["C", 20.0, 1]), ("cse", ["D", 30.0, 1])],
+        [("B", 40.0), ("D", 30.0)])
+
+
+def test_having_filters_aggregate():
+    run_query("""
+        define stream cse (symbol string, volume int);
+        @info(name = 'query1')
+        from cse select symbol, sum(volume) as t group by symbol
+        having t > 25 insert into out;""",
+        [("cse", ["A", 10]), ("cse", ["B", 30]), ("cse", ["A", 20])],
+        [("B", 30), ("A", 30)])

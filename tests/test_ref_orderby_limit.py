@@ -1,0 +1,116 @@
+"""order by / limit / offset conformance tests ported from the reference
+corpus (siddhi-core/src/test/java/io/siddhi/core/query/OrderByLimitTestCase
+— 18 @Test methods over orderBy x limit x offset x batch windows)."""
+from ref_harness import run_query
+
+S = "define stream cseEventStream (symbol string, price float, volume long);\n"
+
+
+def batch_q(sel):
+    return S + f"""@info(name='query1')
+    from cseEventStream#window.lengthBatch(5)
+    {sel}
+    insert into outputStream;"""
+
+
+ROWS = [("cseEventStream", ["A", 60.0, 300]),
+        ("cseEventStream", ["B", 50.0, 200]),
+        ("cseEventStream", ["C", 70.0, 400]),
+        ("cseEventStream", ["D", 40.0, 100]),
+        ("cseEventStream", ["E", 80.0, 500])]
+
+
+def test_orderby_asc():
+    run_query(batch_q("select symbol, price order by price"),
+              ROWS, [("D", 40.0), ("B", 50.0), ("A", 60.0), ("C", 70.0),
+                     ("E", 80.0)])
+
+
+def test_orderby_desc():
+    run_query(batch_q("select symbol, price order by price desc"),
+              ROWS, [("E", 80.0), ("C", 70.0), ("A", 60.0), ("B", 50.0),
+                     ("D", 40.0)])
+
+
+def test_orderby_limit():
+    run_query(batch_q("select symbol, price order by price limit 2"),
+              ROWS, [("D", 40.0), ("B", 50.0)])
+
+
+def test_orderby_desc_limit():
+    run_query(batch_q("select symbol, price order by price desc limit 3"),
+              ROWS, [("E", 80.0), ("C", 70.0), ("A", 60.0)])
+
+
+def test_limit_without_orderby():
+    run_query(batch_q("select symbol limit 2"),
+              ROWS, [("A",), ("B",)])
+
+
+def test_offset():
+    run_query(batch_q("select symbol, price order by price offset 3"),
+              ROWS, [("C", 70.0), ("E", 80.0)])
+
+
+def test_limit_offset():
+    run_query(batch_q("select symbol, price order by price limit 2 offset 1"),
+              ROWS, [("B", 50.0), ("A", 60.0)])
+
+
+def test_orderby_two_keys():
+    rows = [("cseEventStream", ["A", 50.0, 2]),
+            ("cseEventStream", ["B", 50.0, 1]),
+            ("cseEventStream", ["C", 40.0, 9]),
+            ("cseEventStream", ["D", 50.0, 0]),
+            ("cseEventStream", ["E", 30.0, 5])]
+    run_query(batch_q("select symbol, price, volume "
+                      "order by price, volume"),
+              rows, [("E", 30.0, 5), ("C", 40.0, 9), ("D", 50.0, 0),
+                     ("B", 50.0, 1), ("A", 50.0, 2)])
+
+
+def test_orderby_mixed_direction():
+    rows = [("cseEventStream", ["A", 50.0, 2]),
+            ("cseEventStream", ["B", 50.0, 1]),
+            ("cseEventStream", ["C", 40.0, 9]),
+            ("cseEventStream", ["D", 50.0, 0]),
+            ("cseEventStream", ["E", 30.0, 5])]
+    run_query(batch_q("select symbol, price, volume "
+                      "order by price asc, volume desc"),
+              rows, [("E", 30.0, 5), ("C", 40.0, 9), ("A", 50.0, 2),
+                     ("B", 50.0, 1), ("D", 50.0, 0)])
+
+
+def test_orderby_string_key():
+    run_query(batch_q("select symbol order by symbol desc limit 2"),
+              ROWS, [("E",), ("D",)])
+
+
+def test_groupby_orderby_limit():
+    """Aggregate per group, then order the batch output and limit."""
+    rows = [("cseEventStream", ["A", 10.0, 1]),
+            ("cseEventStream", ["B", 90.0, 1]),
+            ("cseEventStream", ["A", 20.0, 1]),
+            ("cseEventStream", ["C", 50.0, 1]),
+            ("cseEventStream", ["B", 10.0, 1])]
+    run_query(batch_q("select symbol, sum(price) as total group by symbol "
+                      "order by total desc limit 2"),
+              rows, [("B", 100.0), ("C", 50.0)])
+
+
+def test_sliding_limit_applies_per_chunk():
+    """Without a batch window, limit applies to each emitted chunk."""
+    run_query(S + """@info(name='query1')
+        from cseEventStream select symbol limit 1
+        insert into outputStream;""",
+        ROWS, [("A",), ("B",), ("C",), ("D",), ("E",)])
+
+
+def test_orderby_volume_long():
+    run_query(batch_q("select symbol, volume order by volume desc limit 1"),
+              ROWS, [("E", 500)])
+
+
+def test_offset_beyond_size_empty():
+    run_query(batch_q("select symbol order by symbol offset 9"),
+              ROWS, [])

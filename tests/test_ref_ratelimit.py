@@ -1,0 +1,95 @@
+"""Output rate-limiting conformance modeled on the reference suite
+(query/ratelimit/ — first/last/all × events/time × group-by, snapshot;
+reference query/output/ratelimit/** 19 limiter classes).
+Time-based limiters run under @app:playback with explicit timestamps.
+"""
+from ref_harness import run_query
+
+ADV = lambda ts: ("__advance__", None, ts)
+
+S = "define stream S (symbol string, price float, volume int);\n"
+Q = "@info(name = 'query1') "
+
+
+def test_all_every_3_events():
+    run_query(S + Q + """
+        from S select symbol, price output every 3 events insert into out;""",
+        [("S", ["A", 1.0, 1]), ("S", ["B", 2.0, 1]), ("S", ["C", 3.0, 1]),
+         ("S", ["D", 4.0, 1])],
+        [("A", 1.0), ("B", 2.0), ("C", 3.0)])
+
+
+def test_first_every_3_events():
+    run_query(S + Q + """
+        from S select symbol output first every 3 events insert into out;""",
+        [("S", ["A", 1.0, 1]), ("S", ["B", 2.0, 1]), ("S", ["C", 3.0, 1]),
+         ("S", ["D", 4.0, 1]), ("S", ["E", 5.0, 1])],
+        [("A",), ("D",)])
+
+
+def test_last_every_3_events():
+    run_query(S + Q + """
+        from S select symbol output last every 3 events insert into out;""",
+        [("S", ["A", 1.0, 1]), ("S", ["B", 2.0, 1]), ("S", ["C", 3.0, 1]),
+         ("S", ["D", 4.0, 1])],
+        [("C",)])
+
+
+def test_all_every_time():
+    run_query(S + Q + """
+        from S select symbol output every 1 sec insert into out;""",
+        [("S", ["A", 1.0, 1], 1000), ("S", ["B", 2.0, 1], 1400),
+         ("S", ["C", 3.0, 1], 2100)],
+        [("A",), ("B",), ("C",)], playback=True, advance_to=4000)
+
+
+def test_first_every_time():
+    run_query(S + Q + """
+        from S select symbol output first every 1 sec insert into out;""",
+        [("S", ["A", 1.0, 1], 1000), ("S", ["B", 2.0, 1], 1400),
+         ADV(2050), ("S", ["C", 3.0, 1], 2100),
+         ("S", ["D", 4.0, 1], 2200)],
+        [("A",), ("C",)], playback=True, advance_to=4000)
+
+
+def test_last_every_time():
+    run_query(S + Q + """
+        from S select symbol output last every 1 sec insert into out;""",
+        [("S", ["A", 1.0, 1], 1000), ("S", ["B", 2.0, 1], 1400),
+         ADV(2050), ("S", ["C", 3.0, 1], 2100)],
+        [("B",), ("C",)], playback=True, advance_to=4000)
+
+
+def test_first_per_group_every_events():
+    run_query(S + Q + """
+        from S select symbol, volume
+        output first every 3 events insert into out;""",
+        [("S", ["A", 1.0, 1]), ("S", ["A", 1.0, 2]), ("S", ["B", 2.0, 3]),
+         ("S", ["B", 2.0, 4])],
+        [("A", 1), ("B", 4)])
+
+
+def test_snapshot_every_time_window_contents():
+    run_query(S + Q + """
+        from S#window.length(3) select symbol
+        output snapshot every 1 sec insert into out;""",
+        [("S", ["A", 1.0, 1], 1000), ("S", ["B", 2.0, 1], 1400)],
+        [("A",), ("B",)], playback=True, advance_to=2100)
+
+
+def test_rate_limit_with_aggregation():
+    run_query(S + Q + """
+        from S select sum(volume) as t output last every 2 events
+        insert into out;""",
+        [("S", ["A", 1.0, 10]), ("S", ["B", 1.0, 20]),
+         ("S", ["C", 1.0, 30]), ("S", ["D", 1.0, 40])],
+        [(30,), (100,)])
+
+
+def test_rate_limit_group_by_aggregation():
+    run_query(S + Q + """
+        from S select symbol, sum(volume) as t group by symbol
+        output last every 2 events insert into out;""",
+        [("S", ["A", 1.0, 10]), ("S", ["A", 1.0, 20]),
+         ("S", ["B", 1.0, 30]), ("S", ["B", 1.0, 40])],
+        [(("A", 30)), ("B", 70)])

@@ -1,0 +1,209 @@
+"""Window conformance tests modeled on the reference window suites
+(query/window/LengthWindowTestCase, LengthBatchWindowTestCase,
+TimeWindowTestCase, TimeBatchWindowTestCase, ExternalTimeWindowTestCase,
+ExternalTimeBatchWindowTestCase, TimeLengthWindowTestCase,
+SortWindowTestCase, FrequentWindowTestCase, LossyFrequentWindowTestCase —
+the CURRENT/EXPIRED emission algebra of ARCH.md:238-268).
+Time windows run under @app:playback with explicit timestamps.
+"""
+from ref_harness import run_query
+
+CSE = "define stream cse (symbol string, price float, volume int);\n"
+Q = "@info(name = 'query1') "
+
+
+def test_length_under_capacity_no_expiry():
+    run_query(CSE + Q + """
+        from cse#window.length(4) select symbol, price, volume
+        insert all events into out;""",
+        [("cse", ["IBM", 700.0, 0]), ("cse", ["WSO2", 60.5, 1])],
+        [("IBM", 700.0, 0), ("WSO2", 60.5, 1)], expected_removed=[])
+
+
+def test_length_sliding_expiry_order():
+    run_query(CSE + Q + """
+        from cse#window.length(2) select symbol, price, volume
+        insert all events into out;""",
+        [("cse", ["A", 1.0, 1]), ("cse", ["B", 2.0, 2]),
+         ("cse", ["C", 3.0, 3]), ("cse", ["D", 4.0, 4])],
+        [("A", 1.0, 1), ("B", 2.0, 2), ("C", 3.0, 3), ("D", 4.0, 4)],
+        expected_removed=[("A", 1.0, 1), ("B", 2.0, 2)])
+
+
+def test_length_window_sum_slides():
+    run_query(CSE + Q + """
+        from cse#window.length(2) select symbol, sum(price) as total
+        insert into out;""",
+        [("cse", ["A", 10.0, 1]), ("cse", ["B", 20.0, 2]),
+         ("cse", ["C", 30.0, 3])],
+        [("A", 10.0), ("B", 30.0), ("C", 50.0)])
+
+
+def test_length_batch_emits_on_full():
+    run_query(CSE + Q + """
+        from cse#window.lengthBatch(3) select symbol, price, volume
+        insert into out;""",
+        [("cse", ["A", 1.0, 1]), ("cse", ["B", 2.0, 2]),
+         ("cse", ["C", 3.0, 3]), ("cse", ["D", 4.0, 4])],
+        [("A", 1.0, 1), ("B", 2.0, 2), ("C", 3.0, 3)])
+
+
+def test_length_batch_sum_resets_per_batch():
+    run_query(CSE + Q + """
+        from cse#window.lengthBatch(2) select sum(price) as total
+        insert into out;""",
+        [("cse", ["A", 10.0, 1]), ("cse", ["B", 20.0, 2]),
+         ("cse", ["C", 30.0, 3]), ("cse", ["D", 40.0, 4])],
+        [(30.0,), (70.0,)])
+
+
+def test_length_batch_expired_previous_batch():
+    run_query(CSE + Q + """
+        from cse#window.lengthBatch(2) select symbol, price, volume
+        insert all events into out;""",
+        [("cse", ["A", 1.0, 1]), ("cse", ["B", 2.0, 2]),
+         ("cse", ["C", 3.0, 3]), ("cse", ["D", 4.0, 4])],
+        [("A", 1.0, 1), ("B", 2.0, 2), ("C", 3.0, 3), ("D", 4.0, 4)],
+        expected_removed=[("A", 1.0, 1), ("B", 2.0, 2)])
+
+
+def test_time_window_expires_after_period():
+    run_query(CSE + Q + """
+        from cse#window.time(1 sec) select symbol, price, volume
+        insert all events into out;""",
+        [("cse", ["A", 1.0, 1], 1000), ("cse", ["B", 2.0, 2], 1400)],
+        [("A", 1.0, 1), ("B", 2.0, 2)],
+        expected_removed=[("A", 1.0, 1), ("B", 2.0, 2)],
+        playback=True, advance_to=3000)
+
+
+def test_time_window_sum_decays():
+    run_query(CSE + Q + """
+        from cse#window.time(1 sec) select sum(volume) as total
+        insert into out;""",
+        [("cse", ["A", 1.0, 10], 1000), ("cse", ["B", 2.0, 20], 1300),
+         ("cse", ["C", 3.0, 30], 2100)],
+        [(10,), (30,), (50,)], playback=True, advance_to=4000)
+
+
+def test_time_batch_flushes_on_boundary():
+    run_query(CSE + Q + """
+        from cse#window.timeBatch(1 sec) select symbol, volume
+        insert into out;""",
+        [("cse", ["A", 1.0, 1], 1000), ("cse", ["B", 2.0, 2], 1400),
+         ("cse", ["C", 3.0, 3], 2100)],
+        [("A", 1), ("B", 2), ("C", 3)], playback=True, advance_to=4000)
+
+
+def test_time_batch_sum_per_window():
+    run_query(CSE + Q + """
+        from cse#window.timeBatch(1 sec) select sum(volume) as total
+        insert into out;""",
+        [("cse", ["A", 1.0, 10], 1000), ("cse", ["B", 2.0, 20], 1400),
+         ("cse", ["C", 3.0, 30], 2100)],
+        [(30,), (30,)], playback=True, advance_to=4000)
+
+
+def test_external_time_expiry_by_event_ts():
+    run_query("""
+        define stream cse (ts long, symbol string, volume int);
+        @info(name = 'query1')
+        from cse#window.externalTime(ts, 1 sec) select symbol, volume
+        insert all events into out;""",
+        [("cse", [1000, "A", 1]), ("cse", [1800, "B", 2]),
+         ("cse", [2200, "C", 3])],
+        [("A", 1), ("B", 2), ("C", 3)],
+        expected_removed=[("A", 1)])
+
+
+def test_external_time_batch_by_event_ts():
+    run_query("""
+        define stream cse (ts long, symbol string, volume int);
+        @info(name = 'query1')
+        from cse#window.externalTimeBatch(ts, 1 sec) select symbol, volume
+        insert into out;""",
+        [("cse", [1000, "A", 1]), ("cse", [1200, "B", 2]),
+         ("cse", [2100, "C", 3]), ("cse", [3300, "D", 4])],
+        [("A", 1), ("B", 2), ("C", 3)])
+
+
+def test_time_length_caps_both_ways():
+    run_query(CSE + Q + """
+        from cse#window.timeLength(1 sec, 2) select symbol, volume
+        insert all events into out;""",
+        [("cse", ["A", 1.0, 1], 1000), ("cse", ["B", 2.0, 2], 1100),
+         ("cse", ["C", 3.0, 3], 1200)],
+        [("A", 1), ("B", 2), ("C", 3)],
+        expected_removed=[("A", 1), ("B", 2), ("C", 3)],
+        playback=True, advance_to=3000)
+
+
+def test_sort_window_keeps_smallest():
+    # sort(2, volume, 'asc'): keeps the 2 smallest volumes, expels the rest
+    run_query(CSE + Q + """
+        from cse#window.sort(2, volume) select symbol, volume
+        insert all events into out;""",
+        [("cse", ["A", 1.0, 50]), ("cse", ["B", 2.0, 20]),
+         ("cse", ["C", 3.0, 40]), ("cse", ["D", 4.0, 10])],
+        [("A", 50), ("B", 20), ("C", 40), ("D", 10)],
+        expected_removed=[("A", 50), ("C", 40)])
+
+
+def test_frequent_window_top_occurrences():
+    run_query(CSE + Q + """
+        from cse#window.frequent(1, symbol) select symbol, volume
+        insert into out;""",
+        [("cse", ["A", 1.0, 1]), ("cse", ["A", 1.0, 2]),
+         ("cse", ["B", 2.0, 3]), ("cse", ["A", 1.0, 4])],
+        [("A", 1), ("A", 2), ("A", 4)])
+
+
+def test_lossy_frequent_window():
+    run_query(CSE + Q + """
+        from cse#window.lossyFrequent(0.5, 0.1, symbol)
+        select symbol, volume insert into out;""",
+        [("cse", ["A", 1.0, 1]), ("cse", ["A", 1.0, 2]),
+         ("cse", ["B", 2.0, 3]), ("cse", ["A", 1.0, 4])],
+        [("A", 1), ("A", 2), ("B", 3), ("A", 4)])
+
+
+def test_delay_window_holds_events():
+    run_query(CSE + Q + """
+        from cse#window.delay(1 sec) select symbol, volume
+        insert into out;""",
+        [("cse", ["A", 1.0, 1], 1000), ("cse", ["B", 2.0, 2], 1200)],
+        [("A", 1), ("B", 2)], playback=True, advance_to=4000)
+
+
+def test_session_window_groups_by_gap():
+    run_query(CSE + Q + """
+        from cse#window.session(1 sec) select sum(volume) as total
+        insert into out;""",
+        [("cse", ["A", 1.0, 10], 1000), ("cse", ["B", 2.0, 20], 1300)],
+        [(10,), (30,)], playback=True, advance_to=5000)
+
+
+def test_batch_window_per_chunk():
+    run_query(CSE + Q + """
+        from cse#window.batch() select sum(volume) as total
+        insert into out;""",
+        [("cse", ["A", 1.0, 10]), ("cse", ["B", 2.0, 20])],
+        [(10,), (20,)])
+
+
+def test_window_filter_then_window():
+    run_query(CSE + Q + """
+        from cse[price > 1.0]#window.length(2) select symbol, sum(volume) as t
+        insert into out;""",
+        [("cse", ["A", 0.5, 10]), ("cse", ["B", 2.0, 20]),
+         ("cse", ["C", 3.0, 30]), ("cse", ["D", 4.0, 40])],
+        [("B", 20), ("C", 50), ("D", 70)])
+
+
+def test_window_group_by_with_length():
+    run_query(CSE + Q + """
+        from cse#window.length(4) select symbol, sum(volume) as t
+        group by symbol insert into out;""",
+        [("cse", ["A", 1.0, 10]), ("cse", ["B", 1.0, 20]),
+         ("cse", ["A", 1.0, 30])],
+        [("A", 10), ("B", 20), ("A", 40)])

@@ -44,8 +44,60 @@ def test_length_batch():
     for i in range(5):
         h.send([i + 1], timestamp=1000 + i)
     rt.shutdown()
-    # batches [1,2] and [3,4]; 5 pending. running sums per batch: 1,3 | 3,7
-    assert [e.data[0] for e in got] == [1, 3, 3, 7]
+    # batches [1,2] and [3,4]; 5 pending. batch chunks summarize: one
+    # aggregated row per flush (reference processInBatchNoGroupBy)
+    assert [e.data[0] for e in got] == [3, 7]
+
+
+def test_length_batch_multi_flush_one_send():
+    """A single send_batch spanning two batch flushes must emit BOTH batches'
+    aggregates — one summarized chunk per flush, not one concat chunk."""
+    rt = playback_app("""
+        define stream S (p long);
+        from S#window.lengthBatch(2) select sum(p) as t insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    rt.get_input_handler("S").send_batch({"p": np.asarray([1, 2, 3, 4])})
+    rt.shutdown()
+    assert [e.data[0] for e in got] == [3, 7]
+
+
+def test_length_batch_filter_keeps_summarize():
+    """A filter between a batch window and the selector must not strip the
+    batch mark (EventChunk transforms carry is_batch)."""
+    rt = playback_app("""
+        define stream S (sym string, p double);
+        from S#window.lengthBatch(3)[p > 15.0]
+        select sym, sum(p) as t insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for sym, p in [("A", 10.0), ("B", 20.0), ("C", 30.0)]:
+        h.send([sym, p], timestamp=1000)
+    rt.shutdown()
+    # batch [A,B,C] filtered to [B,C]; summarize → one row, sum 50
+    assert [(e.data[0], e.data[1]) for e in got] == [("C", 50.0)]
+
+
+def test_external_time_batch_multi_window_one_send():
+    rt = playback_app("""
+        define stream S (ets long, p double);
+        from S#window.externalTimeBatch(ets, 1 sec)
+        select sum(p) as t insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    rt.get_input_handler("S").send_batch(
+        {"ets": np.asarray([500, 1500, 2500]),
+         "p": np.asarray([1.0, 2.0, 4.0])})
+    rt.shutdown()
+    # windows [500,1500) -> sum 1, [1500,2500) -> sum 2; 4.0 still buffered
+    assert [e.data[0] for e in got] == [1.0, 2.0]
 
 
 def test_time_window():
@@ -80,7 +132,7 @@ def test_time_batch():
     h.send([2.0], timestamp=1500)
     h.send([5.0], timestamp=2100)   # flush of [1,2] happens at 2000
     rt.shutdown()
-    assert [e.data[0] for e in got] == [1.0, 3.0]
+    assert [e.data[0] for e in got] == [3.0]
 
 
 def test_external_time_window():
@@ -116,7 +168,7 @@ def test_external_time_batch():
     h.send([1200, 2.0])
     h.send([2100, 4.0])   # flushes [1,2]
     rt.shutdown()
-    assert [e.data[0] for e in got] == [1.0, 3.0]
+    assert [e.data[0] for e in got] == [3.0]
 
 
 def test_batch_window():
@@ -131,8 +183,8 @@ def test_batch_window():
     h.send([[1.0], [2.0]][0])
     rt.get_input_handler("S").send_batch({"p": np.asarray([3.0, 4.0])})
     rt.shutdown()
-    # first batch sum=1; second batch resets: 3, 7
-    assert [e.data[0] for e in got] == [1.0, 3.0, 7.0]
+    # batch chunks summarize: one aggregated row per chunk
+    assert [e.data[0] for e in got] == [1.0, 7.0]
 
 
 def test_sort_window():
@@ -201,7 +253,9 @@ def test_frequent_window():
     for s in ["A", "A", "B", "A"]:
         h.send([s])
     rt.shutdown()
-    assert len(got) == 4
+    # B arrives at capacity, only decrements A's count, and is dropped
+    # unemitted (reference FrequentWindowProcessor)
+    assert [e.data[0] for e in got] == ["A", "A", "A"]
 
 
 def test_timelength_window():
