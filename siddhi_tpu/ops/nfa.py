@@ -527,32 +527,71 @@ def build_block_step(spec: NfaSpec):
     return block_step
 
 
-def build_bank_step(spec: NfaSpec):
+def build_bank_step(spec: NfaSpec, ring: int = 0):
     """N structurally-identical patterns (constants differ) × P partitions.
 
-    Returns jittable fn(carry, block, params) → (carry, match_counts [N]):
+    Returns jittable fn(carry, block, params):
       carry:  NFA carry with a leading pattern axis [N, P, ...]
       block:  one [P, T] event block, shared by every pattern
       params: {param_name: [N]} per-pattern constant lanes
-    Match COUNTS only (the 1k-NFA fleet configs are alert-counting scale;
-    full capture decode stays on the single-pattern path) — summing inside
-    the scan keeps the [N, P, T, K] mask from materialising in HBM.
+
+    ring == 0 → (carry, match_counts [N]): counts only; summing inside the
+    scan keeps the [N, P, T, K] mask from materialising in HBM.
+
+    ring > 0 → (carry, (match_counts [N], ring_cnt [N, ring],
+    ring_pid [N, ring], ring_caps [N, ring, R, C], ring_ts [N, ring],
+    ring_ok [N, ring])): a bounded per-pattern match-payload buffer — for
+    up to `ring` matched partitions per block (those with the most
+    matches), the capture rows + timestamp of a match from that
+    partition's last matching event.  Counts stay exact; payloads beyond
+    the ring are counted but not decoded.  This is the production alert
+    payload the fleet path owes (reference matches carry the full
+    StateEvent chain, query/output/callback/QueryCallback.java).
+
+    Zero-copy design: touching the per-step match captures inside the scan
+    forces XLA to double-buffer the whole captures carry every step (~20x
+    throughput loss measured on v5e).  Instead the scan records only the
+    last match's (ts, slot) scalars; captures are gathered from the FINAL
+    carry after the scan — a completed match's capture rows stay in their
+    slot until the slot is re-armed (clear_slot runs only on arming).
+    `ring_ok` is False when the slot WAS re-armed after the match
+    (slot_start moved past the match ts), i.e. the payload was overwritten
+    and is dropped (still counted); with monotonically increasing block
+    timestamps the check is exact, under repeated equal timestamps a
+    same-ts re-arm can slip through as a stale payload.
     """
 
     def per_partition(carry_p, events_p, prm):
         def step(c, ev):
-            inner, acc = c
-            inner2, (mm, *_rest) = _one_partition_step(spec, inner,
-                                                       {**ev, **prm})
+            inner, acc, lmt, lmk = c
+            inner2, (mm, _mcaps, mts, _me, _ms) = _one_partition_step(
+                spec, inner, {**ev, **prm})
             # accumulate in-carry: avoids a [N, P, T] stacked ys buffer
-            return (inner2, acc + jnp.sum(mm.astype(jnp.int32))), None
-        (c2, acc), _ = jax.lax.scan(step, (carry_p, jnp.int32(0)), events_p)
-        return c2, acc
+            acc2 = acc + jnp.sum(mm.astype(jnp.int32))
+            if ring:
+                hit = jnp.any(mm)
+                k = jnp.argmax(mm)
+                lmt = jnp.where(hit, mts[k], lmt)
+                lmk = jnp.where(hit, k.astype(jnp.int32), lmk)
+            return (inner2, acc2, lmt, lmk), None
+        init = (carry_p, jnp.int32(0), jnp.int32(0), jnp.int32(0))
+        (c2, acc, lmt, lmk), _ = jax.lax.scan(step, init, events_p)
+        return c2, acc, lmt, lmk
 
     def pattern_step(carry_n, prm, block):
-        new_carry, counts = jax.vmap(
+        new_carry, counts, lmt, lmk = jax.vmap(
             per_partition, in_axes=(0, 0, None))(carry_n, block, prm)
-        return new_carry, jnp.sum(counts)
+        total = jnp.sum(counts)
+        if not ring:
+            return new_carry, total
+        ring_cnt, ring_pid = jax.lax.top_k(counts, ring)
+        sel_k = lmk[ring_pid]                              # [ring]
+        ring_caps = new_carry["captures"][ring_pid, sel_k]
+        ring_ts = lmt[ring_pid]
+        # slot re-armed after the match → captures overwritten → drop
+        ring_ok = new_carry["slot_start"][ring_pid, sel_k] <= ring_ts
+        return new_carry, (total, ring_cnt, ring_pid, ring_caps, ring_ts,
+                           ring_ok)
 
     def bank_step(carry, block, params):
         return jax.vmap(pattern_step, in_axes=(0, 0, None))(carry, params,

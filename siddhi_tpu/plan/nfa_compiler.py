@@ -843,13 +843,16 @@ class CompiledPatternBank:
     counts per pattern (BASELINE config: 1k NFAs × 10k partitions)."""
 
     def __init__(self, apps: Sequence[str], n_partitions: int,
-                 n_slots: int = 8, pattern_chunk: Optional[int] = None):
+                 n_slots: int = 8, pattern_chunk: Optional[int] = None,
+                 ring: int = 0):
         import jax
         from ..ops.nfa import build_bank_step, make_bank_carry
         self.nfa = CompiledPatternNFA(apps[0], n_partitions=n_partitions,
                                       n_slots=n_slots, parameterize=True)
         self.n_patterns = len(apps)
         self.n_partitions = n_partitions
+        # top_k over the per-partition counts caps the ring at P
+        self.ring = min(ring, n_partitions)
         lanes: Dict[str, List[float]] = {n: [] for n in
                                          self.nfa.param_names}
         for a in apps:
@@ -873,16 +876,19 @@ class CompiledPatternBank:
         self.carries = [make_bank_carry(self.nfa.spec, self.chunk,
                                         n_partitions)
                         for _ in range(self.n_chunks)]
-        self._step = jax.jit(build_bank_step(self.nfa.spec),
+        self._step = jax.jit(build_bank_step(self.nfa.spec, ring=self.ring),
                              donate_argnums=0)
         self.base_ts: Optional[int] = None
 
     def _default_chunk(self, n_partitions: int, n_slots: int) -> int:
         spec = self.nfa.spec
         # carry bytes × ~16 for scan/vmap intermediates (measured on v5e:
-        # N=1000 P=10k K=8 S=2 C=1 wants ~22G)
+        # N=1000 P=10k K=8 S=2 C=1 wants ~22G); a decode ring consumes the
+        # per-step match_caps (no longer DCE'd), roughly doubling caps temps
         bytes_per_pattern = n_partitions * n_slots * (
             4 + 4 + 4 * max(spec.n_rows, 1) * max(spec.n_caps, 1)) * 16
+        if self.ring:
+            bytes_per_pattern *= 2
         budget = 8 << 30      # leave headroom below ~16G HBM
         chunk = max(1, budget // max(bytes_per_pattern, 1))
         for c in (500, 250, 200, 125, 100, 50, 25, 20, 10, 5, 4, 2, 1):
@@ -890,11 +896,45 @@ class CompiledPatternBank:
                 return c
         return 1
 
-    def process_block(self, block) -> np.ndarray:
-        """→ per-pattern match counts for this block ([N] int32)."""
+    def process_block(self, block):
+        """ring == 0 → per-pattern match counts for this block ([N] int32).
+
+        ring > 0 → (counts [N], ring_cnt [N, ring], ring_pid [N, ring],
+        ring_caps [N, ring, R, C], ring_ts [N, ring], ring_ok [N, ring]) —
+        the bounded match payload buffer (see ops/nfa.build_bank_step)."""
         outs = []
         for ci in range(self.n_chunks):
-            self.carries[ci], counts = self._step(self.carries[ci], block,
-                                                  self.params[ci])
-            outs.append(counts)
-        return jnp.concatenate(outs)
+            self.carries[ci], res = self._step(self.carries[ci], block,
+                                               self.params[ci])
+            outs.append(res)
+        if not self.ring:
+            return jnp.concatenate(outs)
+        return tuple(jnp.concatenate([o[i] for o in outs])
+                     for i in range(6))
+
+    def decode_ring(self, ring_cnt, ring_pid, ring_caps, ring_ts, ring_ok):
+        """Vectorised host decode of a block's match-ring payloads.
+
+        → dict of columnar arrays over the M decoded matches:
+        {"pattern": [M], "partition": [M], "ts": [M], <out_name>: [M], ...}
+        (the columnar analogue of the reference's per-match QueryCallback
+        payload).  Entries whose slot was re-armed after the match
+        (ring_ok False) are excluded — overwritten payloads, still counted
+        in `ring_cnt`."""
+        cnt = np.asarray(ring_cnt)
+        pid = np.asarray(ring_pid)
+        caps = np.asarray(ring_caps)          # [N, ring, R, C]
+        ts = np.asarray(ring_ts)
+        ok = np.asarray(ring_ok)
+        pat, slot = np.nonzero((cnt > 0) & ok)
+        out = {"pattern": pat, "partition": pid[pat, slot],
+               "ts": ts[pat, slot].astype(np.int64) + (self.base_ts or 0)}
+        nfa = self.nfa
+        for name, row, attr, which in nfa.select_outputs:
+            lane = nfa.cap_lane[(row, attr, which)]
+            v = caps[pat, slot, row, lane]
+            at = nfa.attr_types.get(attr)
+            if at in (AttrType.INT, AttrType.LONG):
+                v = np.round(v).astype(np.int64)
+            out[name] = v
+        return out

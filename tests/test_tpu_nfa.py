@@ -171,6 +171,62 @@ def test_pattern_bank_counts_match_individual_runs():
     assert counts.tolist() == sorted(counts.tolist(), reverse=True)
 
 
+def test_pattern_bank_match_ring_payloads():
+    """ring > 0: the bounded decode ring's payloads must be real matches —
+    every decoded (pattern, partition, ts, captures) row appears in that
+    pattern's individually-compiled match list, and every ringed partition's
+    payload is its LAST match of the block."""
+    import numpy as np
+    from siddhi_tpu.ops.nfa import pack_blocks
+    from siddhi_tpu.plan.nfa_compiler import CompiledPatternBank
+
+    def app_for(thr):
+        return f"""
+        define stream S (partition int, price float, kind int);
+        @info(name='q')
+        from every e1=S[kind == 0 and price > {thr}] -> e2=S[kind == 1 and price > e1.price]
+        select e1.price as p1, e2.price as p2
+        insert into Out;
+        """
+
+    thresholds = [10.0, 40.0, 70.0]
+    apps = [app_for(t) for t in thresholds]
+    n_partitions = 8
+    pids, prices, kind, ts = gen_events(13, 400, n_partitions)
+    cols = {"partition": pids.astype(np.float32), "price": prices,
+            "kind": kind.astype(np.float32)}
+
+    bank = CompiledPatternBank(apps, n_partitions=n_partitions, n_slots=16,
+                               ring=4)
+    bank.base_ts = int(ts[0])
+    block = pack_blocks(pids, cols, ts, np.zeros(len(pids), np.int32),
+                        n_partitions, base_ts=int(ts[0]))
+    counts, rcnt, rpid, rcaps, rts, rok = bank.process_block(block)
+    decoded = bank.decode_ring(rcnt, rpid, rcaps, rts, rok)
+
+    assert np.asarray(counts).sum() > 0 and len(decoded["pattern"]) > 0
+    for i, a in enumerate(apps):
+        matches = run_tpu(a, pids, prices, kind, ts, n_partitions, 16)
+        # per-partition: ts of the last matching event + all matches
+        last_ts = {}
+        payloads = {}
+        for p, mts, vals in matches:
+            last_ts[p] = max(last_ts.get(p, 0), mts)
+            payloads.setdefault(p, []).append(
+                (mts, round(vals["p1"], 3), round(vals["p2"], 3)))
+        sel = decoded["pattern"] == i
+        for part, mts, p1, p2 in zip(decoded["partition"][sel],
+                                     decoded["ts"][sel],
+                                     decoded["p1"][sel],
+                                     decoded["p2"][sel]):
+            assert part in payloads, (i, part)
+            # the ring holds a match from the partition's LAST matching
+            # event (several slots may complete on that same event)
+            assert mts == last_ts[part]
+            assert (mts, round(float(p1), 3), round(float(p2), 3)) \
+                in payloads[part], (i, part)
+
+
 APP_COUNT = """
 define stream S (partition int, price float, kind int);
 @info(name='q')
