@@ -159,6 +159,39 @@ class JoinRuntime:
         if jis.on is not None:
             self.on = factory(scope).compile(jis.on)
 
+        # table sides: precompile the `on` condition as a table probe so
+        # PK / @Index hash lookups replace the O(n*m) cross product
+        # (reference JoinInputStreamParser compiles the condition against
+        # the opposite FindableProcessor for exactly this reason)
+        self._table_conds: Dict[str, object] = {}
+        for tside, pside in ((self.left, self.right),
+                             (self.right, self.left)):
+            if not tside.is_table or jis.on is None:
+                continue
+            if pside.is_table or pside.is_named_window or \
+                    pside.is_aggregation:
+                continue
+            # unqualified attrs present on BOTH sides bind to the left in
+            # the joined scope but to the table in probe scope — ambiguous,
+            # keep the cross product
+            from ..query_api.expression import variables_of
+            both = {a.name for a in tside.definition.attributes} & \
+                   {a.name for a in pside.definition.attributes}
+            if any(v.stream_id is None and v.attribute in both
+                   for v in variables_of(jis.on)):
+                continue
+            try:
+                from copy import copy as _copy
+                sd = _copy(pside.definition)
+                if pside.ref != sd.id:
+                    sd.source_alias = pside.ref
+                table = app.table_of(tside.stream_id)
+                cc = table.compile_condition(jis.on, sd, factory)
+                if cc.pk_probe is not None or cc.index_probe is not None:
+                    self._table_conds[tside.side] = cc
+            except Exception:  # noqa: BLE001 — any shape issue → cross path
+                pass
+
         qr._finish_chain([], scope, self.union_def, factory)
         self.head = qr._chain_head([])
 
@@ -207,17 +240,45 @@ class JoinRuntime:
 
     def _probe_and_emit(self, side: JoinSide, opposite: JoinSide,
                         data: EventChunk, emit_type: int):
+        n = len(data)
+        cc = self._table_conds.get(opposite.side)
         if self.agg_runtime is not None and opposite.is_aggregation:
             buf = self.agg_runtime.find_chunk(self.jis.within, self.jis.per,
                                               data)
+        elif cc is not None:
+            # indexed table probe per arriving row (hash lookup +
+            # residual); snapshot and probe under ONE lock acquisition so
+            # the probed row indices are valid for the snapshot
+            table = self.qr.app_runtime.table_of(opposite.stream_id)
+            with table.lock:
+                buf = table.all_rows_chunk()
+                rows = [table._match_rows(cc, data, i)
+                        for i in range(n)] if len(buf) else []
         else:
             buf = opposite.buffer_chunk()
-        n = len(data)
         m = 0 if buf is None or buf.is_empty else len(buf)
         outer_this = (
             self.join_type == JoinType.FULL_OUTER or
             (self.join_type == JoinType.LEFT_OUTER and side.side == "left") or
             (self.join_type == JoinType.RIGHT_OUTER and side.side == "right"))
+
+        if cc is not None and m > 0:
+            sel_l = np.concatenate(
+                [np.full(len(r), i, np.int64) for i, r in enumerate(rows)]
+                or [np.empty(0, np.int64)])
+            sel_r = np.concatenate(rows) if rows \
+                else np.empty(0, np.int64)
+            if outer_this:
+                miss = np.asarray([i for i, r in enumerate(rows)
+                                   if len(r) == 0], np.int64)
+                sel_l = np.concatenate([sel_l, miss])
+                sel_r = np.concatenate([sel_r, np.full(len(miss), -1)])
+                order = np.argsort(sel_l, kind="stable")
+                sel_l, sel_r = sel_l[order], sel_r[order]
+            if len(sel_l):
+                self._emit(side, data, opposite, buf, sel_l, sel_r,
+                           emit_type)
+            return
 
         if m == 0:
             if outer_this:

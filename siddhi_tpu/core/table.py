@@ -29,12 +29,18 @@ STREAM_QUAL = "__stream__"
 
 class CompiledTableCondition:
     """Compiled `on` condition: vectorised over table rows, with per-stream-row
-    scalar bindings; optional equality fast path on the primary key."""
+    scalar bindings; equality fast paths on the primary key or on a secondary
+    `@Index` attribute (reference: CollectionExpressionParser's index-scan vs
+    exhaustive-scan CollectionExecutor plans, util/collection/executor/*)."""
 
     def __init__(self, fn: Optional[CompiledExpr],
-                 pk_probe: Optional[List[Tuple[str, CompiledExpr]]] = None):
+                 pk_probe: Optional[List[Tuple[str, CompiledExpr]]] = None,
+                 index_probe: Optional[Tuple[str, CompiledExpr]] = None):
         self.fn = fn
-        self.pk_probe = pk_probe   # [(table_attr, stream_value_expr)]
+        self.pk_probe = pk_probe       # [(table_attr, stream_value_expr)]
+        # (indexed_attr, stream_value_expr): hash-probe candidates, then
+        # evaluate `fn` over the candidate subset only
+        self.index_probe = index_probe
 
 
 class CompiledSetUpdate:
@@ -57,6 +63,7 @@ class InMemoryTable:
         self._indexes: Dict[str, Dict[Any, List[int]]] = {
             a: {} for a in self.index_attrs}
         self._cols_cache: Optional[Dict[str, np.ndarray]] = None
+        self._ts_cache: Optional[np.ndarray] = None
 
     # ------------------------------------------------------------ basics
 
@@ -65,6 +72,12 @@ class InMemoryTable:
 
     def _invalidate(self):
         self._cols_cache = None
+        self._ts_cache = None
+
+    def _ts_array(self) -> np.ndarray:
+        if self._ts_cache is None:
+            self._ts_cache = np.asarray(self.timestamps, np.int64)
+        return self._ts_cache
 
     def _materialise(self) -> Dict[str, np.ndarray]:
         if self._cols_cache is None:
@@ -99,7 +112,7 @@ class InMemoryTable:
 
     def insert(self, chunk: EventChunk):
         with self.lock:
-            n0 = len(self.timestamps)
+            overwrote = False
             for i in range(len(chunk)):
                 if self.primary_key:
                     key = tuple(_item(chunk.columns[a][i])
@@ -110,17 +123,21 @@ class InMemoryTable:
                         r = self._pk_index[key]
                         for n in self.names:
                             self.columns[n][r] = _item(chunk.columns[n][i])
+                        overwrote = True
                         continue
                 for n in self.names:
                     self.columns[n].append(_item(chunk.columns[n][i]))
                 self.timestamps.append(int(chunk.timestamps[i]))
                 self._index_row(len(self.timestamps) - 1)
+            if overwrote and self.index_attrs:
+                # overwritten rows may have moved index buckets
+                self._rebuild_indexes()
             self._invalidate()
 
     def all_rows_chunk(self) -> EventChunk:
         cols = self._materialise()
         n = len(self.timestamps)
-        return EventChunk(self.names, np.asarray(self.timestamps, np.int64),
+        return EventChunk(self.names, self._ts_array(),
                           np.zeros(n, np.int8), dict(cols))
 
     def _match_rows(self, cond: Optional[CompiledTableCondition],
@@ -143,8 +160,25 @@ class InMemoryTable:
             r = self._pk_index.get(key)
             return np.asarray([r] if r is not None else [], np.int64)
         cols = self._materialise()
-        ctx = EvalCtx(dict(cols), np.asarray(self.timestamps, np.int64), n,
-                      qualified=qual)
+        if cond.index_probe is not None:
+            # hash-probe the secondary index, then run the full condition
+            # over the candidate rows only (candidates are in ascending row
+            # order, so results keep full-scan order)
+            attr, ce = cond.index_probe
+            sctx = EvalCtx({}, np.zeros(1, np.int64), 1, qualified=qual)
+            key = _item(_scalar(ce.fn(sctx)))
+            cand = self._indexes[attr].get(key)
+            if not cand:
+                return np.empty(0, np.int64)
+            cand = np.asarray(cand, np.int64)
+            cctx = EvalCtx({k: v[cand] for k, v in cols.items()},
+                           self._ts_array()[cand],
+                           len(cand), qualified=qual)
+            m = np.asarray(cond.fn.fn(cctx), bool)
+            if m.ndim == 0:
+                m = np.full(len(cand), bool(m))
+            return cand[np.flatnonzero(m)]
+        ctx = EvalCtx(dict(cols), self._ts_array(), n, qualified=qual)
         m = np.asarray(cond.fn.fn(ctx), bool)
         if m.ndim == 0:
             m = np.full(n, bool(m))
@@ -178,6 +212,10 @@ class InMemoryTable:
                 rows = self._match_rows(cond, stream_chunk, i)
                 if len(rows):
                     self._apply_set(rows, stream_chunk, i, cset)
+                    if self.index_attrs:
+                        # a SET may move rows between index buckets; later
+                        # stream rows in this batch probe those buckets
+                        self._rebuild_indexes()
             self._rebuild_indexes()
             self._invalidate()
 
@@ -188,6 +226,8 @@ class InMemoryTable:
                 rows = self._match_rows(cond, stream_chunk, i)
                 if len(rows):
                     self._apply_set(rows, stream_chunk, i, cset)
+                    if self.index_attrs:
+                        self._rebuild_indexes()
                 else:
                     row = stream_chunk.slice(i, i + 1)
                     # insert maps same-named attributes
@@ -237,27 +277,37 @@ class InMemoryTable:
 
     # ------------------------------------------------------------ compile
 
-    def compile_condition(self, on: Optional[Expression], stream_def,
-                          factory) -> CompiledTableCondition:
-        if on is None:
-            return CompiledTableCondition(None)
+    def _stream_scope(self, stream_def, shadow_table_attrs: bool) -> Scope:
+        """Scope binding the probing stream's attributes as per-row scalars
+        (qualified by stream id/alias; unqualified too, unless
+        `shadow_table_attrs` and the table defines the same name)."""
         scope = Scope()
-        # stream attributes first: qualified scalars (by stream name, or
-        # unqualified when not shadowed by a table attribute)
         if stream_def is not None:
             for a in stream_def.attributes:
                 def g(ctx, name=a.name):
                     return ctx.qualified[(STREAM_QUAL, 0)][name]
                 for qual in _stream_quals(stream_def, self.definition.id):
                     scope.add(qual, a.name, a.type, g)
-                if self.definition.index_of(a.name) < 0:
+                if not shadow_table_attrs or \
+                        self.definition.index_of(a.name) < 0:
                     scope.add(None, a.name, a.type, g)
-        # table attributes last: `T.x` (and unqualified table columns) must
-        # resolve to the table even when the flowing definition shares ids
+        return scope
+
+    def compile_condition(self, on: Optional[Expression], stream_def,
+                          factory) -> CompiledTableCondition:
+        if on is None:
+            return CompiledTableCondition(None)
+        # stream attributes first; table attributes last: `T.x` (and
+        # unqualified table columns) must resolve to the table even when
+        # the flowing definition shares ids
+        scope = self._stream_scope(stream_def, shadow_table_attrs=True)
         scope.add_primary(self.definition.id, None, self.definition)
         compiler = factory(scope)
         pk_probe = self._try_pk_probe(on, stream_def, factory)
-        return CompiledTableCondition(compiler.compile(on), pk_probe)
+        index_probe = None if pk_probe else \
+            self._try_index_probe(on, stream_def, factory)
+        return CompiledTableCondition(compiler.compile(on), pk_probe,
+                                      index_probe)
 
     def _try_pk_probe(self, on: Expression, stream_def, factory):
         """Detect `table.pk == <stream expr>` (AND-combined for composite
@@ -281,17 +331,44 @@ class InMemoryTable:
 
         if not collect(on) or set(eqs) != set(self.primary_key):
             return None
-        scope = Scope()
-        if stream_def is not None:
-            for a in stream_def.attributes:
-                def g(ctx, name=a.name):
-                    return ctx.qualified[(STREAM_QUAL, 0)][name]
-                for qual in _stream_quals(stream_def, self.definition.id):
-                    scope.add(qual, a.name, a.type, g)
-                scope.add(None, a.name, a.type, g)
-        compiler = factory(scope)
+        compiler = factory(self._stream_scope(stream_def,
+                                              shadow_table_attrs=False))
         return [(k, compiler.compile(v))
                 for k, v in ((pk, eqs[pk]) for pk in self.primary_key)]
+
+    def _try_index_probe(self, on: Expression, stream_def, factory):
+        """Detect an AND-conjunct `table.indexed == <stream expr>` →
+        secondary-index hash probe with residual filtering (reference:
+        IndexEventHolder secondary indexes + CollectionExpressionParser's
+        partial index plans)."""
+        if not self.index_attrs:
+            return None
+        found: List[Tuple[str, Expression]] = []
+
+        def collect(e: Expression):
+            if isinstance(e, And):
+                collect(e.left)
+                collect(e.right)
+                return
+            if isinstance(e, Compare) and e.op == CompareOp.EQ:
+                for a, b in ((e.left, e.right), (e.right, e.left)):
+                    if isinstance(a, Variable) and \
+                            a.attribute in self.index_attrs and \
+                            a.stream_id in (None, self.definition.id) and \
+                            not _mentions_table(b, self.definition):
+                        found.append((a.attribute, b))
+                        return
+
+        collect(on)
+        if not found:
+            return None
+        attr, value_expr = found[0]
+        compiler = factory(self._stream_scope(stream_def,
+                                              shadow_table_attrs=False))
+        try:
+            return (attr, compiler.compile(value_expr))
+        except Exception:
+            return None     # value expr needs table columns → full scan
 
     def compile_set(self, assignments, stream_def, factory) -> CompiledSetUpdate:
         out = []

@@ -144,6 +144,132 @@ def test_primary_key_table_store_query():
     assert [e.data for e in events] == [["B", 2.0]]
 
 
+def test_store_query_cache_is_lru():
+    """The store-query runtime cache evicts least-recently-used entries one
+    at a time, not wholesale (reference SiddhiAppRuntime.java:280-316)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, price float);
+        define table T (symbol string, price float);
+        from S insert into T;
+    """)
+    rt.start()
+    rt.get_input_handler("S").send(["A", 1.0])
+    rt._store_query_cache_size = 4
+    for i in range(6):
+        rt.query(f"from T select symbol, price limit {i + 1}")
+    q0 = "from T select symbol, price limit 1"
+    assert q0 not in rt._store_query_cache          # evicted (LRU)
+    assert len(rt._store_query_cache) == 4
+    # touching an entry protects it from the next eviction
+    q3 = "from T select symbol, price limit 3"
+    rt.query(q3)
+    rt.query("from T select symbol")                # evicts limit-4, not q3
+    assert q3 in rt._store_query_cache
+    rt.shutdown()
+
+
+def test_secondary_index_probe_used_and_correct():
+    """@Index conditions must consult the hash index (not full-scan) and
+    stay correct across updates/deletes/PK-overwrites (reference:
+    IndexEventHolder secondary indexes)."""
+    import numpy as np
+
+    from siddhi_tpu.core.table import InMemoryTable
+    from siddhi_tpu.query_api.definition import (Attribute, AttrType,
+                                                 StreamDefinition)
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (sym string, grp string, price float);
+        define stream Del (grp string);
+        define stream Upd (sym string, grp string);
+        @PrimaryKey('sym') @Index('grp')
+        define table T (sym string, grp string, price float);
+        from S insert into T;
+        from Del delete T on T.grp == Del.grp;
+        from Upd update T set T.grp = Upd.grp on T.sym == Upd.sym;
+    """)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(50):
+        h.send([f"s{i}", f"g{i % 5}", float(i)])
+
+    table = rt.tables["T"]
+    # the compiled store-query condition picks the index probe
+    sdef = StreamDefinition("q", [Attribute("g", AttrType.STRING)])
+    from siddhi_tpu.plan.expr_compiler import ExprCompiler
+    from siddhi_tpu.compiler.parser import parse_expression
+    cond = table.compile_condition(
+        parse_expression("T.grp == 'g2' and price > 10.0"), None,
+        lambda scope: ExprCompiler(scope, np))
+    assert cond.index_probe is not None and cond.index_probe[0] == "grp"
+    rows = table.find(cond)
+    assert sorted(rows.columns["sym"].tolist()) == \
+        sorted(f"s{i}" for i in range(50) if i % 5 == 2 and i > 10)
+
+    # update moves a row between buckets; delete drops a bucket
+    rt.get_input_handler("Upd").send(["s2", "g0"])
+    rows = table.find(cond)
+    assert "s2" not in rows.columns["sym"].tolist()
+    rt.get_input_handler("Del").send(["g2"])
+    assert len(table.find(cond)) == 0
+    # PK overwrite re-buckets (insert with clashing key rewrites the row)
+    h.send(["s0", "g2", 999.0])
+    rows = table.find(cond)
+    assert rows.columns["sym"].tolist() == ["s0"]
+    rt.shutdown()
+
+
+def test_secondary_index_beats_full_scan():
+    """Probe cost must scale with bucket size, not table size."""
+    import time as _time
+
+    import numpy as np
+
+    from siddhi_tpu.compiler.parser import parse_expression
+    from siddhi_tpu.plan.expr_compiler import ExprCompiler
+
+    def build(n_rows, indexed):
+        m = SiddhiManager()
+        ann = "@Index('grp')" if indexed else ""
+        rt = m.create_siddhi_app_runtime(f"""
+            define stream S (sym string, grp string, price float);
+            {ann}
+            define table T (sym string, grp string, price float);
+            from S insert into T;
+        """)
+        rt.start()
+        cols = {"sym": np.asarray([f"s{i}" for i in range(n_rows)], object),
+                "grp": np.asarray([f"g{i}" for i in range(n_rows)], object),
+                "price": np.arange(n_rows, dtype=np.float32)}
+        rt.get_input_handler("S").send_batch(cols)
+        return rt
+
+    def probe_time(rt, reps=60):
+        table = rt.tables["T"]
+        cond = table.compile_condition(
+            parse_expression("T.grp == 'g7'"), None,
+            lambda scope: ExprCompiler(scope, np))
+        table.find(cond)       # warm the column cache
+        t0 = _time.perf_counter()
+        for _ in range(reps):
+            table.find(cond)
+        return (_time.perf_counter() - t0) / reps
+
+    rt_small = build(200, indexed=True)
+    rt_big = build(20_000, indexed=True)
+    rt_big_scan = build(20_000, indexed=False)
+    t_small, t_big = probe_time(rt_small), probe_time(rt_big)
+    t_scan = probe_time(rt_big_scan)
+    for rt in (rt_small, rt_big, rt_big_scan):
+        rt.shutdown()
+    # indexed probe ~O(bucket): 100× more rows must NOT cost 10× more;
+    # unindexed full scan over 20k rows must be clearly slower
+    assert t_big < t_small * 10, (t_small, t_big)
+    assert t_scan > t_big * 3, (t_big, t_scan)
+
+
 # ---------------------------------------------------------------- triggers
 
 def test_periodic_trigger_playback():

@@ -1,0 +1,306 @@
+"""Table conformance tests ported from the reference corpus
+(siddhi-core/src/test/java/io/siddhi/core/query/table/ — IndexTableTestCase,
+PrimaryKeyTableTestCase, JoinTableTestCase, LogicalTableTestCase,
+DeleteFromTableTestCase, UpdateFromTableTestCase, UpdateOrInsertTableTestCase,
+InsertIntoTableTestCase).  Behaviors mirrored; assertions are the reference
+tests' expected payloads."""
+from ref_harness import run_query
+
+STOCKS = """
+define stream StockStream (symbol string, price float, volume long);
+define stream CheckStockStream (symbol string, volume long);
+define stream UpdateStockStream (symbol string, price float, volume long);
+define stream DeleteStockStream (symbol string);
+"""
+
+
+def T(ann=""):
+    return f"{ann} define table StockTable " \
+           "(symbol string, price float, volume long);\n"
+
+
+FILL = [("StockStream", ["WSO2", 55.6, 100]),
+        ("StockStream", ["IBM", 75.6, 10]),
+        ("StockStream", ["MSFT", 57.6, 200])]
+
+
+# ------------------------------------------------- IndexTableTestCase
+
+def test_index_join_eq():
+    """indexTableTest1: join on the indexed attribute."""
+    run_query(STOCKS + T("@Index('symbol')") + """
+        from StockStream insert into StockTable;
+        @info(name='query1')
+        from CheckStockStream join StockTable
+            on CheckStockStream.symbol == StockTable.symbol
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;""",
+        [("StockStream", ["WSO2", 55.6, 100]),
+         ("StockStream", ["IBM", 55.6, 100]),
+         ("CheckStockStream", ["IBM", 100]),
+         ("CheckStockStream", ["WSO2", 100])],
+        [("IBM", 100), ("WSO2", 100)])
+
+
+def test_index_join_lt_const():
+    """indexTableTest2 family: non-eq condition over the indexed attr falls
+    back to scan but stays correct."""
+    run_query(STOCKS + T("@Index('volume')") + """
+        from StockStream insert into StockTable;
+        @info(name='query1')
+        from CheckStockStream join StockTable
+            on StockTable.volume < 150
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;""",
+        FILL + [("CheckStockStream", ["BP", 100])],
+        [("BP", 100), ("BP", 10)], unordered=True)
+
+
+def test_index_delete_on_indexed():
+    run_query(STOCKS + T("@Index('symbol')") + """
+        from StockStream insert into StockTable;
+        from DeleteStockStream delete StockTable
+            on StockTable.symbol == DeleteStockStream.symbol;
+        @info(name='query1')
+        from CheckStockStream join StockTable
+            on CheckStockStream.symbol == StockTable.symbol
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;""",
+        FILL + [("DeleteStockStream", ["IBM"]),
+                ("CheckStockStream", ["IBM", 0]),
+                ("CheckStockStream", ["WSO2", 0])],
+        [("WSO2", 100)])
+
+
+def test_index_update_on_indexed():
+    run_query(STOCKS + T("@Index('symbol')") + """
+        from StockStream insert into StockTable;
+        from UpdateStockStream update StockTable
+            set StockTable.volume = UpdateStockStream.volume
+            on StockTable.symbol == UpdateStockStream.symbol;
+        @info(name='query1')
+        from CheckStockStream join StockTable
+            on CheckStockStream.symbol == StockTable.symbol
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;""",
+        FILL + [("UpdateStockStream", ["IBM", 77.6, 999]),
+                ("CheckStockStream", ["IBM", 0])],
+        [("IBM", 999)])
+
+
+def test_index_condition_and_residual():
+    """Indexed eq AND residual non-indexed conjunct."""
+    run_query(STOCKS + T("@Index('symbol')") + """
+        from StockStream insert into StockTable;
+        @info(name='query1')
+        from CheckStockStream join StockTable
+            on CheckStockStream.symbol == StockTable.symbol
+               and StockTable.volume > 50
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;""",
+        FILL + [("CheckStockStream", ["IBM", 0]),     # vol 10 → filtered
+                ("CheckStockStream", ["WSO2", 0])],
+        [("WSO2", 100)])
+
+
+# ------------------------------------------------- PrimaryKeyTableTestCase
+
+def test_pk_join_eq():
+    """primaryKeyTableTest1: probe on the PK."""
+    run_query(STOCKS + T("@PrimaryKey('symbol')") + """
+        from StockStream insert into StockTable;
+        @info(name='query1')
+        from CheckStockStream join StockTable
+            on CheckStockStream.symbol == StockTable.symbol
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;""",
+        [("StockStream", ["WSO2", 55.6, 100]),
+         ("StockStream", ["IBM", 55.6, 100]),
+         ("CheckStockStream", ["IBM", 100]),
+         ("CheckStockStream", ["WSO2", 100])],
+        [("IBM", 100), ("WSO2", 100)])
+
+
+def test_pk_overwrite_on_duplicate_insert():
+    """PK clash keeps ONE row (latest values win on this engine)."""
+    run_query(STOCKS + T("@PrimaryKey('symbol')") + """
+        from StockStream insert into StockTable;
+        @info(name='query1')
+        from CheckStockStream join StockTable
+            on CheckStockStream.symbol == StockTable.symbol
+        select StockTable.symbol, StockTable.price, StockTable.volume
+        insert into OutStream;""",
+        [("StockStream", ["IBM", 10.0, 1]),
+         ("StockStream", ["IBM", 20.0, 2]),
+         ("CheckStockStream", ["IBM", 0])],
+        [("IBM", 20.0, 2)])
+
+
+def test_pk_delete():
+    """primaryKeyTableTest: delete by PK condition."""
+    run_query(STOCKS + T("@PrimaryKey('symbol')") + """
+        from StockStream insert into StockTable;
+        from DeleteStockStream delete StockTable
+            on StockTable.symbol == DeleteStockStream.symbol;
+        @info(name='query1')
+        from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;""",
+        FILL + [("DeleteStockStream", ["WSO2"]),
+                ("CheckStockStream", ["X", 0])],
+        [("IBM", 10), ("MSFT", 200)], unordered=True)
+
+
+def test_pk_int_key():
+    run_query("""
+        define stream S (id int, name string);
+        define stream Q (id int);
+        @PrimaryKey('id') define table T (id int, name string);
+        from S insert into T;
+        @info(name='query1')
+        from Q join T on T.id == Q.id
+        select T.id, T.name insert into OutStream;""",
+        [("S", [1, "a"]), ("S", [2, "b"]), ("S", [3, "c"]),
+         ("Q", [2])],
+        [(2, "b")])
+
+
+# ------------------------------------------------- LogicalTableTestCase
+
+def test_logical_and_condition():
+    run_query(STOCKS + T() + """
+        from StockStream insert into StockTable;
+        @info(name='query1')
+        from CheckStockStream join StockTable
+            on StockTable.symbol == 'IBM' and StockTable.volume == 10
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;""",
+        FILL + [("CheckStockStream", ["X", 0])],
+        [("IBM", 10)])
+
+
+def test_logical_or_condition():
+    run_query(STOCKS + T() + """
+        from StockStream insert into StockTable;
+        @info(name='query1')
+        from CheckStockStream join StockTable
+            on StockTable.symbol == 'IBM' or StockTable.volume == 200
+        select StockTable.symbol insert into OutStream;""",
+        FILL + [("CheckStockStream", ["X", 0])],
+        [("IBM",), ("MSFT",)], unordered=True)
+
+
+def test_logical_not_condition():
+    run_query(STOCKS + T() + """
+        from StockStream insert into StockTable;
+        @info(name='query1')
+        from CheckStockStream join StockTable
+            on not (StockTable.symbol == 'IBM')
+        select StockTable.symbol insert into OutStream;""",
+        FILL + [("CheckStockStream", ["X", 0])],
+        [("WSO2",), ("MSFT",)], unordered=True)
+
+
+# ------------------------------------------------- Delete/Update/UpsertTestCase
+
+def test_delete_with_compound_condition():
+    run_query(STOCKS + T() + """
+        from StockStream insert into StockTable;
+        from DeleteStockStream delete StockTable
+            on StockTable.symbol == DeleteStockStream.symbol
+               and StockTable.volume < 50;
+        @info(name='query1')
+        from CheckStockStream join StockTable
+        select StockTable.symbol insert into OutStream;""",
+        FILL + [("DeleteStockStream", ["IBM"]),     # vol 10 < 50 → deleted
+                ("DeleteStockStream", ["WSO2"]),    # vol 100 → kept
+                ("CheckStockStream", ["X", 0])],
+        [("WSO2",), ("MSFT",)], unordered=True)
+
+
+def test_update_multiple_rows():
+    """update hits every matching row."""
+    run_query("""
+        define stream S (symbol string, price float);
+        define stream U (tag string);
+        define stream C (x int);
+        define table T (symbol string, price float);
+        from S insert into T;
+        from U update T set T.price = 0.0 on T.price > 50.0;
+        @info(name='query1')
+        from C join T select T.symbol, T.price insert into OutStream;""",
+        [("S", ["A", 55.0]), ("S", ["B", 45.0]), ("S", ["C", 65.0]),
+         ("U", ["go"]), ("C", [1])],
+        [("A", 0.0), ("B", 45.0), ("C", 0.0)], unordered=True)
+
+
+def test_update_or_insert_inserts_then_updates():
+    run_query("""
+        define stream S (symbol string, price float);
+        define stream C (x int);
+        define table T (symbol string, price float);
+        from S update or insert into T set T.price = S.price
+            on T.symbol == S.symbol;
+        @info(name='query1')
+        from C join T select T.symbol, T.price insert into OutStream;""",
+        [("S", ["A", 1.0]), ("S", ["B", 2.0]), ("S", ["A", 3.0]),
+         ("C", [1])],
+        [("A", 3.0), ("B", 2.0)], unordered=True)
+
+
+# ------------------------------------------------- JoinTableTestCase
+
+def test_table_join_with_stream_filter():
+    run_query(STOCKS + T() + """
+        from StockStream insert into StockTable;
+        @info(name='query1')
+        from CheckStockStream[volume > 50] join StockTable
+            on CheckStockStream.symbol == StockTable.symbol
+        select CheckStockStream.symbol, StockTable.price
+        insert into OutStream;""",
+        FILL + [("CheckStockStream", ["IBM", 10]),    # filtered out
+                ("CheckStockStream", ["IBM", 100])],
+        [("IBM", 75.6)])
+
+
+def test_table_join_select_star_arity():
+    run_query("""
+        define stream S (a int);
+        define stream F (b int);
+        define table T (b int);
+        from F insert into T;
+        @info(name='query1')
+        from S join T on T.b == S.a
+        select S.a, T.b insert into OutStream;""",
+        [("F", [1]), ("F", [2]), ("S", [2])],
+        [(2, 2)])
+
+
+def test_in_table_membership():
+    """`in Table` membership operator
+    (reference condition/InConditionExpressionExecutor)."""
+    run_query("""
+        define stream S (symbol string, price float);
+        define stream F (symbol string);
+        @PrimaryKey('symbol') define table T (symbol string);
+        from F insert into T;
+        @info(name='query1')
+        from S[symbol in T] select symbol, price insert into OutStream;""",
+        [("F", ["IBM"]), ("S", ["IBM", 1.0]), ("S", ["WSO2", 2.0]),
+         ("S", ["IBM", 3.0])],
+        [("IBM", 1.0), ("IBM", 3.0)])
+
+
+def test_table_window_join():
+    """Stream window join against a table stays windowed on the stream
+    side (JoinTableTestCase window variants)."""
+    run_query("""
+        define stream S (symbol string, v long);
+        define stream F (symbol string, m long);
+        define table T (symbol string, m long);
+        from F insert into T;
+        @info(name='query1')
+        from S#window.length(1) join T on T.symbol == S.symbol
+        select S.symbol, S.v, T.m insert into OutStream;""",
+        [("F", ["A", 7]), ("S", ["A", 1]), ("S", ["A", 2])],
+        [("A", 1, 7), ("A", 2, 7)])
