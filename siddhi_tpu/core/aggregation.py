@@ -192,11 +192,13 @@ class AggregationRuntime:
 
     # ------------------------------------------------------------ ingestion
 
-    def receive_chunk(self, chunk: EventChunk):
+    def _prepare_chunk(self, chunk: EventChunk):
+        """Shared ingest head: filters → (ts_col, key_cols, base_vals, n)
+        or None when the chunk is fully filtered."""
         chunk = chunk.only(CURRENT)
         n = len(chunk)
         if n == 0:
-            return
+            return None
         ctx = EvalCtx(chunk.columns, chunk.timestamps, n)
         for f in self.filters:
             m = np.asarray(f.fn(ctx), bool)
@@ -206,7 +208,7 @@ class AggregationRuntime:
                 chunk = chunk.mask(m)
                 n = len(chunk)
                 if n == 0:
-                    return
+                    return None
                 ctx = EvalCtx(chunk.columns, chunk.timestamps, n)
         # event time column
         if self.by_attr is not None:
@@ -223,6 +225,13 @@ class AggregationRuntime:
                 v = np.broadcast_to(np.asarray(v), (n,)) \
                     if np.asarray(v).ndim == 0 else np.asarray(v)
                 base_vals.append(v)
+        return ts_col, key_cols, base_vals, n
+
+    def receive_chunk(self, chunk: EventChunk):
+        prep = self._prepare_chunk(chunk)
+        if prep is None:
+            return
+        ts_col, key_cols, base_vals, n = prep
         for i in range(n):
             key = tuple(_py(kc[i]) for kc in key_cols)
             ts = int(ts_col[i])

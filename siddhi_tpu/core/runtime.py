@@ -111,7 +111,11 @@ class SiddhiAppRuntime:
         self.sources: List[Any] = []
         self.sinks: List[Any] = []
         self._started = False
-        self._store_query_cache: Dict[str, Any] = {}
+        # bounded LRU of compiled store-query runtimes (reference
+        # SiddhiAppRuntime.query:280-316 uses a size-capped LRU map)
+        from collections import OrderedDict
+        self._store_query_cache: "OrderedDict[str, Any]" = OrderedDict()
+        self._store_query_cache_size = 50
 
         self.snapshot_service = SnapshotService(self.app_ctx)
         self.app_ctx.snapshot_service = self.snapshot_service
@@ -186,10 +190,27 @@ class SiddhiAppRuntime:
         # 5. script functions
         for fid, fd in app.function_definitions.items():
             self.app_ctx.script_functions[fid] = ScriptFunction(fd)
-        # 6. aggregations
+        # 6. aggregations (planner: slab-tensor device ingest unless the
+        # app pins @app:engine('host') or device setup fails)
         for aid, ad in app.aggregation_definitions.items():
+            from ..plan.planner import engine_mode
             from .aggregation import AggregationRuntime
-            ar = AggregationRuntime(ad, self)
+            ar = None
+            if engine_mode(app) != "host":
+                try:
+                    from ..plan.iagg_compiler import DeviceAggregationRuntime
+                    ar = DeviceAggregationRuntime(ad, self)
+                except TypeError:
+                    ar = None     # unsupported shape (e.g. string lanes)
+                except Exception:
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "aggregation '%s': device slab path failed, "
+                        "falling back to the host cascade", aid,
+                        exc_info=True)
+                    ar = None
+            if ar is None:
+                ar = AggregationRuntime(ad, self)
             self.aggregations[aid] = ar
             self.snapshot_service.register(f"aggregation:{aid}", ar)
         # 7. queries + partitions
@@ -431,9 +452,12 @@ class SiddhiAppRuntime:
             if rt is None:
                 sq = SiddhiCompiler.parse_store_query(store_query)
                 rt = StoreQueryRuntime(sq, self)
-                if len(self._store_query_cache) > 50:
-                    self._store_query_cache.clear()
+                while len(self._store_query_cache) >= \
+                        self._store_query_cache_size:
+                    self._store_query_cache.popitem(last=False)
                 self._store_query_cache[store_query] = rt
+            else:
+                self._store_query_cache.move_to_end(store_query)
         else:
             rt = StoreQueryRuntime(store_query, self)
         return rt.execute()

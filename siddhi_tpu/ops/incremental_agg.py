@@ -1,0 +1,100 @@
+"""Device-side incremental-aggregation bucket slabs.
+
+TPU-native replacement for the reference's per-event bucket updates
+(aggregation/IncrementalExecutor.java:45-180 — a HashMap of
+BaseIncrementalValueStore per (bucket, group key), mutated one event at a
+time under synchronization).
+
+Here each duration's bucket store is a fixed SLAB of device tensors
+
+    vals [S, B] float32   — one column per decomposed base (sum / sumsq /
+                            min / max / last); counts ride a dedicated lane
+    cnt  [S]    int32     — event count per slot (shared by 'count' bases)
+
+updated once per event micro-batch with segment reductions: the host maps
+(bucket_ts, group key) pairs to slot ids (dict over the batch's UNIQUE
+pairs only), the device folds the whole batch with one `segment_sum` /
+`segment_min` / `segment_max` per base — no per-event work on the hot path.
+
+Precision note: values ride float32 lanes (TPU-native); exact integer
+conformance is kept for counts (int32 lane).  Int-typed sums above 2^24
+lose precision vs the host cascade's arbitrary-precision ints.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = np.float32(-np.inf)
+POS_INF = np.float32(np.inf)
+
+
+def init_row(base_fns: List[str]) -> np.ndarray:
+    """Initial slab row: identity of each base's reduction."""
+    out = np.zeros(len(base_fns), np.float32)
+    for i, fn in enumerate(base_fns):
+        if fn == "min":
+            out[i] = POS_INF
+        elif fn == "max":
+            out[i] = NEG_INF
+    return out
+
+
+def build_slab_update(base_fns: Tuple[str, ...]):
+    """→ jitted fn(vals [S, B], cnt [S], seg [n], base_vals [n, B]) →
+    (vals, cnt).  `seg` < 0 marks masked-out rows."""
+    base_fns = tuple(base_fns)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def update(vals, cnt, seg, base_vals):
+        S = vals.shape[0]
+        n = seg.shape[0]
+        valid = seg >= 0
+        seg_c = jnp.where(valid, seg, S)   # OOB segment swallows masked rows
+        cnt = cnt + jax.ops.segment_sum(valid.astype(jnp.int32), seg_c,
+                                        num_segments=S + 1)[:S]
+        cols = []
+        for b, fn in enumerate(base_fns):
+            col = base_vals[:, b]
+            cur = vals[:, b]
+            if fn in ("sum", "sumsq"):
+                v = col * col if fn == "sumsq" else col
+                add = jax.ops.segment_sum(jnp.where(valid, v, 0.0), seg_c,
+                                          num_segments=S + 1)[:S]
+                cols.append(cur + add)
+            elif fn == "min":
+                m = jax.ops.segment_min(jnp.where(valid, col, POS_INF),
+                                        seg_c, num_segments=S + 1)[:S]
+                cols.append(jnp.minimum(cur, m))
+            elif fn == "max":
+                m = jax.ops.segment_max(jnp.where(valid, col, NEG_INF),
+                                        seg_c, num_segments=S + 1)[:S]
+                cols.append(jnp.maximum(cur, m))
+            elif fn == "count":
+                cols.append(cur)     # counts ride the dedicated cnt lane
+            elif fn == "last":
+                # batch-order last event per slot wins
+                idx = jnp.arange(n)
+                li = jax.ops.segment_max(jnp.where(valid, idx, -1), seg_c,
+                                         num_segments=S + 1)[:S]
+                has = li >= 0
+                lastv = col[jnp.clip(li, 0, max(n - 1, 0))]
+                cols.append(jnp.where(has, lastv, cur))
+            else:
+                raise ValueError(f"Unknown base fn {fn}")
+        return jnp.stack(cols, axis=1), cnt
+
+    return update
+
+
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(3,))
+def reset_slots(vals, cnt, slots, b):
+    """Reset freed slots to their reduction identities (purge support)."""
+    init = jnp.asarray(init_row(b))
+    vals = vals.at[slots].set(init)
+    cnt = cnt.at[slots].set(0)
+    return vals, cnt

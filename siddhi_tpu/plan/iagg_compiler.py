@@ -1,0 +1,208 @@
+"""Device-backed incremental aggregation runtime.
+
+Routes `define aggregation` ingest through the slab segment-reduction
+kernel (ops/incremental_agg.py) instead of the host's per-event bucket
+dict loop (core/aggregation.py receive_chunk ≙ reference
+aggregation/IncrementalExecutor.java:45-180).
+
+Division of labor per micro-batch:
+  host   — filters + expression eval (numpy), bucket-floor per duration
+           (vector int math), (bucket, key) → slot-id factorization over
+           the batch's UNIQUE pairs only
+  device — one segment_sum/min/max fold of the whole batch per base lane
+
+Query/persist/purge sides stay on the host cascade: the slabs are lazily
+materialised back into the `buckets` dict (one device_get per query, not
+per event) so `find_chunk` / store queries / snapshots behave identically
+to the host runtime — conformance is asserted in
+tests/test_device_aggregation.py."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from ..core.aggregation import AggregationRuntime
+from ..core.event import EventChunk
+from ..query_api.definition import DURATION_MS
+
+
+class _Slab:
+    """One duration's device bucket store."""
+
+    def __init__(self, base_fns, cap=2048):
+        import jax.numpy as jnp
+
+        from ..ops.incremental_agg import init_row
+        self.base_fns = tuple(base_fns)
+        self.cap = cap
+        self.slot_of: Dict[Tuple[int, Tuple], int] = {}
+        self.pair_of: List[Tuple[int, Tuple]] = []
+        self.vals = jnp.broadcast_to(jnp.asarray(init_row(base_fns)),
+                                     (cap, max(len(base_fns), 1))).copy()
+        self.cnt = jnp.zeros((cap,), jnp.int32)
+
+    def grow(self):
+        import jax.numpy as jnp
+
+        from ..ops.incremental_agg import init_row
+        extra_v = jnp.broadcast_to(jnp.asarray(init_row(self.base_fns)),
+                                   (self.cap, self.vals.shape[1]))
+        self.vals = jnp.concatenate([self.vals, extra_v])
+        self.cnt = jnp.concatenate(
+            [self.cnt, jnp.zeros((self.cap,), jnp.int32)])
+        self.cap *= 2
+
+
+class DeviceAggregationRuntime(AggregationRuntime):
+    """AggregationRuntime with slab-tensor ingest (SURVEY §7.10 /
+    core/aggregation.py:17-18's promised ops/ path)."""
+
+    def __init__(self, ad, app_runtime):
+        super().__init__(ad, app_runtime)
+        try:
+            from ..query_api.definition import AttrType
+            for fn, arg in zip(self.base_fns, self.base_args):
+                if fn == "count":
+                    continue
+                if arg is not None and arg.type in (AttrType.STRING,
+                                                    AttrType.OBJECT):
+                    raise TypeError(
+                        "non-numeric base lane: host cascade only")
+            from ..ops.incremental_agg import build_slab_update
+            self._slabs: Dict[str, _Slab] = {
+                d: _Slab(self.base_fns) for d in self.durations}
+            self._update = build_slab_update(tuple(self.base_fns))
+            self._dirty = False
+        except Exception:
+            # undo the junction subscription super() made, then let the
+            # caller fall back to the host runtime
+            app_runtime.junction_of(self.stream_id).unsubscribe(self)
+            raise
+
+    # ------------------------------------------------------------ ingest
+
+    def receive_chunk(self, chunk: EventChunk):
+        prep = self._prepare_chunk(chunk)
+        if prep is None:
+            return
+        ts_col, key_cols, base_vals, n = prep
+        # base value matrix [n, B] (count lanes ride zeros)
+        B = max(len(self.base_fns), 1)
+        bv = np.zeros((n, B), np.float32)
+        for b, v in enumerate(base_vals):
+            if v is not None:
+                bv[:, b] = np.asarray(v, np.float32)
+        # group keys → small int ids (unique-only host work)
+        if key_cols:
+            if len(key_cols) == 1:
+                key_obj = key_cols[0]
+            else:
+                key_obj = np.empty(n, object)
+                for i in range(n):
+                    key_obj[i] = tuple(k[i] for k in key_cols)
+            uniq, key_ids = np.unique(key_obj, return_inverse=True)
+            keys_py = [(k if isinstance(k, tuple) else (k,)) for k in uniq]
+            keys_py = [tuple(x.item() if hasattr(x, "item") else x
+                             for x in k) for k in keys_py]
+        else:
+            uniq = np.asarray([0])
+            key_ids = np.zeros(n, np.int64)
+            keys_py = [()]
+        import jax.numpy as jnp
+        for dur in self.durations:
+            step = DURATION_MS[dur]
+            slab = self._slabs[dur]
+            bucket = ts_col - ts_col % step
+            # (bucket, key) → slot: factorize over unique pairs only
+            pair_code = (bucket // step) * len(uniq) + key_ids
+            codes, seg_local = np.unique(pair_code, return_inverse=True)
+            slots = np.empty(len(codes), np.int64)
+            for j, code in enumerate(codes):
+                b_ts = int(code // len(uniq)) * step
+                key = keys_py[int(code % len(uniq))]
+                slot = slab.slot_of.get((b_ts, key))
+                if slot is None:
+                    slot = len(slab.pair_of)
+                    while slot >= slab.cap:
+                        slab.grow()
+                    slab.slot_of[(b_ts, key)] = slot
+                    slab.pair_of.append((b_ts, key))
+                slots[j] = slot
+            seg = slots[seg_local].astype(np.int32)
+            slab.vals, slab.cnt = self._update(
+                slab.vals, slab.cnt, jnp.asarray(seg), jnp.asarray(bv))
+        self._dirty = True
+
+    # ------------------------------------------------------------ sync
+
+    def _sync(self):
+        """Materialise device slabs back into the host bucket dicts (the
+        query/persist/purge sides read those)."""
+        if not self._dirty:
+            return
+        for dur in self.durations:
+            slab = self._slabs[dur]
+            used = len(slab.pair_of)
+            if not used:
+                self.buckets[dur] = {}
+                continue
+            vals = np.asarray(slab.vals[:used])
+            cnt = np.asarray(slab.cnt[:used])
+            store: Dict[Tuple[int, Tuple], List[Any]] = {}
+            for s, (b_ts, key) in enumerate(slab.pair_of):
+                row = []
+                for b, fn in enumerate(self.base_fns):
+                    if fn == "count":
+                        row.append(int(cnt[s]))
+                    elif fn in ("min", "max") and not np.isfinite(
+                            vals[s, b]):
+                        row.append(None)       # untouched identity
+                    else:
+                        row.append(float(vals[s, b]))
+                store[(b_ts, key)] = row
+            self.buckets[dur] = store
+        self._dirty = False
+
+    def _rebuild_slabs(self):
+        """Repopulate slabs from the host dicts (after purge / restore)."""
+        import jax.numpy as jnp
+        for dur in self.durations:
+            slab = _Slab(self.base_fns,
+                         cap=max(2048, 1 << (len(self.buckets[dur]) or 1)
+                                 .bit_length()))
+            vals = np.array(slab.vals)      # mutable host copies
+            cnt = np.array(slab.cnt)
+            for (b_ts, key), row in self.buckets[dur].items():
+                slot = len(slab.pair_of)
+                slab.slot_of[(b_ts, key)] = slot
+                slab.pair_of.append((b_ts, key))
+                for b, fn in enumerate(self.base_fns):
+                    v = row[b]
+                    if fn == "count":
+                        cnt[slot] = int(v or 0)
+                    elif v is not None:
+                        vals[slot, b] = np.float32(v)
+            slab.vals = jnp.asarray(vals)
+            slab.cnt = jnp.asarray(cnt)
+            self._slabs[dur] = slab
+        self._dirty = False
+
+    # ------------------------------------------------------------ reads
+
+    def find_chunk(self, within, per, probe_chunk=None) -> EventChunk:
+        self._sync()
+        return super().find_chunk(within, per, probe_chunk)
+
+    def purge(self, now: int):
+        self._sync()
+        super().purge(now)
+        self._rebuild_slabs()
+
+    def current_state(self):
+        self._sync()
+        return super().current_state()
+
+    def restore_state(self, s):
+        super().restore_state(s)
+        self._rebuild_slabs()

@@ -1,0 +1,154 @@
+"""Device slab-tensor incremental aggregation: conformance vs the host
+bucket cascade (core/aggregation.py), routing, and state round-trips.
+
+(reference model: aggregation/IncrementalExecutor.java:45-180 — here the
+hot path is ops/incremental_agg.py segment reductions; see
+plan/iagg_compiler.py.)"""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+APP = """
+define stream S (symbol string, price double, volume long, ts long);
+define aggregation Agg
+from S
+select symbol, avg(price) as avgPrice, sum(price) as total,
+       count() as n, min(price) as lo, max(price) as hi
+group by symbol
+aggregate by ts every sec ... hour;
+"""
+
+Q = """
+from Agg within 1496200000000, 1496400000000 per 'seconds'
+select AGG_TIMESTAMP, symbol, avgPrice, total, n, lo, hi
+"""
+
+
+def run(engine, sends):
+    prefix = f"@app:engine('{engine}') " if engine else ""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(prefix + APP)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for row in sends:
+        h.send(list(row))
+    events = rt.query(Q)
+    rows = sorted([e.data for e in events], key=lambda r: (r[0], r[1]))
+    agg = rt.aggregations["Agg"]
+    rt.shutdown()
+    return rows, agg
+
+
+def gen(seed, n):
+    rng = np.random.default_rng(seed)
+    syms = ["A", "B", "C"]
+    base = 1496289950000
+    return [[syms[int(rng.integers(0, 3))],
+             float(np.float32(rng.uniform(1.0, 100.0))),
+             int(rng.integers(1, 5)),
+             base + int(rng.integers(0, 120_000))]
+            for _ in range(n)]
+
+
+def test_device_routing_and_conformance():
+    sends = gen(3, 400)
+    host_rows, host_agg = run("host", sends)
+    auto_rows, auto_agg = run(None, sends)
+    from siddhi_tpu.plan.iagg_compiler import DeviceAggregationRuntime
+    assert not isinstance(host_agg, DeviceAggregationRuntime)
+    assert isinstance(auto_agg, DeviceAggregationRuntime)
+    assert len(host_rows) == len(auto_rows) > 0
+    for hr, ar in zip(host_rows, auto_rows):
+        assert hr[0] == ar[0] and hr[1] == ar[1]      # bucket + group
+        assert hr[4] == ar[4]                         # count exact
+        for h, a in zip(hr[2:], ar[2:]):              # f32 lanes
+            assert a == pytest.approx(h, rel=1e-5)
+
+
+def test_device_agg_string_passthrough_falls_back_to_host():
+    """A 'last'-of-string lane cannot ride float32 slabs → host runtime."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, note string, price double, ts long);
+        define aggregation Agg
+        from S select symbol, note, sum(price) as total
+        group by symbol
+        aggregate by ts every sec ... min;
+    """)
+    from siddhi_tpu.plan.iagg_compiler import DeviceAggregationRuntime
+    agg = rt.aggregations["Agg"]
+    assert not isinstance(agg, DeviceAggregationRuntime)
+    rt.start()
+    rt.get_input_handler("S").send(["A", "hello", 5.0, 1496289950000])
+    events = rt.query("""
+        from Agg within 1496200000000, 1496400000000 per 'seconds'
+        select symbol, note, total""")
+    rt.shutdown()
+    assert [e.data for e in events] == [["A", "hello", 5.0]]
+    # exactly one junction subscription survived the fallback
+    junction = None
+    for (sid, *_k), j in rt.junctions.items():
+        if sid == "S":
+            junction = j
+    assert sum(1 for r in junction.receivers if isinstance(
+        r, type(agg))) == 1
+
+
+def test_device_agg_persist_restore_continuity():
+    sends = gen(5, 120)
+    m = SiddhiManager()
+    from siddhi_tpu import InMemoryPersistenceStore
+    m.set_persistence_store(InMemoryPersistenceStore())
+    rt = m.create_siddhi_app_runtime(APP)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for row in sends[:60]:
+        h.send(list(row))
+    rev = rt.persist()
+    rt.shutdown()
+
+    rt2 = m.create_siddhi_app_runtime(APP)
+    rt2.start()
+    rt2.restore_revision(rev)
+    h2 = rt2.get_input_handler("S")
+    for row in sends[60:]:
+        h2.send(list(row))
+    got = sorted([e.data for e in rt2.query(Q)],
+                 key=lambda r: (r[0], r[1]))
+    rt2.shutdown()
+
+    # reference run: everything through one uninterrupted runtime
+    want, _ = run(None, sends)
+    assert len(got) == len(want) > 0
+    for g, w in zip(got, want):
+        assert g[0] == w[0] and g[1] == w[1] and g[4] == w[4]
+        for a, b in zip(g[2:], w[2:]):
+            assert a == pytest.approx(b, rel=1e-5)
+
+
+def test_device_agg_purge_matches_host():
+    """Purging drops old buckets identically on both runtimes."""
+    sends = gen(7, 100)
+    for engine in ("host", None):
+        prefix = f"@app:engine('{engine}') " if engine else ""
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(prefix + APP)
+        rt.start()
+        h = rt.get_input_handler("S")
+        for row in sends:
+            h.send(list(row))
+        agg = rt.aggregations["Agg"]
+        if hasattr(agg, "_sync"):
+            agg._sync()
+        newest = max(b for b, _ in agg.buckets["sec"].keys())
+        agg.purge(newest + 10_000_000_000)
+        if hasattr(agg, "_sync"):
+            agg._sync()
+        left = {d: len(agg.buckets[d]) for d in agg.durations}
+        if engine == "host":
+            host_left = left
+        else:
+            dev_left = left
+        rt.shutdown()
+    assert host_left == dev_left
