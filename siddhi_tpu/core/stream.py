@@ -93,6 +93,7 @@ class StreamJunction:
         self._queue: Optional[queue.Queue] = None
         self._worker_threads: List[threading.Thread] = []
         self._stop = threading.Event()
+        self._drain = threading.Event()
         self._configure_from_annotations()
 
     def _configure_from_annotations(self):
@@ -112,6 +113,7 @@ class StreamJunction:
         if self.is_async and self._queue is None:
             self._queue = queue.Queue(maxsize=self.buffer_size)
             self._stop.clear()
+            self._drain.clear()
             for i in range(self.workers):
                 t = threading.Thread(target=self._worker_loop, daemon=True,
                                      name=f"junction-{self.definition.id}-{i}")
@@ -119,17 +121,18 @@ class StreamJunction:
                 self._worker_threads.append(t)
 
     def stop(self):
-        self._stop.set()
+        """Drain-then-stop: every queued chunk is delivered before workers
+        exit (the reference's shutdown drains the disruptor ring; setting
+        the stop flag first would drop whatever is still queued).
+        Sentinel-free: workers keep consuming until the queue is empty AND
+        the drain flag is up, so no worker can starve another."""
         if self._queue is not None:
-            for _ in self._worker_threads:
-                try:
-                    self._queue.put_nowait(None)
-                except queue.Full:
-                    pass
-        for t in self._worker_threads:
-            t.join(timeout=2.0)
-        self._worker_threads.clear()
-        self._queue = None
+            self._drain.set()
+            for t in self._worker_threads:
+                t.join(timeout=30.0)
+            self._worker_threads.clear()
+            self._queue = None
+        self._stop.set()
 
     def _worker_loop(self):
         """Re-batches queued chunks up to batch_size_max before delivery
@@ -138,18 +141,15 @@ class StreamJunction:
             try:
                 item = self._queue.get(timeout=0.1)
             except queue.Empty:
+                if self._drain.is_set():
+                    break       # drained: queue empty after drain request
                 continue
-            if item is None:
-                break
             batch = [item]
             n = len(item)
             while n < self.batch_size_max:
                 try:
                     nxt = self._queue.get_nowait()
                 except queue.Empty:
-                    break
-                if nxt is None:
-                    self._stop.set()
                     break
                 batch.append(nxt)
                 n += len(nxt)
