@@ -1,0 +1,45 @@
+"""Quick-start: registering a custom function extension (reference model:
+quick-start-samples ExtensionSample.java + util/CustomFunctionExtension —
+here via the @extension decorator / set_extension registry)."""
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from siddhi_tpu import SiddhiManager, StreamCallback  # noqa: E402
+from siddhi_tpu.query_api.definition import AttrType  # noqa: E402
+from siddhi_tpu.utils.extension import (FunctionExtension,  # noqa: E402
+                                        extension)
+
+
+@extension(namespace="custom", name="plus",
+           description="Sum of all numeric arguments",
+           parameters=[("values...", "numeric", "values to add")],
+           returns="double",
+           examples=["custom:plus(price, tax) adds the two columns"])
+class PlusFunction(FunctionExtension):
+    return_type = AttrType.DOUBLE
+
+    def apply(self, *cols):
+        out = cols[0]
+        for c in cols[1:]:
+            out = out + c
+        return out
+
+
+def main():
+    m = SiddhiManager()
+    m.set_extension("custom:plus", PlusFunction)
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (price double, tax double);
+        from S select custom:plus(price, tax) as total
+        insert into OutputStream;
+    """)
+    rt.add_callback("OutputStream", StreamCallback(
+        lambda evs: [print("->", e.data) for e in evs]))
+    rt.start()
+    rt.get_input_handler("S").send([100.0, 17.5])
+    rt.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""Quick-start: filter query with a stream callback (reference model:
+quick-start-samples SimpleFilterSample.java)."""
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from siddhi_tpu import SiddhiManager, StreamCallback  # noqa: E402
+
+
+def main():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream StockStream (symbol string, price float, volume long);
+        from StockStream[volume < 150]
+        select symbol, price insert into OutputStream;
+    """)
+    rt.add_callback("OutputStream", StreamCallback(
+        lambda evs: [print("->", e.timestamp, e.data) for e in evs]))
+    rt.start()
+    h = rt.get_input_handler("StockStream")
+    h.send(["WSO2", 700.0, 100])
+    h.send(["IBM", 75.6, 100])
+    h.send(["GOOG", 50.0, 200])     # filtered out
+    rt.shutdown()
+
+
+if __name__ == "__main__":
+    main()

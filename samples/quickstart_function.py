@@ -1,0 +1,29 @@
+"""Quick-start: built-in and script functions in a select clause
+(reference model: quick-start-samples FunctionSample.java)."""
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from siddhi_tpu import SiddhiManager, StreamCallback  # noqa: E402
+
+
+def main():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream TempStream (room string, tempF double);
+        from TempStream
+        select room, convert((tempF - 32) * 5 / 9, 'double') as tempC,
+               ifThenElse(tempF > 100.0, 'hot', 'ok') as status
+        insert into OutputStream;
+    """)
+    rt.add_callback("OutputStream", StreamCallback(
+        lambda evs: [print("->", e.data) for e in evs]))
+    rt.start()
+    h = rt.get_input_handler("TempStream")
+    h.send(["kitchen", 98.6])
+    h.send(["server-rack", 140.0])
+    rt.shutdown()
+
+
+if __name__ == "__main__":
+    main()

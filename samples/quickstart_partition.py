@@ -1,0 +1,32 @@
+"""Quick-start: per-key partitioned aggregation (reference model:
+quick-start-samples PartitionSample.java)."""
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from siddhi_tpu import SiddhiManager, StreamCallback  # noqa: E402
+
+
+def main():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream TradeStream (symbol string, price double, volume long);
+        partition with (symbol of TradeStream)
+        begin
+            from TradeStream
+            select symbol, sum(volume) as total
+            insert into OutputStream;
+        end;
+    """)
+    rt.add_callback("OutputStream", StreamCallback(
+        lambda evs: [print("->", e.data) for e in evs]))
+    rt.start()
+    h = rt.get_input_handler("TradeStream")
+    h.send(["IBM", 75.6, 100])
+    h.send(["WSO2", 57.6, 10])
+    h.send(["IBM", 75.6, 100])     # IBM total -> 200, WSO2 unaffected
+    rt.shutdown()
+
+
+if __name__ == "__main__":
+    main()

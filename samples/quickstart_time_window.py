@@ -1,0 +1,36 @@
+"""Quick-start: time-window aggregation per symbol (reference model:
+quick-start-samples TimeWindowSample.java) — playback mode makes the
+5-second window deterministic."""
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from siddhi_tpu import QueryCallback, SiddhiManager  # noqa: E402
+
+
+def main():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:playback
+        define stream StockStream (symbol string, price float, volume long);
+        @info(name='query1')
+        from StockStream#window.time(5 sec)
+        select symbol, avg(price) as avgPrice, count() as n
+        group by symbol
+        insert all events into OutputStream;
+    """)
+    rt.add_callback("query1", QueryCallback(
+        lambda ts, cur, exp: print("@", ts,
+                                   [e.data for e in (cur or [])],
+                                   [e.data for e in (exp or [])])))
+    rt.start()
+    h = rt.get_input_handler("StockStream")
+    h.send(["IBM", 100.0, 10], timestamp=1000)
+    h.send(["IBM", 200.0, 10], timestamp=2000)
+    h.send(["WSO2", 50.0, 10], timestamp=3000)
+    h.send(["IBM", 300.0, 10], timestamp=8000)   # first two expired
+    rt.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -67,12 +67,47 @@ def generate_markdown(extension_registry=None) -> str:
               "queried with `from A [on cond] within <from>, <to> per "
               "'<duration>'` in store queries and joins.", ""]
 
+    # @extension-decorated classes: full metadata render (≙ the reference
+    # doc-gen mojos consuming @Extension/@Parameter/@Example annotations)
+    from ..utils.extension import EXTENSION_METADATA
+    seen = set()
+    metas = list(EXTENSION_METADATA.values())
     if extension_registry is not None:
-        names = sorted(getattr(extension_registry, "_by_name", {}))
-        if names:
-            lines += ["## Registered extensions", ""]
-            for n in names:
-                impl = extension_registry._by_name[n]
+        for n, impl in sorted(getattr(extension_registry,
+                                      "_by_name", {}).items()):
+            m = getattr(impl, "__extension_meta__", None)
+            if m is not None and m.key not in EXTENSION_METADATA:
+                metas.append(m)
+    if metas:
+        lines += ["## Registered extensions", ""]
+        for m in metas:
+            if m.key in seen:
+                continue
+            seen.add(m.key)
+            lines.append(f"### `{m.key}`")
+            lines.append("")
+            if m.description:
+                lines.append(m.description)
+                lines.append("")
+            if m.parameters:
+                lines.append("| parameter | type | description |")
+                lines.append("|---|---|---|")
+                for pname, ptype, pdesc in m.parameters:
+                    lines.append(f"| `{pname}` | {ptype} | {pdesc} |")
+                lines.append("")
+            if m.returns:
+                lines.append(f"**Returns:** `{m.returns}`")
+                lines.append("")
+            for ex in m.examples:
+                lines.append(f"```\n{ex}\n```")
+                lines.append("")
+    if extension_registry is not None:
+        plain = [(n, impl) for n, impl in
+                 sorted(getattr(extension_registry, "_by_name", {}).items())
+                 if getattr(impl, "__extension_meta__", None) is None]
+        if plain:
+            lines += ["## Extensions without metadata", ""]
+            for n, impl in plain:
                 lines.append(f"- `{n}` — {_first_line(impl)}")
             lines.append("")
     return "\n".join(lines)

@@ -12,7 +12,59 @@ processors, sources, sinks, mappers, stores.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass
+class ExtensionMeta:
+    """Metadata attached by the @extension decorator (≙ the reference's
+    @Extension annotation + @Parameter/@ReturnAttribute/@Example nested
+    annotations, siddhi-annotations/.../Extension.java).  Feeds arity
+    validation at compile time and tools/docgen.py rendering."""
+    namespace: str
+    name: str
+    description: str = ""
+    # (name, type, description); a name ending in '...' marks variadic
+    parameters: List[Tuple[str, str, str]] = field(default_factory=list)
+    returns: Optional[str] = None
+    examples: List[str] = field(default_factory=list)
+
+    @property
+    def key(self) -> str:
+        ns = (self.namespace or "").lower()
+        return f"{ns}:{self.name.lower()}" if ns else self.name.lower()
+
+    @property
+    def variadic(self) -> bool:
+        return bool(self.parameters) and \
+            self.parameters[-1][0].endswith("...")
+
+
+#: global index of decorated extensions — docgen renders it, and
+#: SiddhiManager.set_extension validates registration names against it
+EXTENSION_METADATA: Dict[str, ExtensionMeta] = {}
+
+
+def extension(namespace: str = "", name: Optional[str] = None,
+              description: str = "",
+              parameters: Sequence[Tuple[str, str, str]] = (),
+              returns: Optional[str] = None,
+              examples: Sequence[str] = ()):
+    """Class decorator declaring extension metadata
+    (reference @Extension, util/SiddhiExtensionLoader.java:50-101 consumes
+    the annotation index this mirrors)."""
+    def deco(cls):
+        meta = ExtensionMeta(namespace=namespace,
+                             name=name or cls.__name__.lower(),
+                             description=description or
+                             (cls.__doc__ or "").split("\n")[0],
+                             parameters=list(parameters), returns=returns,
+                             examples=list(examples))
+        cls.__extension_meta__ = meta
+        EXTENSION_METADATA[meta.key] = meta
+        return cls
+    return deco
 
 
 class FunctionExtension:
@@ -27,6 +79,22 @@ class FunctionExtension:
     @classmethod
     def compile_call(cls, compiled_args, compiler):
         from ..plan.expr_compiler import CompiledExpr
+        from .errors import SiddhiAppCreationError
+        meta: Optional[ExtensionMeta] = getattr(cls, "__extension_meta__",
+                                                None)
+        if meta is not None and meta.parameters:
+            want = len(meta.parameters)
+            n = len(compiled_args)
+            if meta.variadic:
+                if n < want - 1:
+                    raise SiddhiAppCreationError(
+                        f"{meta.key}() needs at least {want - 1} "
+                        f"arguments, got {n}")
+            elif n != want:
+                raise SiddhiAppCreationError(
+                    f"{meta.key}() takes {want} arguments "
+                    f"({', '.join(p[0] for p in meta.parameters)}), "
+                    f"got {n}")
         inst = cls()
 
         def fn(ctx):
