@@ -43,6 +43,9 @@ _DECOMPOSE = {
     "min": ("min",),
     "max": ("max",),
     "stddev": ("sum", "sumsq", "count"),
+    # distinct value-set per bucket, |set| on read (reference
+    # DistinctCountIncrementalAttributeAggregator); host-only lane
+    "distinctcount": ("distinct",),
 }
 
 
@@ -102,7 +105,7 @@ class AggregationRuntime:
                     self.base_args.append(arg)
                 t = (AttrType.DOUBLE if fname in ("avg", "stddev")
                      else (arg.type if arg is not None else AttrType.LONG))
-                if fname == "count":
+                if fname in ("count", "distinctcount"):
                     t = AttrType.LONG
                 if fname == "sum" and arg is not None and arg.type in (
                         AttrType.INT, AttrType.LONG):
@@ -252,13 +255,26 @@ class AggregationRuntime:
 
     def find_chunk(self, within, per, probe_chunk=None) -> EventChunk:
         """Materialise buckets of duration `per` within the time range as an
-        EventChunk (reference IncrementalAggregateCompileCondition.find)."""
-        dur = _eval_per(per)
+        EventChunk (reference IncrementalAggregateCompileCondition.find).
+        `within`/`per` may be Variables referencing the probing stream's
+        attributes (`within i.startTime, i.endTime per i.perValue` —
+        Aggregation1TestCase test6); they resolve against probe_chunk's
+        first row."""
+        from ..query_api.expression import Variable
+        probe_row = None
+        within_items = list(within) if isinstance(within, (tuple, list)) \
+            else [within]
+        if probe_chunk is not None and len(probe_chunk) and any(
+                isinstance(p, Variable)
+                for p in within_items + [per] if p is not None):
+            probe_row = {nm: _py(probe_chunk.columns[nm][0])
+                         for nm in probe_chunk.names}
+        dur = _eval_per(per, probe_row)
         if dur not in self.buckets:
             raise StoreQueryCreationError(
                 f"Aggregation '{self.ad.id}' has no '{dur}' duration "
                 f"(has {self.durations})")
-        lo, hi = _eval_within(within)
+        lo, hi = _eval_within(within, probe_row)
         rows = [(b_ts, key, slots)
                 for (b_ts, key), slots in self.buckets[dur].items()
                 if lo <= b_ts < hi]
@@ -314,6 +330,8 @@ def _jsonable(v):
 
 
 def _init_of(fn: str):
+    if fn == "distinct":
+        return set()
     return None if fn in ("min", "max") else 0
 
 
@@ -332,12 +350,18 @@ def _update(fn: str, acc, v):
         return v if acc is None else min(acc, v)
     if fn == "max":
         return v if acc is None else max(acc, v)
+    if fn == "distinct":
+        acc = set() if acc is None else acc
+        acc.add(v)
+        return acc
     raise SiddhiAppCreationError(f"Unknown base fn {fn}")
 
 
 def _recombine(o: _OutputSpec, base_fns, slots):
     vals = [slots[i] for i in o.bases]
     kinds = [base_fns[i] for i in o.bases]
+    if kinds == ["distinct"]:
+        return len(vals[0] or ())
     if len(vals) == 1:
         return vals[0]
     d = dict(zip(kinds, vals))
@@ -352,9 +376,19 @@ def _recombine(o: _OutputSpec, base_fns, slots):
     return vals[0]
 
 
-def _eval_per(per) -> str:
+def _probe_value(v, probe_row):
+    """Resolve a Variable against the probing stream's row."""
+    from ..query_api.expression import Variable
+    if isinstance(v, Variable) and probe_row is not None and \
+            v.attribute in probe_row:
+        return probe_row[v.attribute]
+    return v
+
+
+def _eval_per(per, probe_row=None) -> str:
     if per is None:
         raise StoreQueryCreationError("aggregation query needs `per`")
+    per = _probe_value(per, probe_row)
     if isinstance(per, Constant):
         word = str(per.value)
     elif isinstance(per, str):
@@ -362,7 +396,12 @@ def _eval_per(per) -> str:
     else:
         raise StoreQueryCreationError(f"Unsupported per expression {per!r}")
     from ..compiler.parser import Parser
-    return Parser._norm_duration(word)
+    try:
+        return Parser._norm_duration(word)
+    except Exception:
+        # `per` may now flow from event data (per i.perValue): a bad value
+        # is a store-query error, not a parse-time one
+        raise StoreQueryCreationError(f"Bad per duration {word!r}")
 
 
 _DATE_FORMATS = ["%Y-%m-%d %H:%M:%S %z", "%Y-%m-%d %H:%M:%S",
@@ -389,20 +428,21 @@ def _parse_time_point(v) -> int:
     raise StoreQueryCreationError(f"Cannot parse time point {v!r}")
 
 
-def _eval_within(within) -> Tuple[int, int]:
+def _eval_within(within, probe_row=None) -> Tuple[int, int]:
     if within is None:
         return (-2**62, 2**62)
     if isinstance(within, (tuple, list)):
         items = [w for w in within if w is not None]
     else:
         items = [within]
+    items = [_probe_value(w, probe_row) for w in items]
     if len(items) == 2:
         return (_parse_time_point(items[0]), _parse_time_point(items[1]))
     w = items[0]
     # single value: a wildcard date pattern "2014-**-** ..." covering a range
-    if isinstance(w, Constant) and isinstance(w.value, str) and \
-            "**" in w.value:
-        s = w.value.strip()
+    wv = w.value if isinstance(w, Constant) else w
+    if isinstance(wv, str) and "**" in wv:
+        s = wv.strip()
         # the range comes from the date prefix before the first wildcard
         prefix = s.split("**")[0].rstrip("-: ")
         try:

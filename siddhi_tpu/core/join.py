@@ -135,6 +135,11 @@ class JoinRuntime:
             self.agg_runtime = app.aggregations[agg_side.stream_id]
         else:
             self.agg_runtime = None
+        from ..query_api.expression import Variable
+        probes = list(jis.within) if isinstance(jis.within, (tuple, list)) \
+            else [jis.within]
+        self._agg_per_row = any(isinstance(p, Variable)
+                                for p in probes + [jis.per] if p is not None)
         self.join_type = jis.join_type
         self.trigger = jis.trigger
 
@@ -243,6 +248,13 @@ class JoinRuntime:
         n = len(data)
         cc = self._table_conds.get(opposite.side)
         if self.agg_runtime is not None and opposite.is_aggregation:
+            if self._agg_per_row and n > 1:
+                # within/per read the probing rows' attributes → each row
+                # may target a different range/duration
+                for i in range(n):
+                    self._probe_and_emit(side, opposite,
+                                         data.slice(i, i + 1), emit_type)
+                return
             buf = self.agg_runtime.find_chunk(self.jis.within, self.jis.per,
                                               data)
         elif cc is not None:

@@ -65,6 +65,9 @@ class DeviceAggregationRuntime(AggregationRuntime):
             for fn, arg in zip(self.base_fns, self.base_args):
                 if fn == "count":
                     continue
+                if fn not in ("sum", "sumsq", "min", "max", "last"):
+                    raise TypeError(
+                        f"base '{fn}' has no slab lane: host cascade only")
                 if arg is not None and arg.type in (AttrType.STRING,
                                                     AttrType.OBJECT):
                     raise TypeError(

@@ -1,0 +1,214 @@
+"""Incremental-aggregation conformance tests ported from the reference
+corpus (siddhi-core/src/test/java/io/siddhi/core/aggregation/
+Aggregation1TestCase and friends — within wildcards, per from joined
+stream attributes, last-value lanes, multi-key group by)."""
+import pytest
+
+from siddhi_tpu import QueryCallback, SiddhiManager
+
+STOCK = ("define stream stockStream (symbol string, price float, "
+         "lastClosingPrice float, volume long, quantity int, "
+         "timestamp long);")
+
+AGG = """
+define aggregation stockAggregation
+from stockStream
+select symbol, avg(price) as avgPrice, sum(price) as totalPrice,
+       (price * quantity) as lastTradeValue
+group by symbol
+aggregate by timestamp every sec ... hour;
+"""
+
+SENDS5 = [
+    ["WSO2", 50.0, 60.0, 90, 6, 1496289950000],
+    ["WSO2", 70.0, None, 40, 10, 1496289950000],
+    ["WSO2", 60.0, 44.0, 200, 56, 1496289952000],
+    ["WSO2", 100.0, None, 200, 16, 1496289952000],
+    ["IBM", 100.0, None, 200, 26, 1496289954000],
+    ["IBM", 100.0, None, 200, 96, 1496289954000],
+]
+
+
+def build(app):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(app)
+    rt.start()
+    return rt
+
+
+def test_within_wildcard_per_seconds():
+    """incrementalStreamProcessorTest5: wildcard `within`, last-value
+    lane reflects the bucket's last event."""
+    rt = build(STOCK + AGG)
+    h = rt.get_input_handler("stockStream")
+    for row in SENDS5:
+        h.send(list(row))
+    events = rt.query('from stockAggregation '
+                      'within "2017-06-** **:**:**" per "seconds"')
+    rt.shutdown()
+    rows = sorted([tuple(e.data) for e in events])
+    assert rows == sorted([
+        (1496289952000, "WSO2", 80.0, 160.0, 1600.0),
+        (1496289950000, "WSO2", 60.0, 120.0, 700.0),
+        (1496289954000, "IBM", 100.0, 200.0, 9600.0),
+    ])
+
+
+def test_join_with_per_from_stream_attribute():
+    """incrementalStreamProcessorTest6: within/per values flow from the
+    joined stream's attributes; output ordered by AGG_TIMESTAMP."""
+    rt = build(STOCK + AGG.replace("sec ... hour", "sec ... year") + """
+        define stream inputStream (symbol string, value int,
+            startTime string, endTime string, perValue string);
+        @info(name = 'query1')
+        from inputStream as i join stockAggregation as s
+        within i.startTime, i.endTime
+        per i.perValue
+        select AGG_TIMESTAMP, s.symbol, avgPrice,
+               totalPrice as sumPrice, lastTradeValue
+        order by AGG_TIMESTAMP
+        insert all events into outputStream;
+    """)
+    got = []
+    rt.add_callback("query1", QueryCallback(
+        lambda ts, cur, exp: got.extend(tuple(e.data) for e in (cur or []))))
+    sh = rt.get_input_handler("stockStream")
+    sh.send(["WSO2", 50.0, 60.0, 90, 6, 1496289950000])
+    sh.send(["WSO2", 70.0, None, 40, 10, 1496289950000])
+    sh.send(["IBM", 100.0, None, 200, 26, 1496289951000])
+    sh.send(["IBM", 900.0, None, 200, 60, 1496289952000])
+    rt.get_input_handler("inputStream").send(
+        ["IBM", 1, "2017-06-01 04:05:50", "2017-06-01 04:05:53",
+         "seconds"])
+    rt.shutdown()
+    assert got == [
+        (1496289950000, "WSO2", 60.0, 120.0, 700.0),
+        (1496289951000, "IBM", 100.0, 100.0, 2600.0),
+        (1496289952000, "IBM", 900.0, 900.0, 54000.0),
+    ]
+
+
+def test_no_group_by_single_bucket_stream():
+    """incrementalStreamProcessorTest1 family: aggregation without
+    group-by keeps one bucket per window."""
+    rt = build(STOCK + """
+        define aggregation stockAggregation
+        from stockStream
+        select sum(price) as sumPrice
+        aggregate by timestamp every sec ... min;
+    """)
+    h = rt.get_input_handler("stockStream")
+    h.send(["WSO2", 50.0, 60.0, 90, 6, 1496289950000])
+    h.send(["IBM", 70.0, None, 40, 10, 1496289950500])
+    h.send(["IBM", 30.0, None, 40, 10, 1496289952000])
+    events = rt.query('from stockAggregation '
+                      'within "2017-06-** **:**:**" per "seconds"')
+    rt.shutdown()
+    rows = sorted(tuple(e.data) for e in events)
+    assert rows == [(1496289950000, 120.0), (1496289952000, 30.0)]
+
+
+def test_group_by_two_keys():
+    """incrementalStreamProcessorTest4: composite group key."""
+    rt = build(STOCK + """
+        define aggregation stockAggregation
+        from stockStream
+        select symbol, volume, sum(price) as sumPrice
+        group by symbol, volume
+        aggregate by timestamp every sec ... min;
+    """)
+    h = rt.get_input_handler("stockStream")
+    h.send(["WSO2", 50.0, 60.0, 90, 6, 1496289950000])
+    h.send(["WSO2", 70.0, None, 90, 10, 1496289950100])
+    h.send(["WSO2", 10.0, None, 40, 10, 1496289950200])
+    events = rt.query('from stockAggregation '
+                      'within "2017-06-** **:**:**" per "seconds"')
+    rt.shutdown()
+    rows = sorted(tuple(e.data) for e in events)
+    assert rows == [
+        (1496289950000, "WSO2", 40, 10.0),
+        (1496289950000, "WSO2", 90, 120.0),
+    ]
+
+
+def test_minute_rollup_from_second_buckets():
+    """Duration cascade: the same events queried per 'minutes' roll up."""
+    rt = build(STOCK + AGG)
+    h = rt.get_input_handler("stockStream")
+    for row in SENDS5:
+        h.send(list(row))
+    events = rt.query('from stockAggregation '
+                      'within "2017-06-** **:**:**" per "minutes"')
+    rt.shutdown()
+    rows = sorted(tuple(e.data) for e in events)
+    # minute bucket 1496289900000: WSO2 avg 70 total 280, IBM avg 100
+    assert rows == [
+        (1496289900000, "IBM", 100.0, 200.0, 9600.0),
+        (1496289900000, "WSO2", 70.0, 280.0, 1600.0),
+    ]
+
+
+def test_within_explicit_range_filters_buckets():
+    rt = build(STOCK + AGG)
+    h = rt.get_input_handler("stockStream")
+    for row in SENDS5:
+        h.send(list(row))
+    events = rt.query(
+        'from stockAggregation within "2017-06-01 04:05:52", '
+        '"2017-06-01 04:05:54" per "seconds"')
+    rt.shutdown()
+    rows = sorted(tuple(e.data) for e in events)
+    assert rows == [(1496289952000, "WSO2", 80.0, 160.0, 1600.0)]
+
+
+def test_on_condition_with_per():
+    """Store query with `on` filter over the aggregation selection."""
+    rt = build(STOCK + AGG)
+    h = rt.get_input_handler("stockStream")
+    for row in SENDS5:
+        h.send(list(row))
+    events = rt.query('from stockAggregation on symbol == "IBM" '
+                      'within "2017-06-** **:**:**" per "seconds" '
+                      'select symbol, totalPrice')
+    rt.shutdown()
+    assert [tuple(e.data) for e in events] == [("IBM", 200.0)]
+
+
+def test_min_max_count_lanes():
+    rt = build(STOCK + """
+        define aggregation stockAggregation
+        from stockStream
+        select symbol, min(price) as lo, max(price) as hi, count() as n
+        group by symbol
+        aggregate by timestamp every sec ... min;
+    """)
+    h = rt.get_input_handler("stockStream")
+    h.send(["WSO2", 50.0, 60.0, 90, 6, 1496289950000])
+    h.send(["WSO2", 70.0, None, 40, 10, 1496289950100])
+    h.send(["WSO2", 20.0, None, 40, 10, 1496289950200])
+    events = rt.query('from stockAggregation '
+                      'within "2017-06-** **:**:**" per "seconds"')
+    rt.shutdown()
+    assert [tuple(e.data) for e in events] == \
+        [(1496289950000, "WSO2", 20.0, 70.0, 3)]
+
+
+def test_distinct_count_aggregation():
+    """DistinctCountAggregationTestCase: distinctCount over a duration
+    (host-only lane: falls back from the slab path)."""
+    rt = build(STOCK + """
+        define aggregation stockAggregation
+        from stockStream
+        select symbol, distinctCount(volume) as dv
+        group by symbol
+        aggregate by timestamp every sec ... min;
+    """)
+    h = rt.get_input_handler("stockStream")
+    h.send(["WSO2", 50.0, 60.0, 90, 6, 1496289950000])
+    h.send(["WSO2", 70.0, None, 90, 10, 1496289950100])
+    h.send(["WSO2", 10.0, None, 40, 10, 1496289950200])
+    events = rt.query('from stockAggregation '
+                      'within "2017-06-** **:**:**" per "seconds"')
+    rt.shutdown()
+    assert [tuple(e.data) for e in events] == \
+        [(1496289950000, "WSO2", 2)]
