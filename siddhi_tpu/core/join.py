@@ -83,7 +83,9 @@ class JoinSide:
                 self.window = create_window_processor(
                     h.name, h.params, app.app_ctx,
                     self.definition.attribute_names,
-                    lambda e: compiler.compile(e))
+                    lambda e: compiler.compile(e),
+                    namespace=h.namespace or "",
+                    extension_registry=app.extension_registry)
                 self.window.lock = runtime.qr.lock
                 self.window.next = self.collector
             elif isinstance(h, StreamFunctionHandler):

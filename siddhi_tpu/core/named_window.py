@@ -25,14 +25,17 @@ class _Publisher(Processor):
 
 
 class NamedWindow:
-    def __init__(self, definition: WindowDefinition, app_ctx, compile_expr):
+    def __init__(self, definition: WindowDefinition, app_ctx, compile_expr,
+                 extension_registry=None):
         self.definition = definition
         self.app_ctx = app_ctx
         self.lock = threading.RLock()
         name = definition.window_name or "length"
         self.processor = create_window_processor(
             name, definition.window_params, app_ctx,
-            definition.attribute_names, compile_expr)
+            definition.attribute_names, compile_expr,
+            namespace=definition.window_namespace or "",
+            extension_registry=extension_registry)
         self.processor.lock = self.lock
         self.processor.next = _Publisher(self)
         self.subscribers = []        # query receivers (receive_chunk)

@@ -187,7 +187,9 @@ class QueryRuntime:
             elif isinstance(h, WindowHandler):
                 wp = create_window_processor(
                     h.name, h.params, app.app_ctx, definition.attribute_names,
-                    lambda e: compiler.compile(e))
+                    lambda e: compiler.compile(e),
+                    namespace=h.namespace or "",
+                    extension_registry=app.extension_registry)
                 wp.lock = self.lock
                 self.windows.append(wp)
                 chain.append(wp)

@@ -178,7 +178,8 @@ class SiddhiAppRuntime:
             scope.add_primary(wid, None, wd)
             compiler = ExprCompiler(scope, np, self.app_ctx.script_functions,
                                     self.extension_registry)
-            nw = NamedWindow(wd, self.app_ctx, lambda e: compiler.compile(e))
+            nw = NamedWindow(wd, self.app_ctx, lambda e: compiler.compile(e),
+                             extension_registry=self.extension_registry)
             self.named_windows[wid] = nw
             self.snapshot_service.register(f"window:{wid}", nw)
         # 4. triggers

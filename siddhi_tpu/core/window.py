@@ -95,6 +95,81 @@ class WindowProcessor(Processor):
         self.buffer = _chunk_restore(state["buffer"], self.names)
 
 
+class GroupingWindowProcessor(WindowProcessor):
+    """Extension base: window state partitioned per group key (reference
+    query/processor/stream/window/GroupingWindowProcessor.java — the
+    `_groupingKey` SPI base its grouping window extensions subclass).
+
+    Subclasses declare `make_inner() -> WindowProcessor` (a fresh inner
+    window per key) and get one isolated inner instance per group-key
+    value; emissions from every inner flow to this processor's `next`."""
+
+    def __init__(self, app_ctx, names, key_expr: CompiledExpr):
+        super().__init__(app_ctx, names)
+        self.key_expr = key_expr
+        self._inners: Dict = {}
+
+    def make_inner(self) -> "WindowProcessor":
+        raise NotImplementedError
+
+    def _inner_for(self, key) -> "WindowProcessor":
+        w = self._inners.get(key)
+        if w is None:
+            w = self.make_inner()
+            w.lock = self.lock
+            w.next = _GroupForward(self)
+            self._inners[key] = w
+        return w
+
+    _NAN_KEY = "__nan__"
+
+    def on_data(self, chunk: EventChunk):
+        n = len(chunk)
+        ctx = EvalCtx(dict(chunk.columns), chunk.timestamps, n)
+        keys = np.asarray(self.key_expr.fn(ctx))
+        if keys.ndim == 0:
+            keys = np.full(n, keys)
+        # NaN != NaN would both defeat the dedup (a leaked inner per
+        # occurrence) and zero the mask (events silently dropped) — fold
+        # every NaN into one sentinel bucket
+        key_list = [self._NAN_KEY if k != k else k for k in keys.tolist()]
+        for key in dict.fromkeys(key_list):          # first-seen order
+            m = np.asarray([k == key for k in key_list])
+            self._inner_for(key).process(chunk.mask(m))
+
+    def on_timer_event(self, ts: int):
+        for w in self._inners.values():
+            w.on_timer_event(ts)
+
+    def find_chunk(self) -> Optional[EventChunk]:
+        parts = [w.find_chunk() for w in self._inners.values()]
+        parts = [p for p in parts if p is not None and not p.is_empty]
+        return EventChunk.concat(parts) if parts else None
+
+    def current_state(self):
+        return {"keys": list(self._inners),
+                "inners": [w.current_state()
+                           for w in self._inners.values()]}
+
+    def restore_state(self, state):
+        self._inners = {}
+        for key, s in zip(state["keys"], state["inners"]):
+            self._inner_for(key).restore_state(s)
+
+
+class _GroupForward(Processor):
+    """Routes a per-key inner window's emissions to the group processor's
+    downstream."""
+
+    def __init__(self, owner: GroupingWindowProcessor):
+        super().__init__()
+        self.owner = owner
+
+    def process(self, chunk: EventChunk):
+        if self.owner.next is not None:
+            self.owner.next.process(chunk)
+
+
 def _chunk_state(c: EventChunk) -> dict:
     return {"names": c.names,
             "timestamps": c.timestamps.tolist(),
@@ -888,10 +963,38 @@ class CronWindowProcessor(WindowProcessor):
 # ===================================================================== factory
 
 def create_window_processor(name: str, params: List, app_ctx, names,
-                            compile_expr) -> WindowProcessor:
+                            compile_expr, namespace: str = "",
+                            extension_registry=None) -> WindowProcessor:
     """Factory mapping window names to processors.  `params` are query-api
-    Expressions; `compile_expr` compiles one against the input scope."""
+    Expressions; `compile_expr` compiles one against the input scope.
+    Namespaced (or unknown) names resolve through the extension registry
+    (reference: SiddhiExtensionLoader window holders) — the registered
+    class either subclasses WindowProcessor (instantiated as
+    cls(app_ctx, names, params, compile_expr)) or provides a
+    create(app_ctx, names, params, compile_expr) factory."""
     from ..query_api.expression import Constant, TimeConstant, Variable
+
+    def _extension():
+        if extension_registry is None:
+            return None
+        ext = extension_registry.find_window(namespace or "", name)
+        if ext is None:
+            return None
+        # the registry is kind-unsegregated: only window-shaped classes
+        # qualify, so a colliding function/source name falls through to
+        # the proper "Unknown window type" error
+        if hasattr(ext, "create"):
+            return ext.create(app_ctx, names, params, compile_expr)
+        if isinstance(ext, type) and issubclass(ext, WindowProcessor):
+            return ext(app_ctx, names, params, compile_expr)
+        return None
+
+    if namespace:
+        wp = _extension()
+        if wp is None:
+            raise SiddhiAppCreationError(
+                f"Unknown window type '{namespace}:{name}'")
+        return wp
 
     def const(i, default=None):
         if i >= len(params):
@@ -968,4 +1071,7 @@ def create_window_processor(name: str, params: List, app_ctx, names,
         return DelayWindowProcessor(app_ctx, names, time_ms(0))
     if low == "cron":
         return CronWindowProcessor(app_ctx, names, str(const(0)))
+    wp = _extension()
+    if wp is not None:
+        return wp
     raise SiddhiAppCreationError(f"Unknown window type '{name}'")

@@ -1,0 +1,106 @@
+"""Small reference conformance suites: IsNullTestCase,
+BooleanCompareTestCase, StringCompareTestCase, PassThroughTestCase
+(siddhi-core/src/test/java/io/siddhi/core/query/)."""
+from ref_harness import run_query
+
+S = "define stream cseEventStream (symbol string, price float, volume long);\n"
+
+
+def test_is_null_filter_matches_null_payload():
+    """IsNullTestCase.testIsNull1: only the null-symbol event passes."""
+    run_query(S + """@info(name='query1')
+        from cseEventStream[symbol is null]
+        select price insert into outputStream;""",
+        [("cseEventStream", ["IBM", 700.0, 100]),
+         ("cseEventStream", [None, 60.5, 200]),
+         ("cseEventStream", ["WSO2", 60.5, 200])],
+        [(60.5,)])
+
+
+def test_not_is_null_filter():
+    run_query(S + """@info(name='query1')
+        from cseEventStream[not (symbol is null)]
+        select symbol insert into outputStream;""",
+        [("cseEventStream", ["IBM", 700.0, 100]),
+         ("cseEventStream", [None, 60.5, 200]),
+         ("cseEventStream", ["WSO2", 60.5, 200])],
+        [("IBM",), ("WSO2",)])
+
+
+def test_is_null_in_select():
+    run_query(S + """@info(name='query1')
+        from cseEventStream
+        select symbol is null as noSym insert into outputStream;""",
+        [("cseEventStream", ["IBM", 1.0, 1]),
+         ("cseEventStream", [None, 2.0, 2])],
+        [(False,), (True,)])
+
+
+# ------------------------------------------------- BooleanCompareTestCase
+
+BOOL_S = "define stream S (symbol string, ok bool, price float);\n"
+
+
+def test_bool_compare_true_literal():
+    run_query(BOOL_S + """@info(name='query1')
+        from S[ok == true] select symbol insert into Out;""",
+        [("S", ["A", True, 1.0]), ("S", ["B", False, 2.0]),
+         ("S", ["C", True, 3.0])],
+        [("A",), ("C",)])
+
+
+def test_bool_compare_false_literal():
+    run_query(BOOL_S + """@info(name='query1')
+        from S[ok == false] select symbol insert into Out;""",
+        [("S", ["A", True, 1.0]), ("S", ["B", False, 2.0])],
+        [("B",)])
+
+
+def test_bool_not_equal():
+    run_query(BOOL_S + """@info(name='query1')
+        from S[ok != true] select symbol insert into Out;""",
+        [("S", ["A", True, 1.0]), ("S", ["B", False, 2.0])],
+        [("B",)])
+
+
+# ------------------------------------------------- StringCompareTestCase
+
+def test_string_equal_and_not_equal():
+    run_query(S + """@info(name='query1')
+        from cseEventStream[symbol == 'WSO2'] select volume
+        insert into outputStream;""",
+        [("cseEventStream", ["IBM", 1.0, 10]),
+         ("cseEventStream", ["WSO2", 2.0, 20])],
+        [(20,)])
+    run_query(S + """@info(name='query1')
+        from cseEventStream[symbol != 'WSO2'] select volume
+        insert into outputStream;""",
+        [("cseEventStream", ["IBM", 1.0, 10]),
+         ("cseEventStream", ["WSO2", 2.0, 20])],
+        [(10,)])
+
+
+def test_string_compare_both_sides_variables():
+    run_query("""define stream S (a string, b string);
+        @info(name='query1')
+        from S[a == b] select a insert into Out;""",
+        [("S", ["x", "x"]), ("S", ["x", "y"]), ("S", ["z", "z"])],
+        [("x",), ("z",)])
+
+
+# ------------------------------------------------- PassThroughTestCase
+
+def test_passthrough_select_star():
+    run_query(S + """@info(name='query1')
+        from cseEventStream select * insert into outputStream;""",
+        [("cseEventStream", ["IBM", 700.0, 100]),
+         ("cseEventStream", ["WSO2", 60.5, 200])],
+        [("IBM", 700.0, 100), ("WSO2", 60.5, 200)])
+
+
+def test_passthrough_projection_reorder():
+    run_query(S + """@info(name='query1')
+        from cseEventStream select volume, symbol
+        insert into outputStream;""",
+        [("cseEventStream", ["IBM", 700.0, 100])],
+        [(100, "IBM")])
