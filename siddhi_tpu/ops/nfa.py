@@ -564,15 +564,19 @@ def build_bank_step(spec: NfaSpec, ring: int = 0):
     def per_partition(carry_p, events_p, prm):
         def step(c, ev):
             inner, acc, lmt, lmk = c
-            inner2, (mm, _mcaps, mts, _me, _ms) = _one_partition_step(
+            inner2, (mm, *_rest) = _one_partition_step(
                 spec, inner, {**ev, **prm})
             # accumulate in-carry: avoids a [N, P, T] stacked ys buffer
             acc2 = acc + jnp.sum(mm.astype(jnp.int32))
             if ring:
+                # the EVENT's ts, not the per-slot match ts (m_ts): reading
+                # m_ts forces the per-unit emission-bookkeeping chains XLA
+                # otherwise dead-code-eliminates — 5.5x slower measured.
+                # They only differ for absent-deadline completions, whose
+                # payload ts then reads as the triggering event's time.
                 hit = jnp.any(mm)
-                k = jnp.argmax(mm)
-                lmt = jnp.where(hit, mts[k], lmt)
-                lmk = jnp.where(hit, k.astype(jnp.int32), lmk)
+                lmt = jnp.where(hit, ev["__ts"], lmt)
+                lmk = jnp.where(hit, jnp.argmax(mm).astype(jnp.int32), lmk)
             return (inner2, acc2, lmt, lmk), None
         init = (carry_p, jnp.int32(0), jnp.int32(0), jnp.int32(0))
         (c2, acc, lmt, lmk), _ = jax.lax.scan(step, init, events_p)
