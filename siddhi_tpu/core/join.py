@@ -196,6 +196,10 @@ class JoinRuntime:
                 cc = table.compile_condition(jis.on, sd, factory)
                 if cc.pk_probe is not None or cc.index_probe is not None:
                     self._table_conds[tside.side] = cc
+                elif getattr(cc, "root", None) is not None:
+                    # record table (core/record_table.py): the condition
+                    # translated to the store-neutral IR — probe natively
+                    self._table_conds[tside.side] = cc
             except Exception:  # noqa: BLE001 — any shape issue → cross path
                 pass
 
@@ -260,14 +264,26 @@ class JoinRuntime:
             buf = self.agg_runtime.find_chunk(self.jis.within, self.jis.per,
                                               data)
         elif cc is not None:
-            # indexed table probe per arriving row (hash lookup +
-            # residual); snapshot and probe under ONE lock acquisition so
-            # the probed row indices are valid for the snapshot
+            from .record_table import AbstractRecordTable
             table = self.qr.app_runtime.table_of(opposite.stream_id)
-            with table.lock:
-                buf = table.all_rows_chunk()
-                rows = [table._match_rows(cc, data, i)
-                        for i in range(n)] if len(buf) else []
+            if not isinstance(table, AbstractRecordTable):
+                # indexed table probe per arriving row (hash lookup +
+                # residual); snapshot and probe under ONE lock acquisition
+                # so the probed row indices are valid for the snapshot
+                with table.lock:
+                    buf = table.all_rows_chunk()
+                    rows = [table._match_rows(cc, data, i)
+                            for i in range(n)] if len(buf) else []
+            else:
+                # record table: condition pushdown, one native store probe
+                # per arriving row (≙ AbstractRecordTable.find with the
+                # compiled condition's per-probe parameters)
+                chunks = [table.find(cc, data, i) for i in range(n)]
+                buf = EventChunk.concat(chunks)
+                rows, off = [], 0
+                for c in chunks:
+                    rows.append(np.arange(off, off + len(c)))
+                    off += len(c)
         else:
             buf = opposite.buffer_chunk()
         m = 0 if buf is None or buf.is_empty else len(buf)

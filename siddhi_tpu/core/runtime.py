@@ -170,7 +170,8 @@ class SiddhiAppRuntime:
                     store_ann.get("type", ""))
                 if store_cls is not None:
                     table = store_cls(td, store_ann)
-            self.tables[tid] = table or InMemoryTable(td)
+            # `is None`, not truthiness — an empty store has __len__() == 0
+            self.tables[tid] = InMemoryTable(td) if table is None else table
             self.snapshot_service.register(f"table:{tid}", self.tables[tid])
         # 3. named windows
         for wid, wd in app.window_definitions.items():

@@ -56,6 +56,11 @@ class StoreQueryRuntime:
             definition = src.definition
             cond = src.compile_condition(sq.input_store.on, None,
                                          self._factory())
+            if sq.type == StoreQueryType.FIND and \
+                    getattr(src, "supports_query", False):
+                pushed = self._try_query_pushdown(src, cond)
+                if pushed is not None:
+                    return pushed
             chunk = src.find(cond)
         elif kind == "window":
             definition = src.definition
@@ -88,6 +93,22 @@ class StoreQueryRuntime:
             return self._insert()
         return None
 
+    def _try_query_pushdown(self, table, cond) -> Optional[List[Event]]:
+        """Selection pushdown to a queryable record table (reference:
+        AbstractQueryableRecordTable.query + StoreQueryParser's
+        CompiledSelection path).  Returns None if the selector doesn't
+        translate — the caller falls back to host-side selection."""
+        from ..utils.errors import SiddhiAppCreationError
+        try:
+            selection = table.compile_selection(self.sq.selector,
+                                                self._factory())
+        except SiddhiAppCreationError:
+            return None
+        rows = table.query(cond, selection)
+        names = [n for n, _ in selection.select]
+        now = self.app.app_ctx.current_time()
+        return [Event(now, [r.get(n) for n in names]) for r in rows]
+
     def _apply_on(self, chunk: EventChunk, definition) -> EventChunk:
         on = self.sq.input_store.on
         if on is None or chunk.is_empty:
@@ -110,7 +131,13 @@ class StoreQueryRuntime:
                             self._factory(), output_id="store")
         collector = _Collector()
         sel.next = collector
-        sel.process(chunk.with_types(CURRENT))
+        # a pull query sees the table as one closed batch: group-by
+        # aggregates summarize to one row per group (reference
+        # SelectStoreQueryRuntime semantics — and what a queryable record
+        # store's native GROUP BY pushdown returns)
+        snapshot = chunk.with_types(CURRENT)
+        snapshot.is_batch = True
+        sel.process(snapshot)
         if not collector.chunks:
             return []
         return EventChunk.concat(collector.chunks).to_events()

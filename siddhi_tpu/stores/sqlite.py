@@ -1,0 +1,196 @@
+"""SQLite-backed queryable record table.
+
+The proof-of-the-SPI store (reference analogue: the siddhi-store-rdbms
+extension implementing table/record/AbstractQueryableRecordTable.java):
+compiled conditions and selections arrive as store-neutral RecordExpr trees
+(core/record_table.py) and are rendered here into parameterised SQL — the
+store executes probes natively instead of shipping rows to the engine.
+
+Usage::
+
+    @Store(type='sqlite', database=':memory:', table='StockTable')
+    define table StockTable (symbol string, price float, volume long);
+
+The last executed SQL statements are kept in `self.sql_log` so tests (and
+curious users) can verify pushdown actually happened.
+"""
+from __future__ import annotations
+
+import sqlite3
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from ..core.record_table import (AbstractQueryableRecordTable, Agg, Arith,
+                                 BoolAnd, BoolNot, BoolOr, Cmp, Col, Const,
+                                 NullCheck, Param, RecordExpr,
+                                 RecordSelection)
+from ..query_api.definition import AttrType
+from ..utils.errors import SiddhiAppCreationError
+from ..utils.extension import extension
+
+_SQL_TYPE = {
+    AttrType.INT: "INTEGER", AttrType.LONG: "INTEGER",
+    AttrType.FLOAT: "REAL", AttrType.DOUBLE: "REAL",
+    AttrType.BOOL: "INTEGER", AttrType.STRING: "TEXT",
+}
+
+_CMP_SQL = {"==": "=", "!=": "<>", "<": "<", "<=": "<=", ">": ">", ">=": ">="}
+
+
+def _render(e: Optional[RecordExpr]) -> str:
+    """RecordExpr → SQL with :name parameter placeholders."""
+    if e is None:
+        return "1"
+    if isinstance(e, Col):
+        return f'"{e.name}"'
+    if isinstance(e, Const):
+        v = e.value
+        if isinstance(v, bool):
+            return "1" if v else "0"
+        if isinstance(v, str):
+            return "'" + v.replace("'", "''") + "'"
+        return repr(v)
+    if isinstance(e, Param):
+        return f":{e.name}"
+    if isinstance(e, Cmp):
+        return f"({_render(e.left)} {_CMP_SQL[e.op]} {_render(e.right)})"
+    if isinstance(e, BoolAnd):
+        return f"({_render(e.left)} AND {_render(e.right)})"
+    if isinstance(e, BoolOr):
+        return f"({_render(e.left)} OR {_render(e.right)})"
+    if isinstance(e, BoolNot):
+        return f"(NOT {_render(e.expr)})"
+    if isinstance(e, NullCheck):
+        return f"({_render(e.expr)} IS NULL)"
+    if isinstance(e, Arith):
+        return f"({_render(e.left)} {e.op} {_render(e.right)})"
+    if isinstance(e, Agg):
+        arg = "*" if e.arg is None else _render(e.arg)
+        return f"{e.kind.upper()}({arg})"
+    raise SiddhiAppCreationError(f"sqlite store: unrenderable {type(e)}")
+
+
+def _clean_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: (int(v) if isinstance(v, bool) else v)
+            for k, v in params.items()}
+
+
+@extension(namespace="store", name="sqlite",
+           description="SQLite-backed queryable record table with full "
+                       "condition and selection pushdown",
+           parameters=[("database", "string",
+                        "sqlite database path (default ':memory:')"),
+                       ("table", "string",
+                        "backing table name (default: the definition id)")])
+class SQLiteStore(AbstractQueryableRecordTable):
+
+    def init(self, definition, store_annotation) -> None:
+        db = ":memory:"
+        table = definition.id
+        if store_annotation is not None:
+            db = store_annotation.get("database", db) or db
+            table = store_annotation.get("table", table) or table
+        self._table = table
+        self._bools = [a.name for a in definition.attributes
+                       if a.type == AttrType.BOOL]
+        self.sql_log: List[str] = []
+        cols = []
+        for a in definition.attributes:
+            t = _SQL_TYPE.get(a.type)
+            if t is None:
+                raise SiddhiAppCreationError(
+                    f"sqlite store: unsupported attribute type {a.type} "
+                    f"for '{a.name}'")
+            cols.append(f'"{a.name}" {t}')
+        # engine probes may come from any junction/worker thread; all calls
+        # are serialized by AbstractRecordTable.lock
+        self._conn = sqlite3.connect(db, check_same_thread=False)
+        self._conn.execute(
+            f'CREATE TABLE IF NOT EXISTS "{table}" ({", ".join(cols)})')
+        self._conn.commit()
+
+    def _exec(self, sql: str, params=None):
+        self.sql_log.append(sql)
+        return self._conn.execute(sql, _clean_params(params or {}))
+
+    def _row_dict(self, names, row) -> Dict[str, Any]:
+        d = dict(zip(names, row))
+        for b in self._bools:
+            if b in d and d[b] is not None:
+                d[b] = bool(d[b])
+        return d
+
+    # ------------------------------------------------------------- SPI
+
+    def add(self, records: List[Dict[str, Any]]) -> None:
+        if not records:
+            return
+        cols = self.names
+        sql = (f'INSERT INTO "{self._table}" '
+               f'({", ".join(chr(34) + c + chr(34) for c in cols)}) '
+               f'VALUES ({", ".join(":" + c for c in cols)})')
+        self.sql_log.append(sql)
+        self._conn.executemany(
+            sql, [_clean_params({c: r.get(c) for c in cols})
+                  for r in records])
+        self._conn.commit()
+
+    def find_records(self, condition, params) -> Iterable[Dict[str, Any]]:
+        cur = self._exec(
+            f'SELECT {", ".join(chr(34) + c + chr(34) for c in self.names)} '
+            f'FROM "{self._table}" WHERE {_render(condition)}', params)
+        for row in cur.fetchall():
+            yield self._row_dict(self.names, row)
+
+    def update_records(self, condition, param_rows, assignments) -> None:
+        sets = ", ".join(f'"{col}" = {_render(e)}' for col, e in assignments)
+        sql = (f'UPDATE "{self._table}" SET {sets} '
+               f'WHERE {_render(condition)}')
+        for pr in param_rows:
+            self._exec(sql, pr)
+        self._conn.commit()
+
+    def delete_records(self, condition, param_rows) -> None:
+        sql = f'DELETE FROM "{self._table}" WHERE {_render(condition)}'
+        for pr in (param_rows or [{}]):
+            self._exec(sql, pr)
+        self._conn.commit()
+
+    def contains_records(self, condition, params) -> bool:
+        cur = self._exec(
+            f'SELECT EXISTS(SELECT 1 FROM "{self._table}" '
+            f'WHERE {_render(condition)})', params)
+        return bool(cur.fetchone()[0])
+
+    # --------------------------------------------------- selection pushdown
+
+    def query_records(self, condition, params,
+                      selection: RecordSelection) -> Iterable[Dict[str, Any]]:
+        names = [n for n, _ in selection.select]
+        cols = ", ".join(f'{_render(e)} AS "{n}"'
+                         for n, e in selection.select)
+        sql = (f'SELECT {cols} FROM "{self._table}" '
+               f'WHERE {_render(condition)}')
+        if selection.group_by:
+            sql += " GROUP BY " + ", ".join(
+                f'"{g}"' for g in selection.group_by)
+        if selection.having is not None:
+            sql += f" HAVING {_render(selection.having)}"
+        if selection.order_by:
+            sql += " ORDER BY " + ", ".join(
+                f'"{a}" {"ASC" if asc else "DESC"}'
+                for a, asc in selection.order_by)
+        if selection.limit is not None or selection.offset is not None:
+            sql += f" LIMIT {selection.limit if selection.limit is not None else -1}"
+            if selection.offset is not None:
+                sql += f" OFFSET {selection.offset}"
+        cur = self._exec(sql, params)
+        # outputs that are plain bool-column passthroughs keep host parity
+        # (sqlite stores BOOL as 0/1)
+        bool_outs = [n for n, e in selection.select
+                     if isinstance(e, Col) and e.name in self._bools]
+        for row in cur.fetchall():
+            d = dict(zip(names, row))
+            for b in bool_outs:
+                if d[b] is not None:
+                    d[b] = bool(d[b])
+            yield d

@@ -103,6 +103,13 @@ class FunctionExtension:
                             if compiled_args else cls.return_type)
 
 
+#: lazily-imported built-in extensions shipped with the framework
+#: (≙ the reference's bundled extension jars resolved by SiddhiClassLoader)
+_BUILTIN_EXTENSIONS: Dict[str, str] = {
+    "store:sqlite": "siddhi_tpu.stores.sqlite:SQLiteStore",
+}
+
+
 class ExtensionRegistry:
     def __init__(self):
         self._by_name: Dict[str, Any] = {}
@@ -135,7 +142,13 @@ class ExtensionRegistry:
 
     def _find(self, ns: str, name: str, kind) -> Optional[Any]:
         self._load_entry_points()
-        impl = self._by_name.get(self._key(ns, name))
+        key = self._key(ns, name)
+        impl = self._by_name.get(key)
+        if impl is None and key in _BUILTIN_EXTENSIONS:
+            mod, _, attr = _BUILTIN_EXTENSIONS[key].partition(":")
+            import importlib
+            impl = getattr(importlib.import_module(mod), attr)
+            self._by_name[key] = impl
         if impl is None:
             return None
         if kind is not None and isinstance(impl, type) and \

@@ -1,0 +1,415 @@
+"""Record-table SPI tests — external stores receive compiled conditions
+(store-neutral RecordExpr trees) and selection pushdown
+(reference: table/record/AbstractRecordTable.java,
+AbstractQueryableRecordTable.java; rendered to SQL by stores/sqlite.py)."""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.record_table import (AbstractRecordTable, Cmp, Col,
+                                          Param)
+
+APP_HEAD = """
+define stream StockStream (symbol string, price float, volume long);
+define stream CheckStockStream (symbol string, volume long);
+define stream UpdateStockStream (symbol string, price float, volume long);
+define stream DeleteStockStream (symbol string);
+"""
+
+
+class DictStore(AbstractRecordTable):
+    """Minimal list-of-dicts store with a call log, used to assert what the
+    engine actually pushes through the SPI."""
+
+    instances = []
+
+    def init(self, definition, store_annotation):
+        self.rows = []
+        self.calls = []
+        DictStore.instances.append(self)
+
+    def _eval(self, e, row, params):
+        from siddhi_tpu.core.record_table import (Agg, Arith, BoolAnd,
+                                                  BoolNot, BoolOr, Cmp, Col,
+                                                  Const, NullCheck, Param)
+        if e is None:
+            return True
+        if isinstance(e, Col):
+            return row[e.name]
+        if isinstance(e, Const):
+            return e.value
+        if isinstance(e, Param):
+            return params[e.name]
+        if isinstance(e, Cmp):
+            import operator
+            l, r = self._eval(e.left, row, params), \
+                self._eval(e.right, row, params)
+            return {"==": operator.eq, "!=": operator.ne, "<": operator.lt,
+                    "<=": operator.le, ">": operator.gt,
+                    ">=": operator.ge}[e.op](l, r)
+        if isinstance(e, BoolAnd):
+            return self._eval(e.left, row, params) and \
+                self._eval(e.right, row, params)
+        if isinstance(e, BoolOr):
+            return self._eval(e.left, row, params) or \
+                self._eval(e.right, row, params)
+        if isinstance(e, BoolNot):
+            return not self._eval(e.expr, row, params)
+        if isinstance(e, NullCheck):
+            return self._eval(e.expr, row, params) is None
+        if isinstance(e, Arith):
+            import operator
+            l, r = self._eval(e.left, row, params), \
+                self._eval(e.right, row, params)
+            return {"+": operator.add, "-": operator.sub, "*": operator.mul,
+                    "/": operator.truediv, "%": operator.mod}[e.op](l, r)
+        raise AssertionError(f"unexpected node {e}")
+
+    def add(self, records):
+        self.calls.append(("add", len(records)))
+        self.rows.extend(dict(r) for r in records)
+
+    def find_records(self, condition, params):
+        self.calls.append(("find", condition, dict(params)))
+        return [r for r in self.rows if self._eval(condition, r, params)]
+
+    def update_records(self, condition, param_rows, assignments):
+        self.calls.append(("update", condition))
+        for pr in param_rows:
+            for r in self.rows:
+                if self._eval(condition, r, pr):
+                    for col, e in assignments:
+                        r[col] = self._eval(e, r, pr)
+
+    def delete_records(self, condition, param_rows):
+        self.calls.append(("delete", condition))
+        for pr in param_rows:
+            self.rows = [r for r in self.rows
+                         if not self._eval(condition, r, pr)]
+
+
+@pytest.fixture(autouse=True)
+def _reset_dictstore():
+    DictStore.instances = []
+    yield
+    DictStore.instances = []
+
+
+def _manager_with_dictstore():
+    m = SiddhiManager()
+    m.set_extension("store:dict", DictStore)
+    return m
+
+
+def _run(m, app, sends, out_stream="OutStream"):
+    rt = m.create_siddhi_app_runtime(app)
+    got = []
+    if out_stream:
+        rt.add_callback(out_stream, StreamCallback(
+            lambda evs: got.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    ts = 1_000_000
+    for sid, row in sends:
+        ts += 100
+        rt.get_input_handler(sid).send(row, timestamp=ts)
+    rt.shutdown()
+    return got
+
+
+FILL = [("StockStream", ["WSO2", 55.6, 100]),
+        ("StockStream", ["IBM", 75.6, 10])]
+
+
+def test_record_table_insert_and_join_pushes_condition():
+    m = _manager_with_dictstore()
+    got = _run(m, APP_HEAD + """
+        @Store(type='dict')
+        define table StockTable (symbol string, price float, volume long);
+        from StockStream insert into StockTable;
+        @info(name='q')
+        from CheckStockStream join StockTable
+            on CheckStockStream.symbol == StockTable.symbol
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;""",
+        FILL + [("CheckStockStream", ["IBM", 0]),
+                ("CheckStockStream", ["WSO2", 0])])
+    assert got == [("IBM", 10), ("WSO2", 100)]
+    store = DictStore.instances[0]
+    assert ("add", 1) in store.calls
+    # the join probed through the SPI — a compiled Cmp(Col == Param)
+    finds = [c for c in store.calls if c[0] == "find"]
+    assert any(isinstance(c[1], Cmp) and isinstance(c[1].left, Col)
+               or isinstance(c[1], Cmp) and isinstance(c[1].right, Col)
+               for c in finds if c[1] is not None), finds
+
+
+def test_record_table_update_and_delete():
+    m = _manager_with_dictstore()
+    _run(m, APP_HEAD + """
+        @Store(type='dict')
+        define table StockTable (symbol string, price float, volume long);
+        from StockStream insert into StockTable;
+        from UpdateStockStream update StockTable
+            set StockTable.volume = UpdateStockStream.volume
+            on StockTable.symbol == UpdateStockStream.symbol;
+        from DeleteStockStream delete StockTable
+            on StockTable.symbol == DeleteStockStream.symbol;""",
+        FILL + [("UpdateStockStream", ["IBM", 75.6, 99]),
+                ("DeleteStockStream", ["WSO2"])], out_stream=None)
+    store = DictStore.instances[0]
+    assert store.rows == [{"symbol": "IBM", "price": pytest.approx(75.6),
+                           "volume": 99}]
+
+
+def test_record_table_update_or_insert():
+    m = _manager_with_dictstore()
+    _run(m, APP_HEAD + """
+        @Store(type='dict')
+        define table StockTable (symbol string, price float, volume long);
+        from UpdateStockStream update or insert into StockTable
+            set StockTable.volume = UpdateStockStream.volume
+            on StockTable.symbol == UpdateStockStream.symbol;""",
+        [("UpdateStockStream", ["IBM", 75.6, 10]),
+         ("UpdateStockStream", ["IBM", 75.6, 30]),
+         ("UpdateStockStream", ["WSO2", 55.6, 5])], out_stream=None)
+    store = DictStore.instances[0]
+    by_sym = {r["symbol"]: r["volume"] for r in store.rows}
+    assert by_sym == {"IBM": 30, "WSO2": 5}
+
+
+def test_record_table_in_membership():
+    m = _manager_with_dictstore()
+    got = _run(m, APP_HEAD + """
+        @Store(type='dict') @PrimaryKey('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        from StockStream insert into StockTable;
+        @info(name='q')
+        from CheckStockStream[CheckStockStream.symbol in StockTable]
+        select symbol, volume insert into OutStream;""",
+        FILL + [("CheckStockStream", ["IBM", 1]),
+                ("CheckStockStream", ["FB", 2])])
+    assert got == [("IBM", 1)]
+
+
+def test_record_table_store_query_find():
+    m = _manager_with_dictstore()
+    rt = m.create_siddhi_app_runtime(APP_HEAD + """
+        @Store(type='dict')
+        define table StockTable (symbol string, price float, volume long);
+        from StockStream insert into StockTable;""")
+    rt.start()
+    h = rt.get_input_handler("StockStream")
+    for _, row in FILL:
+        h.send(row)
+    events = rt.query("from StockTable on volume < 50 "
+                      "select symbol, volume")
+    assert [tuple(e.data) for e in events] == [("IBM", 10)]
+    rt.shutdown()
+
+
+# ---------------------------------------------------------------- sqlite
+
+def _sqlite_table_of(rt):
+    return rt.tables["StockTable"]
+
+
+def test_sqlite_store_end_to_end():
+    m = SiddhiManager()
+    got = _run(m, APP_HEAD + """
+        @Store(type='sqlite')
+        define table StockTable (symbol string, price float, volume long);
+        from StockStream insert into StockTable;
+        from UpdateStockStream update StockTable
+            set StockTable.volume = UpdateStockStream.volume,
+                StockTable.price = StockTable.price + 1.0
+            on StockTable.symbol == UpdateStockStream.symbol;
+        @info(name='q')
+        from CheckStockStream join StockTable
+            on CheckStockStream.symbol == StockTable.symbol
+               and StockTable.volume > CheckStockStream.volume
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;""",
+        FILL + [("UpdateStockStream", ["IBM", 0.0, 500]),
+                ("CheckStockStream", ["IBM", 400]),
+                ("CheckStockStream", ["WSO2", 400])])
+    assert got == [("IBM", 500)]
+
+
+def test_sqlite_store_query_selection_pushdown():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, price float, volume long);
+        @Store(type='sqlite')
+        define table StockTable (symbol string, price float, volume long);
+        from S insert into StockTable;""")
+    rt.start()
+    h = rt.get_input_handler("S")
+    for row in (["IBM", 10.0, 5], ["IBM", 20.0, 7], ["WSO2", 30.0, 2],
+                ["WSO2", 40.0, 1], ["MSFT", 5.0, 9]):
+        h.send(row)
+    events = rt.query(
+        "from StockTable select symbol, sum(volume) as total "
+        "group by symbol order by total desc limit 2")
+    assert [tuple(e.data) for e in events] == [("IBM", 12), ("MSFT", 9)]
+    table = _sqlite_table_of(rt)
+    assert any("GROUP BY" in s and "ORDER BY" in s and "LIMIT" in s
+               for s in table.sql_log), table.sql_log
+    rt.shutdown()
+
+
+def test_sqlite_store_query_on_condition_pushdown():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, price float, volume long);
+        @Store(type='sqlite')
+        define table StockTable (symbol string, price float, volume long);
+        from S insert into StockTable;""")
+    rt.start()
+    h = rt.get_input_handler("S")
+    for row in (["IBM", 10.0, 5], ["WSO2", 30.0, 2]):
+        h.send(row)
+    events = rt.query("from StockTable on volume >= 5 "
+                      "select symbol, volume")
+    assert [tuple(e.data) for e in events] == [("IBM", 5)]
+    table = _sqlite_table_of(rt)
+    assert any("WHERE" in s and "volume" in s for s in table.sql_log)
+    rt.shutdown()
+
+
+def test_sqlite_having_alias_shadows_column():
+    """HAVING reads the output row (host QuerySelector semantics) even when
+    a select rename shadows a table column of the same name."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, price float);
+        @Store(type='sqlite')
+        define table T (symbol string, price float);
+        from S insert into T;""")
+    rt.start()
+    h = rt.get_input_handler("S")
+    for row in (["IBM", 10.0], ["IBM", 200.0], ["W", 30.0]):
+        h.send(row)
+    events = rt.query("from T select symbol, avg(price) as price "
+                      "group by symbol having price > 50")
+    assert [tuple(e.data) for e in events] == [("IBM", 105.0)]
+    rt.shutdown()
+
+
+def test_sqlite_empty_table_ungrouped_aggregate_matches_host():
+    """SUM over an empty store must return no rows, like the host path —
+    not SQL's single NULL row."""
+    for store_ann in ("@Store(type='sqlite')", ""):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(f"""
+            define stream S (symbol string, volume long);
+            {store_ann}
+            define table T (symbol string, volume long);
+            from S insert into T;""")
+        rt.start()
+        events = rt.query("from T select sum(volume) as total")
+        assert [tuple(e.data) for e in events] == [], store_ann or "host"
+        rt.shutdown()
+
+
+def test_record_table_batched_update_single_spi_call():
+    """A multi-event update batch arrives as ONE update_records call."""
+    m = _manager_with_dictstore()
+    rt = m.create_siddhi_app_runtime(APP_HEAD + """
+        @Store(type='dict')
+        define table StockTable (symbol string, price float, volume long);
+        from StockStream insert into StockTable;
+        from UpdateStockStream update StockTable
+            set StockTable.volume = UpdateStockStream.volume
+            on StockTable.symbol == UpdateStockStream.symbol;""")
+    rt.start()
+    for _, row in FILL:
+        rt.get_input_handler("StockStream").send(row)
+    rt.get_input_handler("UpdateStockStream").send_batch(
+        {"symbol": np.asarray(["IBM", "WSO2"], object),
+         "price": np.asarray([1.0, 2.0], np.float32),
+         "volume": np.asarray([7, 8], np.int64)})
+    rt.shutdown()
+    store = DictStore.instances[0]
+    assert [c for c in store.calls if c[0] == "update"] == \
+        [("update", store.calls[-1][1])]       # exactly one update call
+    assert {r["symbol"]: r["volume"] for r in store.rows} == \
+        {"IBM": 7, "WSO2": 8}
+
+
+def test_record_table_update_without_set_overwrites_same_named():
+    """`update T on ...` with no SET clause copies same-named stream columns
+    (InMemoryTable._apply_set parity)."""
+    for ann in ("@Store(type='sqlite')", ""):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(APP_HEAD + f"""
+            {ann}
+            define table StockTable (symbol string, price float, volume long);
+            from StockStream insert into StockTable;
+            from UpdateStockStream update StockTable
+                on StockTable.symbol == UpdateStockStream.symbol;""")
+        rt.start()
+        for _, row in FILL:
+            rt.get_input_handler("StockStream").send(row)
+        rt.get_input_handler("UpdateStockStream").send(["IBM", 99.0, 777])
+        events = rt.query("from StockTable on symbol == 'IBM' "
+                          "select symbol, volume")
+        assert [tuple(e.data) for e in events] == [("IBM", 777)], ann
+        rt.shutdown()
+
+
+def test_grouped_store_query_parity_host_vs_pushdown():
+    """Grouped aggregates in a pull query summarize to one row per group on
+    BOTH paths — the host selector must not emit running per-row rows."""
+    results = {}
+    for ann in ("@Store(type='sqlite')", ""):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(f"""
+            define stream S (symbol string, volume long);
+            {ann}
+            define table T (symbol string, volume long);
+            from S insert into T;""")
+        rt.start()
+        h = rt.get_input_handler("S")
+        for row in (["IBM", 5], ["WSO2", 9], ["IBM", 2]):
+            h.send(row)
+        events = rt.query("from T select symbol, sum(volume) as total "
+                          "group by symbol order by total desc limit 5")
+        results[ann or "host"] = [tuple(e.data) for e in events]
+        rt.shutdown()
+    assert results["@Store(type='sqlite')"] == results["host"] == \
+        [("WSO2", 9), ("IBM", 7)]
+
+
+def test_sqlite_bool_column_pushdown_parity():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, flag bool);
+        @Store(type='sqlite')
+        define table T (symbol string, flag bool);
+        from S insert into T;""")
+    rt.start()
+    rt.get_input_handler("S").send(["IBM", True])
+    events = rt.query("from T select symbol, flag")
+    assert [tuple(e.data) for e in events] == [("IBM", True)]
+    assert isinstance(events[0].data[1], bool)
+    rt.shutdown()
+
+
+def test_sqlite_snapshot_skips_external_state():
+    """@Store contents are owned by the external system — persist()/restore
+    round-trips must not try to serialize the connection."""
+    m = SiddhiManager()
+    from siddhi_tpu.core.snapshot import InMemoryPersistenceStore
+    m.set_persistence_store(InMemoryPersistenceStore())
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (symbol string, volume long);
+        @Store(type='sqlite')
+        define table StockTable (symbol string, volume long);
+        from S insert into StockTable;""")
+    rt.start()
+    rt.get_input_handler("S").send(["IBM", 5])
+    rt.persist()
+    rt.restore_last_revision()
+    events = rt.query("from StockTable select symbol, volume")
+    assert [tuple(e.data) for e in events] == [("IBM", 5)]
+    rt.shutdown()
