@@ -206,13 +206,20 @@ class JoinRuntime:
         qr._finish_chain([], scope, self.union_def, factory)
         self.head = qr._chain_head([])
 
-        # subscribe both sides (self-join: two receivers on one junction)
+        # subscribe both sides (self-join: two receivers on one junction);
+        # a named-window side subscribes to the shared window itself — its
+        # published CURRENT/EXPIRED events trigger the join exactly like
+        # the reference's Window.java feeding downstream JoinProcessors
         for side, s in ((self.left, jis.left), (self.right, jis.right)):
-            if side.is_table or side.is_named_window or side.is_aggregation:
+            if side.is_table or side.is_aggregation:
                 continue
-            junction = app.junction_of(s.stream_id, s.is_inner, s.is_fault)
             recv = _JoinReceiver(self, side)
-            junction.subscribe(recv)
+            if side.is_named_window:
+                app.named_window_of(s.stream_id).subscribe(recv)
+            else:
+                junction = app.junction_of(s.stream_id, s.is_inner,
+                                           s.is_fault)
+                junction.subscribe(recv)
             qr.receivers[f"{side.side}:{s.stream_id}"] = recv
 
     @property
@@ -237,6 +244,15 @@ class JoinRuntime:
             # 1. arriving CURRENT events probe the opposite buffer
             if triggers and not data.is_empty:
                 self._probe_and_emit(side, opposite, data, CURRENT)
+            # 1b. a named-window side's publication carries its own
+            # EXPIRED rows (shared buffer already applied) — probe them
+            # as EXPIRED joins (reference Window.java → JoinProcessor)
+            if side.is_named_window and triggers:
+                expired = chunk.only(EXPIRED)
+                if not expired.is_empty:
+                    self._probe_and_emit(side, opposite,
+                                         expired.with_types(CURRENT),
+                                         EXPIRED)
             # 2. events enter this side's window; expirees probe as EXPIRED
             if side.window is not None:
                 side.window.process(chunk)

@@ -1,0 +1,262 @@
+"""Named-window (`define window`) conformance tests ported from the
+reference corpus (siddhi-core/src/test/java/io/siddhi/core/window/ —
+LengthWindowTestCase, LengthBatchWindowTestCase, TimeWindowTestCase,
+TimeBatchWindowTestCase, SortWindowTestCase, DelayWindowTestCase,
+CustomJoinWindowTestCase).  Behaviors mirrored with this repo's sends;
+assertions are the reference tests' expected semantics: shared window
+definitions feed many queries, `output all events` exposes expiry,
+joins run against the shared buffer."""
+from ref_harness import run_query
+
+CSE = "define stream cse (symbol string, price float, volume int);\n"
+
+
+# ------------------------------------------------- LengthWindowTestCase
+
+def test_named_length_window_under_capacity():
+    """testLengthWindow1: fewer events than the window size — only
+    CURRENT events, in arrival order."""
+    run_query(CSE + """
+        define window cseWindow (symbol string, price float, volume int)
+            length(4) output all events;
+        @info(name='query1') from cse select symbol, price, volume
+            insert into cseWindow;
+        @info(name='query2') from cseWindow insert into outputStream;""",
+        [("cse", ["IBM", 700.0, 0]), ("cse", ["WSO2", 60.5, 1])],
+        [("IBM", 700.0, 0), ("WSO2", 60.5, 1)], stream="outputStream",
+        playback=True)
+
+
+def test_named_length_window_expiry_interleaves():
+    """testLengthWindow2: past capacity, each arrival expires the oldest —
+    `insert all events` interleaves CURRENT and EXPIRED rows."""
+    run_query(CSE + """
+        define window cseWindow (symbol string, price float, volume int)
+            length(4) output all events;
+        @info(name='query1') from cse select symbol, price, volume
+            insert into cseWindow;
+        @info(name='query2') from cseWindow insert all events into
+            outputStream;""",
+        [("cse", ["IBM", 700.0, i]) for i in range(6)],
+        [("IBM", 700.0, 0), ("IBM", 700.0, 1), ("IBM", 700.0, 2),
+         ("IBM", 700.0, 3),
+         ("IBM", 700.0, 0), ("IBM", 700.0, 4),      # 0 expires as 4 arrives
+         ("IBM", 700.0, 1), ("IBM", 700.0, 5)],     # 1 expires as 5 arrives
+        stream="outputStream", playback=True)
+
+
+def test_named_window_aggregate_query():
+    """Aggregates over a shared window buffer (LengthWindowTestCase
+    aggregation variants): sum tracks the live window contents."""
+    run_query(CSE + """
+        define window cseWindow (symbol string, price float, volume int)
+            length(2) output all events;
+        @info(name='query1') from cse select symbol, price, volume
+            insert into cseWindow;
+        @info(name='query2') from cseWindow select sum(volume) as total
+            insert into outputStream;""",
+        [("cse", ["IBM", 1.0, 10]), ("cse", ["IBM", 1.0, 20]),
+         ("cse", ["IBM", 1.0, 30])],
+        [(10,), (30,), (50,)],          # 10, 10+20, 20+30 (10 expired)
+        stream="outputStream", playback=True)
+
+
+# --------------------------------------------- LengthBatchWindowTestCase
+
+def test_named_length_batch_window():
+    """Batch named window emits only on full batches."""
+    run_query(CSE + """
+        define window cseWindow (symbol string, price float, volume int)
+            lengthBatch(2) output all events;
+        @info(name='query1') from cse select symbol, price, volume
+            insert into cseWindow;
+        @info(name='query2') from cseWindow insert into outputStream;""",
+        [("cse", ["A", 1.0, 1]), ("cse", ["B", 1.0, 2]),
+         ("cse", ["C", 1.0, 3]), ("cse", ["D", 1.0, 4]),
+         ("cse", ["E", 1.0, 5])],
+        [("A", 1.0, 1), ("B", 1.0, 2), ("C", 1.0, 3), ("D", 1.0, 4)],
+        stream="outputStream", playback=True)
+
+
+# ------------------------------------------------- TimeWindowTestCase
+
+def test_named_time_window_expiry():
+    """Time-based named window expires by virtual clock."""
+    run_query(CSE + """
+        define window cseWindow (symbol string, price float, volume int)
+            time(1 sec) output all events;
+        @info(name='query1') from cse select symbol, price, volume
+            insert into cseWindow;
+        @info(name='query2') from cseWindow select sum(volume) as total
+            insert into outputStream;""",
+        [("cse", ["A", 1.0, 10], 1_000_000),
+         ("cse", ["B", 1.0, 20], 1_000_100),
+         ("__advance__", None, 1_002_000),
+         ("cse", ["C", 1.0, 40], 1_002_100)],
+        [(10,), (30,), (40,)],        # A+B expired by the clock advance
+        stream="outputStream", playback=True)
+
+
+# --------------------------------------------- TimeBatchWindowTestCase
+
+def test_named_time_batch_window():
+    run_query(CSE + """
+        define window cseWindow (symbol string, price float, volume int)
+            timeBatch(1 sec) output all events;
+        @info(name='query1') from cse select symbol, price, volume
+            insert into cseWindow;
+        @info(name='query2') from cseWindow insert into outputStream;""",
+        [("cse", ["A", 1.0, 1], 1_000_000),
+         ("cse", ["B", 1.0, 2], 1_000_200),
+         ("__advance__", None, 1_001_100),
+         ("cse", ["C", 1.0, 3], 1_001_200),
+         ("__advance__", None, 1_002_200)],
+        [("A", 1.0, 1), ("B", 1.0, 2), ("C", 1.0, 3)],
+        stream="outputStream", playback=True)
+
+
+# ------------------------------------------------- SortWindowTestCase
+
+def test_named_sort_window():
+    """sort(2, volume) keeps the two smallest volumes; larger rows expire
+    immediately."""
+    run_query(CSE + """
+        define window cseWindow (symbol string, price float, volume int)
+            sort(2, volume) output all events;
+        @info(name='query1') from cse select symbol, price, volume
+            insert into cseWindow;
+        @info(name='query2') from cseWindow insert expired events into
+            outputStream;""",
+        [("cse", ["A", 1.0, 50]), ("cse", ["B", 1.0, 20]),
+         ("cse", ["C", 1.0, 40]), ("cse", ["D", 1.0, 10])],
+        [("A", 1.0, 50), ("C", 1.0, 40)],
+        stream="outputStream", playback=True)
+
+
+# ------------------------------------------------- DelayWindowTestCase
+
+def test_named_delay_window():
+    """delay(1 sec): events surface only after the delay elapses."""
+    run_query(CSE + """
+        define window cseWindow (symbol string, price float, volume int)
+            delay(1 sec);
+        @info(name='query1') from cse select symbol, price, volume
+            insert into cseWindow;
+        @info(name='query2') from cseWindow insert into outputStream;""",
+        [("cse", ["A", 1.0, 1], 1_000_000),
+         ("__advance__", None, 1_000_500),
+         ("cse", ["B", 1.0, 2], 1_000_600),
+         ("__advance__", None, 1_001_100)],
+        [("A", 1.0, 1)],               # only A's delay has elapsed
+        stream="outputStream", playback=True)
+
+
+# --------------------------------------------- CustomJoinWindowTestCase
+
+def test_join_named_window_with_table():
+    """testJoinWindowWithTable: a length(1) check window joined against a
+    table — expected single (WSO2, WSO2, 100) row."""
+    run_query("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string);
+        define window CheckStockWindow (symbol string) length(1)
+            output all events;
+        define table StockTable (symbol string, price float, volume long);
+        @info(name='query0') from StockStream insert into StockTable;
+        @info(name='query1') from CheckStockStream insert into
+            CheckStockWindow;
+        @info(name='query2')
+        from CheckStockWindow join StockTable
+            on CheckStockWindow.symbol == StockTable.symbol
+        select CheckStockWindow.symbol as checkSymbol,
+               StockTable.symbol as symbol, StockTable.volume as volume
+        insert into OutputStream;""",
+        [("StockStream", ["WSO2", 55.6, 100]),
+         ("StockStream", ["IBM", 75.6, 10]),
+         ("CheckStockStream", ["WSO2"])],
+        [("WSO2", "WSO2", 100)], stream="OutputStream", playback=True)
+
+
+def test_join_two_named_windows():
+    """testJoinWindowWithWindow: filtered inserts feed two shared windows;
+    the join fires per matching regulator arrival (rooms 4 and 5)."""
+    run_query("""
+        define stream TempStream (deviceID long, roomNo int, temp double);
+        define stream RegulatorStream (deviceID long, roomNo int, isOn bool);
+        define window TempWindow (deviceID long, roomNo int, temp double)
+            time(1 min);
+        define window RegulatorWindow (deviceID long, roomNo int, isOn bool)
+            length(1);
+        @info(name='query1') from TempStream[temp > 30.0]
+            insert into TempWindow;
+        @info(name='query2') from RegulatorStream[isOn == false]
+            insert into RegulatorWindow;
+        @info(name='query3')
+        from TempWindow join RegulatorWindow
+            on TempWindow.roomNo == RegulatorWindow.roomNo
+        select TempWindow.roomNo, RegulatorWindow.deviceID,
+               'start' as action
+        insert into RegulatorActionStream;""",
+        [("TempStream", [100, 1, 20.0]), ("TempStream", [100, 2, 25.0]),
+         ("TempStream", [100, 3, 30.0]), ("TempStream", [100, 4, 35.0]),
+         ("TempStream", [100, 5, 40.0]),
+         ("RegulatorStream", [100, 1, False]),
+         ("RegulatorStream", [100, 2, False]),
+         ("RegulatorStream", [100, 3, False]),
+         ("RegulatorStream", [100, 4, False]),
+         ("RegulatorStream", [100, 5, False])],
+        [(4, 100, "start"), (5, 100, "start")],
+        stream="RegulatorActionStream", playback=True)
+
+
+def test_many_streams_one_named_window():
+    """testWindowWithMultipleStreams shape: five source streams feed one
+    shared window; the window sees the union."""
+    streams = "\n".join(
+        f"define stream Stream{i} (symbol string, price float, volume long);"
+        for i in range(5))
+    inserts = "\n".join(
+        f"@info(name='insert{i}') from Stream{i} insert into AllWindow;"
+        for i in range(5))
+    run_query(streams + """
+        define window AllWindow (symbol string, price float, volume long)
+            length(10) output all events;
+        """ + inserts + """
+        @info(name='query1') from AllWindow select symbol, volume
+            insert into OutputStream;""",
+        [(f"Stream{i}", ["WSO2", i * 10.0, 1]) for i in range(5)],
+        [("WSO2", 1)] * 5, stream="OutputStream", playback=True)
+
+
+def test_filter_on_named_window_query():
+    """testWindowFilter shape: `from W[cond]` filters the shared buffer's
+    output stream."""
+    run_query("""
+        define stream StockIn (symbol string, price float, volume long);
+        define window StockWindow (symbol string, price float, volume long)
+            length(10) output all events;
+        @info(name='query1') from StockIn insert into StockWindow;
+        @info(name='query2') from StockWindow[volume > 6]
+            select symbol, volume insert into OutputStream;""",
+        [("StockIn", ["WSO2", 84.0, 20]), ("StockIn", ["IBM", 90.0, 1]),
+         ("StockIn", ["WSO2", 55.0, 5]), ("StockIn", ["IBM", 70.0, 8])],
+        [("WSO2", 20), ("IBM", 8)], stream="OutputStream", playback=True)
+
+
+def test_named_window_unidirectional_join_stream():
+    """Stream joined to a named window (only stream side triggers)."""
+    run_query("""
+        define stream Probe (symbol string);
+        define stream StockIn (symbol string, volume long);
+        define window StockWindow (symbol string, volume long) length(5);
+        @info(name='query1') from StockIn insert into StockWindow;
+        @info(name='query2')
+        from Probe unidirectional join StockWindow
+            on Probe.symbol == StockWindow.symbol
+        select Probe.symbol, StockWindow.volume
+        insert into OutputStream;""",
+        [("StockIn", ["IBM", 10]), ("StockIn", ["WSO2", 20]),
+         ("Probe", ["IBM"]), ("StockIn", ["IBM", 30]),
+         ("Probe", ["IBM"])],
+        [("IBM", 10), ("IBM", 10), ("IBM", 30)],
+        stream="OutputStream", playback=True, unordered=True)
