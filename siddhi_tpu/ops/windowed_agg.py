@@ -50,10 +50,13 @@ def make_wagg_carry(n_partitions: int, window: int) -> WaggCarry:
 
 # ------------------------------------------------------------------ jnp path
 
-def build_wagg_step(window: int):
+def build_wagg_step(window: int, want_minmax: bool = False):
     """fn(carry, values [P,T], accepted [P,T]) →
-    (carry, (sums [P,T], counts [P,T]))  — running aggregate after each
-    accepted event (positions with accepted=False repeat the previous)."""
+    (carry, (sums [P,T], counts [P,T][, mins, maxs]))  — running aggregate
+    after each accepted event (positions with accepted=False repeat the
+    previous).  min/max reduce the live ring slots exactly — no
+    subtract-on-expiry state is needed because the window contents are
+    materialised (the classic sliding-extremum problem dissolves)."""
 
     def lane_step(carry, xs):
         ring, pos, cnt, runsum, comp = carry
@@ -71,15 +74,21 @@ def build_wagg_step(window: int):
         ring2 = jnp.where(ok & oh, x, ring)
         pos2 = jnp.where(ok, (pos + 1) % window, pos)
         cnt2 = jnp.where(ok, jnp.minimum(cnt + 1, window), cnt)
-        return (ring2, pos2, cnt2, runsum2, comp2), (runsum2, cnt2)
+        out = (runsum2, cnt2)
+        if want_minmax:
+            valid = jnp.arange(window) < cnt2     # filled slots (see ring
+            mn = jnp.min(jnp.where(valid, ring2, jnp.inf))      # fill order)
+            mx = jnp.max(jnp.where(valid, ring2, -jnp.inf))
+            out = (runsum2, cnt2, mn, mx)
+        return (ring2, pos2, cnt2, runsum2, comp2), out
 
     def per_lane(carry_l, values_l, ok_l):
         return jax.lax.scan(lane_step, carry_l, (values_l, ok_l))
 
     def step(carry: WaggCarry, values, accepted):
-        (ring, pos, cnt, runsum, comp), (sums, counts) = jax.vmap(per_lane)(
+        (ring, pos, cnt, runsum, comp), outs = jax.vmap(per_lane)(
             tuple(carry), values, accepted)
-        return WaggCarry(ring, pos, cnt, runsum, comp), (sums, counts)
+        return WaggCarry(ring, pos, cnt, runsum, comp), outs
 
     return step
 
@@ -89,7 +98,8 @@ def build_wagg_step(window: int):
 LANES = 128
 
 
-def build_wagg_step_pallas(window: int, t_per_block: int):
+def build_wagg_step_pallas(window: int, t_per_block: int,
+                           want_minmax: bool = False):
     """Same contract as build_wagg_step, lowered to one Pallas kernel.
 
     Layout: partition lanes ride the last (128-wide) dim; the grid walks
@@ -102,7 +112,7 @@ def build_wagg_step_pallas(window: int, t_per_block: int):
 
     def kernel(values_ref, ok_ref, ring_in, pos_in, cnt_in, sum_in, comp_in,
                ring_out, pos_out, cnt_out, sum_out, comp_out, sums_ref,
-               counts_ref):
+               counts_ref, *minmax_refs):
         # refs carry a leading block dim of 1 (one tile per program)
         ring = ring_in[0, :, :]                  # (W, 128)
         pos = pos_in[0, 0, :]                    # (128,)
@@ -127,6 +137,12 @@ def build_wagg_step_pallas(window: int, t_per_block: int):
             cnt = jnp.where(ok, jnp.minimum(cnt + 1, W), cnt)
             sums_ref[0, t, :] = runsum
             counts_ref[0, t, :] = cnt
+            if want_minmax:
+                valid = iota_w < cnt[None, :]
+                minmax_refs[0][0, t, :] = jnp.min(
+                    jnp.where(valid, ring, jnp.inf), axis=0)
+                minmax_refs[1][0, t, :] = jnp.max(
+                    jnp.where(valid, ring, -jnp.inf), axis=0)
         ring_out[0, :, :] = ring
         pos_out[0, 0, :] = pos
         cnt_out[0, 0, :] = cnt
@@ -163,18 +179,23 @@ def build_wagg_step_pallas(window: int, t_per_block: int):
             jax.ShapeDtypeStruct(vals.shape, jnp.float32),   # sums
             jax.ShapeDtypeStruct(ok.shape, jnp.int32),       # counts
         ]
+        out_specs = [tile_spec((W, LANES)), tile_spec((1, LANES)),
+                     tile_spec((1, LANES)), tile_spec((1, LANES)),
+                     tile_spec((1, LANES)), tile_spec((T, LANES)),
+                     tile_spec((T, LANES))]
+        if want_minmax:
+            out_shape += [jax.ShapeDtypeStruct(vals.shape, jnp.float32),
+                          jax.ShapeDtypeStruct(vals.shape, jnp.float32)]
+            out_specs += [tile_spec((T, LANES)), tile_spec((T, LANES))]
 
-        ring2, pos2, cnt2, rs2, cp2, sums, counts = pl.pallas_call(
+        ring2, pos2, cnt2, rs2, cp2, sums, counts, *mm = pl.pallas_call(
             kernel,
             grid=grid,
             in_specs=[tile_spec((T, LANES)), tile_spec((T, LANES)),
                       tile_spec((W, LANES)), tile_spec((1, LANES)),
                       tile_spec((1, LANES)), tile_spec((1, LANES)),
                       tile_spec((1, LANES))],
-            out_specs=[tile_spec((W, LANES)), tile_spec((1, LANES)),
-                       tile_spec((1, LANES)), tile_spec((1, LANES)),
-                       tile_spec((1, LANES)), tile_spec((T, LANES)),
-                       tile_spec((T, LANES))],
+            out_specs=out_specs,
             out_shape=out_shape,
             input_output_aliases={2: 0, 3: 1, 4: 2, 5: 3, 6: 4},
         )(vals, ok, ring, pos, cnt, rs, cp)
@@ -183,8 +204,12 @@ def build_wagg_step_pallas(window: int, t_per_block: int):
             ring=ring2.transpose(0, 2, 1).reshape(P, W),
             pos=pos2.reshape(P), cnt=cnt2.reshape(P),
             runsum=rs2.reshape(P), comp=cp2.reshape(P))
-        sums_pt = sums.transpose(0, 2, 1).reshape(P, -1)
-        counts_pt = counts.transpose(0, 2, 1).reshape(P, -1)
-        return new_carry, (sums_pt, counts_pt)
+
+        def back(a):
+            return a.transpose(0, 2, 1).reshape(P, -1)
+        outs = (back(sums), back(counts))
+        if want_minmax:
+            outs += (back(mm[0]), back(mm[1]))
+        return new_carry, outs
 
     return step

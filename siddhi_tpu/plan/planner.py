@@ -361,6 +361,8 @@ class DeviceWindowedAggRuntime:
                 t = (AttrType.DOUBLE if vt in (AttrType.FLOAT,
                                                AttrType.DOUBLE, None)
                      else AttrType.LONG)
+            elif kind in ("min", "max"):
+                t = vt if vt is not None else AttrType.DOUBLE
             else:                                  # avg
                 t = AttrType.DOUBLE
             attrs.append(Attribute(name, t))
@@ -419,9 +421,11 @@ class DeviceWindowedAggRuntime:
                                   np.zeros(n, np.int32), P,
                                   base_ts=int(ts_arr[0]), pad_t_pow2=True,
                                   return_rows=True)
-        sums, counts = self.cwa.process_block(block)
-        sums = np.asarray(sums)
-        counts = np.asarray(counts)
+        outs = self.cwa.process_block(block)
+        sums = np.asarray(outs[0])
+        counts = np.asarray(outs[1])
+        mins = np.asarray(outs[2]) if len(outs) > 2 else None
+        maxs = np.asarray(outs[3]) if len(outs) > 3 else None
 
         # host-side twin filter decides which input events emit output rows
         from .expr_compiler import EvalCtx
@@ -445,6 +449,10 @@ class DeviceWindowedAggRuntime:
                 cols[name] = ev_sums
             elif kind == "count":
                 cols[name] = ev_counts
+            elif kind == "min":
+                cols[name] = mins[sel_l, sel_r]
+            elif kind == "max":
+                cols[name] = maxs[sel_l, sel_r]
             else:
                 with np.errstate(invalid="ignore", divide="ignore"):
                     cols[name] = np.where(ev_counts > 0,

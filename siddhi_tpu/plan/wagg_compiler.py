@@ -24,7 +24,7 @@ from .expr_compiler import EvalCtx, ExprCompiler, Scope
 from ..ops.windowed_agg import (LANES, WaggCarry, build_wagg_step,
                                 build_wagg_step_pallas, make_wagg_carry)
 
-_AGGS = {"sum", "count", "avg"}
+_AGGS = {"sum", "count", "avg", "min", "max"}
 
 
 class CompiledWindowedAgg:
@@ -87,9 +87,11 @@ class CompiledWindowedAgg:
                 self.outputs.append((oa.rename, "key", e.attribute))
             else:
                 raise SiddhiAppCreationError(
-                    "windowed-agg select supports sum/count/avg of one "
-                    "expression plus key attributes")
+                    "windowed-agg select supports sum/count/avg/min/max of "
+                    "one expression plus key attributes")
         self.value = value_expr
+        self.want_minmax = any(k in ("min", "max")
+                               for _, k, _ in self.outputs)
         self.filter_exprs = [h.expr for h in s.handlers
                              if isinstance(h, Filter)]
         self.input_definition = definition
@@ -99,8 +101,10 @@ class CompiledWindowedAgg:
         if use_pallas is None:
             use_pallas = jax.devices()[0].platform == "tpu" and \
                 n_partitions % LANES == 0
-        step = (build_wagg_step_pallas(self.window, t_per_block)
-                if use_pallas else build_wagg_step(self.window))
+        step = (build_wagg_step_pallas(self.window, t_per_block,
+                                       self.want_minmax)
+                if use_pallas else build_wagg_step(self.window,
+                                                   self.want_minmax))
         self.use_pallas = use_pallas
 
         def full_step(carry: WaggCarry, block: Dict[str, jnp.ndarray]):
@@ -144,15 +148,16 @@ class CompiledWindowedAgg:
 
     def process_block(self, block):
         """block: [P, T] packed lanes (ops.nfa.pack_blocks) →
-        (sums [P, T], counts [P, T]) running aggregates."""
-        self.carry, (sums, counts) = self._step(self.carry, block)
-        return sums, counts
+        (sums [P, T], counts [P, T][, mins, maxs]) running aggregates."""
+        self.carry, outs = self._step(self.carry, block)
+        return outs
 
     def current_aggregates(self) -> Dict[str, np.ndarray]:
         """Per-lane aggregate values right now."""
         s = np.asarray(self.carry.runsum)
         c = np.asarray(self.carry.cnt)
         out = {}
+        ring = None
         for name, kind, _attr in self.outputs:
             if kind == "sum":
                 out[name] = s
@@ -162,4 +167,11 @@ class CompiledWindowedAgg:
                 with np.errstate(invalid="ignore", divide="ignore"):
                     out[name] = np.where(c > 0, s / np.maximum(c, 1),
                                          np.nan)
+            elif kind in ("min", "max"):
+                if ring is None:
+                    ring = np.asarray(self.carry.ring)
+                valid = np.arange(self.window)[None, :] < c[:, None]
+                fill = np.inf if kind == "min" else -np.inf
+                red = np.min if kind == "min" else np.max
+                out[name] = red(np.where(valid, ring, fill), axis=1)
         return out

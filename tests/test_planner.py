@@ -261,7 +261,8 @@ WAGG_PART_APP = """
     partition with (k of S) begin
     @info(name='q')
     from S[v > 2.0]#window.length(5)
-    select k, sum(v) as total, count() as n, avg(v) as mean
+    select k, sum(v) as total, count() as n, avg(v) as mean,
+           min(v) as lo, max(v) as hi
     group by k
     insert into Out;
     end;
@@ -282,6 +283,8 @@ def test_partitioned_windowed_agg_device_parity():
         assert a[0] == b[0] and a[2] == b[2]
         assert a[1] == pytest.approx(b[1], abs=1e-3)
         assert a[3] == pytest.approx(b[3], abs=1e-3)
+        assert a[4] == pytest.approx(b[4], abs=1e-4)     # min
+        assert a[5] == pytest.approx(b[5], abs=1e-4)     # max
 
 
 def test_wagg_int_sum_falls_back_to_host():

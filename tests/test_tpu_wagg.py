@@ -105,6 +105,97 @@ def test_wagg_pallas_interpret_matches_jnp():
     assert (np.asarray(c1.pos) == np.asarray(c2.pos)).all()
 
 
+def test_wagg_minmax_matches_naive():
+    """min/max lanes reduce the live ring exactly — compare against a naive
+    per-lane sliding-window reference."""
+    import jax
+    P, W, T = 8, 5, 64
+    rng = np.random.default_rng(3)
+    values = rng.uniform(0, 100, (P, T)).astype(np.float32)
+    accepted = rng.random((P, T)) < 0.6
+    step = jax.jit(build_wagg_step(W, want_minmax=True))
+    _, (s, n, mn, mx) = step(make_wagg_carry(P, W), values, accepted)
+    mn, mx = np.asarray(mn), np.asarray(mx)
+    for p in range(P):
+        win = []
+        for t in range(T):
+            if accepted[p, t]:
+                win.append(values[p, t])
+                win = win[-W:]
+            if win:
+                assert mn[p, t] == pytest.approx(min(win)), (p, t)
+                assert mx[p, t] == pytest.approx(max(win)), (p, t)
+
+
+def test_wagg_minmax_pallas_interpret_matches_jnp():
+    from jax.experimental import pallas as pl
+    import jax
+    import jax.numpy as jnp
+    P, W, T = 256, 7, 8
+    rng = np.random.default_rng(4)
+    values = rng.uniform(0, 10, (P, T)).astype(np.float32)
+    accepted = rng.random((P, T)) < 0.7
+    step_j = jax.jit(build_wagg_step(W, want_minmax=True))
+    _, (s1, n1, mn1, mx1) = step_j(make_wagg_carry(P, W), values, accepted)
+    orig = pl.pallas_call
+
+    def patched(*a, **k):
+        k["interpret"] = True
+        return orig(*a, **k)
+    pl.pallas_call = patched
+    try:
+        step_p = build_wagg_step_pallas(W, T, want_minmax=True)
+        _, (s2, n2, mn2, mx2) = step_p(make_wagg_carry(P, W),
+                                       jnp.asarray(values),
+                                       jnp.asarray(accepted))
+    finally:
+        pl.pallas_call = orig
+    assert np.allclose(np.asarray(mn1), np.asarray(mn2))
+    assert np.allclose(np.asarray(mx1), np.asarray(mx2))
+    assert np.allclose(np.asarray(s1), np.asarray(s2))
+
+
+def test_wagg_minmax_end_to_end_vs_oracle():
+    """min/max through CompiledWindowedAgg vs the partitioned host query."""
+    n_partitions = 8
+    pids, vals, ts = gen(11, 300, n_partitions)
+    agg = CompiledWindowedAgg("""
+        define stream S (k int, v float);
+        @info(name='q')
+        from S[v > 2.0]#window.length(5)
+        select k, min(v) as lo, max(v) as hi, sum(v) as total
+        group by k
+        insert into Out;
+    """, n_partitions=n_partitions, t_per_block=32, use_pallas=False)
+    block = pack_blocks(pids, {"k": pids.astype(np.float32), "v": vals},
+                        ts, np.zeros(len(pids), np.int32), n_partitions,
+                        base_ts=int(ts[0]))
+    agg.process_block(block)
+    got = agg.current_aggregates()
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (k int, v float);
+        partition with (k of S) begin
+        @info(name='q')
+        from S[v > 2.0]#window.length(5)
+        select k, min(v) as lo, max(v) as hi group by k
+        insert into Out; end;
+    """)
+    last = {}
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: [last.__setitem__(e.data[0], (e.data[1], e.data[2]))
+                     for e in evs]))
+    rt.start()
+    rt.get_input_handler("S").send_batch(
+        {"k": pids.astype(np.int32), "v": vals}, timestamps=ts)
+    rt.shutdown()
+    assert last, "oracle produced nothing"
+    for k, (lo, hi) in last.items():
+        assert got["lo"][k] == pytest.approx(lo, rel=1e-6), k
+        assert got["hi"][k] == pytest.approx(hi, rel=1e-6), k
+
+
 def test_wagg_rejects_distinct_aggregate_args():
     """sum(x) + avg(y) can't share the single value lane — must be rejected
     at compile time, not silently aggregate the wrong column."""
