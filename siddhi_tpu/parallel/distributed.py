@@ -1,0 +1,155 @@
+"""Multi-host scale-out: the distributed communication backend.
+
+The reference is a single-JVM engine — its deepest "transport" is the LMAX
+Disruptor ring and in-memory pub/sub (stream/StreamJunction.java:280-316,
+util/transport/InMemoryBroker.java; SURVEY.md §5.8).  The TPU-native
+equivalent is a single sharded program spanning hosts: every host runs this
+same code under `jax.distributed`, the partition axis of the NFA/aggregation
+state shards over the GLOBAL device set (ICI within a slice, DCN across
+hosts), and XLA's collectives carry the only cross-host traffic on the hot
+path (the per-block stats psum in parallel/mesh.py).
+
+Host-side dataflow:
+  - each host ingests the events whose partition keys it OWNS
+    (`host_for_partition`: contiguous range split, so key→host routing is a
+    single integer divide a fronting load balancer can compute);
+  - per-host blocks assemble into one global sharded array with
+    `jax.make_array_from_process_local_data` — no host ever materialises
+    another host's events;
+  - the jitted sharded step runs SPMD on all hosts; each host reads back
+    only its own shard of the match outputs (`addressable_shards`), so
+    alert egress is host-local too.
+
+Single-host (and the CI virtual-device mesh) is the num_processes=1 special
+case of the same code path.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+COORD_ENV = "SIDDHI_TPU_COORDINATOR"        # host:port of process 0
+NPROC_ENV = "SIDDHI_TPU_NUM_PROCESSES"
+PID_ENV = "SIDDHI_TPU_PROCESS_ID"
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> bool:
+    """Join (or bootstrap) the multi-host cluster via jax.distributed.
+
+    Reads SIDDHI_TPU_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID when
+    arguments are omitted.  Returns True if a multi-process runtime was
+    initialised, False for the single-process fallback (no env, no args) —
+    the rest of the module works identically either way.
+    """
+    import jax
+    coordinator = coordinator or os.environ.get(COORD_ENV)
+    if coordinator is None:
+        return False
+    num_processes = num_processes if num_processes is not None else \
+        int(os.environ.get(NPROC_ENV, "1"))
+    process_id = process_id if process_id is not None else \
+        int(os.environ.get(PID_ENV, "0"))
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+def process_info() -> Tuple[int, int]:
+    """(process_id, num_processes) of this host."""
+    import jax
+    return jax.process_index(), jax.process_count()
+
+
+def host_partition_range(n_partitions: int,
+                         process_id: Optional[int] = None,
+                         num_processes: Optional[int] = None
+                         ) -> Tuple[int, int]:
+    """[start, stop) of the global partition axis this host ingests.
+
+    Contiguous split matching the mesh's leading-axis sharding: host h of H
+    owns rows [h*P/H, (h+1)*P/H).  A fronting router sends an event with
+    partition lane p to host p * H // P."""
+    pid, nproc = process_info()
+    if process_id is None:
+        process_id = pid
+    if num_processes is None:
+        num_processes = nproc
+    per = n_partitions // num_processes
+    assert per * num_processes == n_partitions, \
+        f"n_partitions={n_partitions} must divide by hosts={num_processes}"
+    return process_id * per, (process_id + 1) * per
+
+
+def host_for_partition(p: int, n_partitions: int,
+                       num_processes: Optional[int] = None) -> int:
+    """Owning host of global partition lane p (router-side helper)."""
+    if num_processes is None:
+        num_processes = process_info()[1]
+    return p * num_processes // n_partitions
+
+
+def global_block(local_block: Dict[str, np.ndarray], mesh,
+                 axis: str = "p") -> Dict:
+    """Assemble each host's local [P_local, T] lanes into global sharded
+    arrays on `mesh` without cross-host data movement
+    (jax.make_array_from_process_local_data: every host contributes the
+    shard it already holds)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    out = {}
+    for k, v in local_block.items():
+        sh = NamedSharding(mesh, P(axis, *([None] * (v.ndim - 1))))
+        out[k] = jax.make_array_from_process_local_data(sh, v)
+    return out
+
+
+def local_rows(global_array) -> np.ndarray:
+    """This host's rows of a partition-sharded output, in global row order
+    (host-local alert egress: each host decodes only the matches of the
+    partitions it owns)."""
+    shards = sorted([s for s in global_array.addressable_shards],
+                    key=lambda s: s.index[0].start or 0)
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
+
+class DistributedPatternBank:
+    """A compiled pattern NFA sharded over the global (multi-host) device
+    set: the distributed version of plan/nfa_compiler.CompiledPatternNFA's
+    single-chip step (partition/PartitionRuntime.java's per-key clones →
+    rows of one global state slab spanning hosts).
+    """
+
+    def __init__(self, app_string: str, n_partitions: int, n_slots: int = 8,
+                 mesh=None, axis: str = "p"):
+        import jax
+        from .mesh import (build_sharded_step, make_sharded_carry,
+                           partition_mesh)
+        from ..plan.nfa_compiler import CompiledPatternNFA
+
+        self.mesh = mesh if mesh is not None else partition_mesh()
+        self.axis = axis
+        self.n_partitions = n_partitions
+        n_dev = len(self.mesh.devices.reshape(-1))
+        assert n_partitions % n_dev == 0, \
+            f"n_partitions={n_partitions} must divide device count {n_dev}"
+        self.nfa = CompiledPatternNFA(app_string, n_partitions=1,
+                                      n_slots=n_slots)
+        self.spec = self.nfa.spec
+        self.carry = make_sharded_carry(self.spec, n_partitions, self.mesh,
+                                        axis)
+        self._step = build_sharded_step(self.spec, self.mesh, axis)
+        self.local_range = host_partition_range(n_partitions)
+
+    def step_local(self, local_block: Dict[str, np.ndarray]):
+        """Feed this host's [P_local, T] block; returns (local_mask,
+        local_ts, stats) — the host's own match rows plus the global stats
+        from the single cross-host psum."""
+        gblock = global_block(local_block, self.mesh, self.axis)
+        self.carry, (mask, caps, ts), stats = self._step(self.carry, gblock)
+        return local_rows(mask), local_rows(ts), \
+            {k: int(v) for k, v in stats.items()}
