@@ -22,15 +22,14 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
-from ..query_api.definition import TableDefinition
+from ..query_api.definition import AttrType, TableDefinition
 from ..query_api.expression import (And, AttributeFunction, Compare,
                                     CompareOp, Constant, Expression, IsNull,
                                     MathExpr, MathOp, Not, Or, Variable,
                                     variables_of)
 from ..utils.errors import SiddhiAppCreationError
 from .event import CURRENT, EventChunk, dtype_for
-
-STREAM_QUAL = "__stream__"
+from .table import STREAM_QUAL, _item, _scalar
 
 
 # ---------------------------------------------------------------- condition IR
@@ -44,15 +43,44 @@ class RecordExpr:
     pass
 
 
+#: coarse value-type tags on IR nodes ('str' | 'int' | 'float' | 'bool' |
+#: None=unknown) — stores use them to render type-correct native syntax
+#: (e.g. SQL string concat is `||`, not `+`) or refuse an operator whose
+#: native semantics diverge from the engine's.
+def _tag_of(t: Optional[AttrType]) -> Optional[str]:
+    if t in (AttrType.INT, AttrType.LONG):
+        return "int"
+    if t in (AttrType.FLOAT, AttrType.DOUBLE):
+        return "float"
+    if t == AttrType.STRING:
+        return "str"
+    if t == AttrType.BOOL:
+        return "bool"
+    return None
+
+
 @dataclass(frozen=True)
 class Col(RecordExpr):
     """Table column reference."""
     name: str
+    type: Optional[str] = None
 
 
 @dataclass(frozen=True)
 class Const(RecordExpr):
     value: Any
+
+    @property
+    def type(self) -> Optional[str]:
+        if isinstance(self.value, bool):
+            return "bool"
+        if isinstance(self.value, int):
+            return "int"
+        if isinstance(self.value, float):
+            return "float"
+        if isinstance(self.value, str):
+            return "str"
+        return None
 
 
 @dataclass(frozen=True)
@@ -61,6 +89,7 @@ class Param(RecordExpr):
     expression for each probing event and passes {name: value} to the store
     (≙ streamVariable placeholders in the reference's compiled conditions)."""
     name: str
+    type: Optional[str] = None
 
 
 @dataclass(frozen=True)
@@ -98,12 +127,34 @@ class Arith(RecordExpr):
     left: RecordExpr
     right: RecordExpr
 
+    @property
+    def type(self) -> Optional[str]:
+        lt = getattr(self.left, "type", None)
+        rt = getattr(self.right, "type", None)
+        if "str" in (lt, rt):
+            return "str"
+        if "float" in (lt, rt):
+            return "float"
+        if lt == rt == "int":
+            return "int"
+        return None
+
 
 @dataclass(frozen=True)
 class Agg(RecordExpr):
     """Aggregate over the selected/grouped rows (selection pushdown only)."""
     kind: str                  # 'sum' 'count' 'avg' 'min' 'max'
     arg: Optional[RecordExpr]  # None for count(*)
+
+
+def record_expr_children(e: RecordExpr):
+    """Direct RecordExpr children of a node — THE tree-walk for IR
+    consumers (stores' validate_expr, _has_agg); new node shapes must keep
+    children as direct dataclass fields or extend this."""
+    for f in getattr(e, "__dataclass_fields__", {}):
+        v = getattr(e, f)
+        if isinstance(v, RecordExpr):
+            yield v
 
 
 # ---------------------------------------------------------------- compiled forms
@@ -216,8 +267,9 @@ class _Translator:
 
     def _param(self, e: Expression) -> Param:
         name = f"{self._prefix}{len(self.params)}"
-        self.params.append((name, self._compiler().compile(e)))
-        return Param(name)
+        ce = self._compiler().compile(e)
+        self.params.append((name, ce))
+        return Param(name, _tag_of(getattr(ce, "type", None)))
 
     # ---- recursive translation
 
@@ -233,7 +285,9 @@ class _Translator:
                     raise SiddhiAppCreationError(
                         f"record table '{self.table_def.id}' has no "
                         f"attribute '{e.attribute}'")
-                return Col(e.attribute)
+                t = next(a.type for a in self.table_def.attributes
+                         if a.name == e.attribute)
+                return Col(e.attribute, _tag_of(t))
             return self._param(e)
         if self._is_table_free(e):
             return self._param(e)
@@ -416,12 +470,18 @@ class AbstractRecordTable:
 
     # ------------------------------------------------------------- compile
 
+    def validate_expr(self, e: Optional[RecordExpr]) -> None:
+        """Store hook, called at compile time: raise SiddhiAppCreationError
+        for IR whose native execution would diverge from engine semantics
+        (callers fall back to host-side evaluation where one exists)."""
+
     def compile_condition(self, on: Optional[Expression], stream_def,
                           factory) -> CompiledRecordCondition:
         if on is None:
             return CompiledRecordCondition(None, [])
         tr = _Translator(self.definition, stream_def, factory)
         root = tr.translate(on)
+        self.validate_expr(root)
         return CompiledRecordCondition(root, tr.params)
 
     def compile_set(self, assignments, stream_def,
@@ -433,6 +493,8 @@ class AbstractRecordTable:
         tr = _Translator(self.definition, stream_def, factory, prefix="s")
         out = [(a.table_variable.attribute, tr.translate(a.value))
                for a in assignments or []]
+        for _, e in out:
+            self.validate_expr(e)
         return CompiledRecordSet(out, tr.params)
 
     def _effective_set(self, cset: "CompiledRecordSet",
@@ -477,11 +539,13 @@ class AbstractQueryableRecordTable(AbstractRecordTable):
         tr = _Translator(self.definition, None, factory,
                          allow_aggregates=True)
         if selector.select_all:
-            select = [(a.name, Col(a.name))
+            select = [(a.name, Col(a.name, _tag_of(a.type)))
                       for a in self.definition.attributes]
         else:
             select = [(oa.rename, tr.translate(oa.expr))
                       for oa in selector.attributes]
+        for _, e in select:
+            self.validate_expr(e)
         out_names = {name for name, _ in select}
         group_by = []
         for v in selector.group_by:
@@ -505,6 +569,7 @@ class AbstractQueryableRecordTable(AbstractRecordTable):
             raise SiddhiAppCreationError(
                 "selection pushdown: selector must not reference stream "
                 "attributes")
+        self.validate_expr(having)
         return RecordSelection(select, group_by, having, order_by,
                                selector.limit, selector.offset)
 
@@ -544,12 +609,8 @@ class AbstractQueryableRecordTable(AbstractRecordTable):
     def _has_agg(e: RecordExpr) -> bool:
         if isinstance(e, Agg):
             return True
-        for f in getattr(e, "__dataclass_fields__", {}):
-            v = getattr(e, f)
-            if isinstance(v, RecordExpr) and \
-                    AbstractQueryableRecordTable._has_agg(v):
-                return True
-        return False
+        return any(AbstractQueryableRecordTable._has_agg(c)
+                   for c in record_expr_children(e))
 
     def query(self, cond: Optional[CompiledRecordCondition],
               selection: RecordSelection,
@@ -558,13 +619,17 @@ class AbstractQueryableRecordTable(AbstractRecordTable):
         with self.lock:
             root, params = (None, {}) if cond is None else \
                 (cond.root, cond.eval_params(stream_chunk, row_i))
+            rows = list(self.query_records(root, params, selection))
             # ungrouped aggregates over zero matching rows: SQL emits one
-            # NULL/0 row, the host selector emits nothing — keep host parity
-            if not selection.group_by and \
+            # row (NULL sums, 0 counts — or arbitrary values for arithmetic
+            # over them), the host selector emits nothing.  The returned
+            # values cannot distinguish the cases, so the single-row
+            # ungrouped-aggregate shape always pays one existence probe.
+            if len(rows) == 1 and not selection.group_by and \
                     any(self._has_agg(e) for _, e in selection.select) and \
                     not self.contains_records(root, params):
                 return []
-            return list(self.query_records(root, params, selection))
+            return rows
 
 
 # ---------------------------------------------------------------- helpers
@@ -575,13 +640,3 @@ def _records_of(chunk: EventChunk, names) -> List[Dict[str, Any]]:
         out.append({n: _item(chunk.columns[n][i])
                     for n in names if n in chunk.columns})
     return out
-
-
-def _item(v):
-    return v.item() if hasattr(v, "item") else v
-
-
-def _scalar(v):
-    if isinstance(v, np.ndarray) and v.ndim > 0:
-        return v[0]
-    return v

@@ -22,7 +22,7 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 from ..core.record_table import (AbstractQueryableRecordTable, Agg, Arith,
                                  BoolAnd, BoolNot, BoolOr, Cmp, Col, Const,
                                  NullCheck, Param, RecordExpr,
-                                 RecordSelection)
+                                 RecordSelection, record_expr_children)
 from ..query_api.definition import AttrType
 from ..utils.errors import SiddhiAppCreationError
 from ..utils.extension import extension
@@ -36,12 +36,17 @@ _SQL_TYPE = {
 _CMP_SQL = {"==": "=", "!=": "<>", "<": "<", "<=": "<=", ">": ">", ">=": ">="}
 
 
+def _q(ident: str) -> str:
+    """Quote an SQL identifier (embedded quotes doubled)."""
+    return '"' + ident.replace('"', '""') + '"'
+
+
 def _render(e: Optional[RecordExpr]) -> str:
     """RecordExpr → SQL with :name parameter placeholders."""
     if e is None:
         return "1"
     if isinstance(e, Col):
-        return f'"{e.name}"'
+        return _q(e.name)
     if isinstance(e, Const):
         v = e.value
         if isinstance(v, bool):
@@ -62,6 +67,9 @@ def _render(e: Optional[RecordExpr]) -> str:
     if isinstance(e, NullCheck):
         return f"({_render(e.expr)} IS NULL)"
     if isinstance(e, Arith):
+        if e.op == "+" and e.type == "str":
+            # engine `+` on strings is concatenation; SQL `+` coerces to 0
+            return f"({_render(e.left)} || {_render(e.right)})"
         return f"({_render(e.left)} {e.op} {_render(e.right)})"
     if isinstance(e, Agg):
         arg = "*" if e.arg is None else _render(e.arg)
@@ -100,13 +108,25 @@ class SQLiteStore(AbstractQueryableRecordTable):
                 raise SiddhiAppCreationError(
                     f"sqlite store: unsupported attribute type {a.type} "
                     f"for '{a.name}'")
-            cols.append(f'"{a.name}" {t}')
+            cols.append(f'{_q(a.name)} {t}')
         # engine probes may come from any junction/worker thread; all calls
         # are serialized by AbstractRecordTable.lock
         self._conn = sqlite3.connect(db, check_same_thread=False)
         self._conn.execute(
-            f'CREATE TABLE IF NOT EXISTS "{table}" ({", ".join(cols)})')
+            f'CREATE TABLE IF NOT EXISTS {_q(table)} ({", ".join(cols)})')
         self._conn.commit()
+
+    def validate_expr(self, e) -> None:
+        """Refuse IR whose SQLite semantics diverge from the engine's
+        (callers with a host path fall back; others surface the error)."""
+        if e is None:
+            return
+        if isinstance(e, Arith) and e.op == "%" and e.type == "float":
+            raise SiddhiAppCreationError(
+                "sqlite store: '%' on REAL operands truncates to INTEGER "
+                "in SQLite (engine fmod semantics diverge)")
+        for c in record_expr_children(e):
+            self.validate_expr(c)
 
     def _exec(self, sql: str, params=None):
         self.sql_log.append(sql)
@@ -125,8 +145,8 @@ class SQLiteStore(AbstractQueryableRecordTable):
         if not records:
             return
         cols = self.names
-        sql = (f'INSERT INTO "{self._table}" '
-               f'({", ".join(chr(34) + c + chr(34) for c in cols)}) '
+        sql = (f'INSERT INTO {_q(self._table)} '
+               f'({", ".join(_q(c) for c in cols)}) '
                f'VALUES ({", ".join(":" + c for c in cols)})')
         self.sql_log.append(sql)
         self._conn.executemany(
@@ -136,28 +156,29 @@ class SQLiteStore(AbstractQueryableRecordTable):
 
     def find_records(self, condition, params) -> Iterable[Dict[str, Any]]:
         cur = self._exec(
-            f'SELECT {", ".join(chr(34) + c + chr(34) for c in self.names)} '
-            f'FROM "{self._table}" WHERE {_render(condition)}', params)
+            f'SELECT {", ".join(_q(c) for c in self.names)} '
+            f'FROM {_q(self._table)} WHERE {_render(condition)}', params)
         for row in cur.fetchall():
             yield self._row_dict(self.names, row)
 
     def update_records(self, condition, param_rows, assignments) -> None:
-        sets = ", ".join(f'"{col}" = {_render(e)}' for col, e in assignments)
-        sql = (f'UPDATE "{self._table}" SET {sets} '
+        sets = ", ".join(f'{_q(col)} = {_render(e)}'
+                         for col, e in assignments)
+        sql = (f'UPDATE {_q(self._table)} SET {sets} '
                f'WHERE {_render(condition)}')
         for pr in param_rows:
             self._exec(sql, pr)
         self._conn.commit()
 
     def delete_records(self, condition, param_rows) -> None:
-        sql = f'DELETE FROM "{self._table}" WHERE {_render(condition)}'
+        sql = f'DELETE FROM {_q(self._table)} WHERE {_render(condition)}'
         for pr in (param_rows or [{}]):
             self._exec(sql, pr)
         self._conn.commit()
 
     def contains_records(self, condition, params) -> bool:
         cur = self._exec(
-            f'SELECT EXISTS(SELECT 1 FROM "{self._table}" '
+            f'SELECT EXISTS(SELECT 1 FROM {_q(self._table)} '
             f'WHERE {_render(condition)})', params)
         return bool(cur.fetchone()[0])
 
@@ -166,18 +187,18 @@ class SQLiteStore(AbstractQueryableRecordTable):
     def query_records(self, condition, params,
                       selection: RecordSelection) -> Iterable[Dict[str, Any]]:
         names = [n for n, _ in selection.select]
-        cols = ", ".join(f'{_render(e)} AS "{n}"'
+        cols = ", ".join(f'{_render(e)} AS {_q(n)}'
                          for n, e in selection.select)
-        sql = (f'SELECT {cols} FROM "{self._table}" '
+        sql = (f'SELECT {cols} FROM {_q(self._table)} '
                f'WHERE {_render(condition)}')
         if selection.group_by:
             sql += " GROUP BY " + ", ".join(
-                f'"{g}"' for g in selection.group_by)
+                _q(g) for g in selection.group_by)
         if selection.having is not None:
             sql += f" HAVING {_render(selection.having)}"
         if selection.order_by:
             sql += " ORDER BY " + ", ".join(
-                f'"{a}" {"ASC" if asc else "DESC"}'
+                f'{_q(a)} {"ASC" if asc else "DESC"}'
                 for a, asc in selection.order_by)
         if selection.limit is not None or selection.offset is not None:
             sql += f" LIMIT {selection.limit if selection.limit is not None else -1}"

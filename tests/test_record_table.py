@@ -308,6 +308,10 @@ def test_sqlite_empty_table_ungrouped_aggregate_matches_host():
         rt.start()
         events = rt.query("from T select sum(volume) as total")
         assert [tuple(e.data) for e in events] == [], store_ann or "host"
+        # arithmetic over COUNT yields a non-NULL/non-0 SQL value on zero
+        # rows — must still emit nothing (host parity)
+        events = rt.query("from T select count(volume) + 1 as n")
+        assert [tuple(e.data) for e in events] == [], store_ann or "host"
         rt.shutdown()
 
 
@@ -392,6 +396,71 @@ def test_sqlite_bool_column_pushdown_parity():
     events = rt.query("from T select symbol, flag")
     assert [tuple(e.data) for e in events] == [("IBM", True)]
     assert isinstance(events[0].data[1], bool)
+    rt.shutdown()
+
+
+def test_sqlite_string_concat_condition_parity():
+    """Engine `+` on strings is concatenation — the sqlite store must
+    render `||`, not numeric `+`."""
+    results = {}
+    for ann in ("@Store(type='sqlite')", ""):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(f"""
+            define stream S (a string, b string);
+            define stream P (a string);
+            {ann}
+            define table T (a string, b string);
+            from S insert into T;
+            @info(name='q')
+            from P join T on P.a + 'y' == T.b
+            select T.a, T.b insert into OutStream;""")
+        got = []
+        rt.add_callback("OutStream", StreamCallback(
+            lambda evs: got.extend(tuple(e.data) for e in evs)))
+        rt.start()
+        rt.get_input_handler("S").send(["x", "xy"])
+        rt.get_input_handler("P").send(["x"])
+        rt.shutdown()
+        results[ann or "host"] = got
+    assert results["@Store(type='sqlite')"] == results["host"] == \
+        [("x", "xy")]
+
+
+def test_sqlite_float_mod_falls_back_to_host_semantics():
+    """SQLite '%' truncates REALs to INTEGER; the store refuses that
+    condition so the join evaluates it host-side (fmod semantics)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (sym string, v double);
+        define stream P (sym string);
+        @Store(type='sqlite')
+        define table T (sym string, v double);
+        from S insert into T;
+        @info(name='q')
+        from P join T on T.v % 2.0 > 1.0
+        select T.sym, T.v insert into OutStream;""")
+    got = []
+    rt.add_callback("OutStream", StreamCallback(
+        lambda evs: got.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    rt.get_input_handler("S").send(["A", 5.5])     # fmod(5.5,2)=1.5 > 1
+    rt.get_input_handler("S").send(["B", 4.5])     # fmod(4.5,2)=0.5
+    rt.get_input_handler("P").send(["x"])
+    rt.shutdown()
+    assert got == [("A", 5.5)]
+
+
+def test_sqlite_quoted_table_name():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (sym string, v long);
+        @Store(type='sqlite', table='odd "name"')
+        define table T (sym string, v long);
+        from S insert into T;""")
+    rt.start()
+    rt.get_input_handler("S").send(["A", 1])
+    events = rt.query("from T select sym, v")
+    assert [tuple(e.data) for e in events] == [("A", 1)]
     rt.shutdown()
 
 
