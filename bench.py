@@ -42,9 +42,11 @@ sys.path.insert(0, __file__.rsplit("/", 1)[0])
 
 N_PATTERNS = 1000
 N_PARTITIONS = 10_000
-T_PER_BLOCK = 16          # events per partition lane per block (throughput)
+T_PER_BLOCK = 64          # events per partition lane per block (throughput;
+                          # T=64 amortizes the ~18ms fixed per-dispatch cost
+                          # ~25% better than T=16 — docs/perf_notes.md)
 T_LAT_BLOCK = 4           # smaller latency-phase micro-batches
-THRU_BLOCKS = 64          # async-dispatch throughput phase
+THRU_BLOCKS = 32          # async-dispatch throughput phase
 LAT_BLOCKS = 200          # per-block-synchronous latency phase
 N_SLOTS = 8
 MATCH_RING = 4            # decoded match payloads per pattern per block
