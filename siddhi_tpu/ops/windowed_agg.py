@@ -93,6 +93,90 @@ def build_wagg_step(window: int, want_minmax: bool = False):
     return step
 
 
+# ------------------------------------------------------------- time windows
+
+TS_EMPTY = np.iinfo(np.int32).min    # empty-slot timestamp marker
+
+
+class TimeWaggCarry(NamedTuple):
+    ring: jnp.ndarray      # [P, W] f32 — last W accepted values
+    ring_ts: jnp.ndarray   # [P, W] i32 — ts offsets (TS_EMPTY = empty);
+    #                        offsets from the compiler's rebasing base —
+    #                        x64 is disabled under jit, so absolute ms
+    #                        don't fit (plan/wagg_compiler rebases)
+    pos: jnp.ndarray       # [P] i32
+    cnt: jnp.ndarray       # [P] i32 — entries written (≤ W)
+    last_ts: jnp.ndarray   # [P] i32 — most recent accepted ts offset
+    overflow: jnp.ndarray  # [P] bool — sticky: a still-in-window entry was
+    #                        evicted (results undercount; caller must grow
+    #                        the capacity and replay the block)
+
+
+def make_time_wagg_carry(n_partitions: int, capacity: int) -> TimeWaggCarry:
+    return TimeWaggCarry(
+        ring=jnp.zeros((n_partitions, capacity), jnp.float32),
+        ring_ts=jnp.full((n_partitions, capacity), TS_EMPTY, jnp.int32),
+        pos=jnp.zeros((n_partitions,), jnp.int32),
+        cnt=jnp.zeros((n_partitions,), jnp.int32),
+        last_ts=jnp.zeros((n_partitions,), jnp.int32),
+        overflow=jnp.zeros((n_partitions,), bool))
+
+
+def build_time_wagg_step(window_ms: int, capacity: int,
+                         want_minmax: bool = False):
+    """Sliding time(t) aggregation: fn(carry, values [P,T], ts [P,T] i32
+    offsets, accepted [P,T]) → (carry, (sums, counts[, mins, maxs])).
+
+    The ring materialises the window's events (value + ts offset); each
+    accepted event's output is an exact masked reduction over entries with
+    `entry_ts > event_ts - window_ms` — the host TimeWindowProcessor's
+    expiry boundary (entries at ts <= now - window expire first,
+    core/window.py TimeWindowProcessor._collect_expired).  No incremental
+    subtract state: expiry is implicit in the mask, so sums are exact and
+    min/max come free.  When an eviction would discard a still-in-window
+    entry the lane's sticky `overflow` flag sets — results undercount and
+    the caller must grow the capacity and replay from the previous carry.
+
+    Per-event semantics: each event expires by ITS OWN timestamp (the host
+    oracle batches expiry at the chunk's final timestamp, so a multi-event
+    chunk spanning an expiry boundary can differ; the planner feeds this
+    kernel per-junction-chunk exactly as the host path receives them)."""
+
+    iota = jnp.arange(capacity)
+
+    def lane_step(carry, xs):
+        ring, rts, pos, cnt, last_ts, ovf = carry
+        x, t, ok = xs
+        oh = iota == pos
+        old_ts = jnp.sum(jnp.where(oh, rts, 0))
+        evicting_live = (cnt == capacity) & (old_ts > t - window_ms)
+        ovf2 = ovf | (ok & evicting_live)
+        ring2 = jnp.where(ok & oh, x, ring)
+        rts2 = jnp.where(ok & oh, t, rts)
+        pos2 = jnp.where(ok, (pos + 1) % capacity, pos)
+        cnt2 = jnp.where(ok, jnp.minimum(cnt + 1, capacity), cnt)
+        last2 = jnp.where(ok, t, last_ts)
+        valid = (iota < cnt2) & (rts2 > t - window_ms)
+        s = jnp.sum(jnp.where(valid, ring2, 0.0))
+        c = jnp.sum(valid.astype(jnp.int32))
+        if want_minmax:
+            mn = jnp.min(jnp.where(valid, ring2, jnp.inf))
+            mx = jnp.max(jnp.where(valid, ring2, -jnp.inf))
+            out = (s, c, mn, mx)
+        else:
+            out = (s, c)
+        return (ring2, rts2, pos2, cnt2, last2, ovf2), out
+
+    def per_lane(carry_l, values_l, ts_l, ok_l):
+        return jax.lax.scan(lane_step, carry_l, (values_l, ts_l, ok_l))
+
+    def step(carry: TimeWaggCarry, values, ts, accepted):
+        new_c, outs = jax.vmap(per_lane)(tuple(carry), values, ts, accepted)
+        return TimeWaggCarry(*new_c), outs
+
+    return step
+
+
 # --------------------------------------------------------------- pallas path
 
 LANES = 128

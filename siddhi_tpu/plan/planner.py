@@ -379,6 +379,7 @@ class DeviceWindowedAggRuntime:
                     for a in self.cwa.input_definition.attributes
                     if self._dtype_for(a.type) is not object}
             warm["__ts"] = np.zeros((P, 1), np.int32)
+            warm["__ts64"] = np.zeros((P, 1), np.int64)
             warm["__valid"] = np.zeros((P, 1), bool)
             self.cwa.process_block(warm)
         except SiddhiAppCreationError:
@@ -421,6 +422,11 @@ class DeviceWindowedAggRuntime:
                                   np.zeros(n, np.int32), P,
                                   base_ts=int(ts_arr[0]), pad_t_pow2=True,
                                   return_rows=True)
+        # absolute i64 ts lanes: the time-window kernel's expiry must be
+        # comparable ACROSS blocks (the packed __ts is per-block offsets)
+        ts64 = np.zeros(block["__ts"].shape, np.int64)
+        ts64[lanes, rows] = ts_arr
+        block["__ts64"] = ts64
         outs = self.cwa.process_block(block)
         sums = np.asarray(outs[0])
         counts = np.asarray(outs[1])

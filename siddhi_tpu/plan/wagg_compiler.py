@@ -21,10 +21,15 @@ from ..query_api import Filter, Query, SingleInputStream, WindowHandler
 from ..query_api.expression import AttributeFunction, Constant, Variable
 from ..utils.errors import SiddhiAppCreationError
 from .expr_compiler import EvalCtx, ExprCompiler, Scope
-from ..ops.windowed_agg import (LANES, WaggCarry, build_wagg_step,
-                                build_wagg_step_pallas, make_wagg_carry)
+from ..ops.windowed_agg import (LANES, TimeWaggCarry, WaggCarry,
+                                build_time_wagg_step, build_wagg_step,
+                                build_wagg_step_pallas, make_time_wagg_carry,
+                                make_wagg_carry)
 
 _AGGS = {"sum", "count", "avg", "min", "max"}
+
+TIME_CAPACITY_START = 64      # initial time-window ring capacity (doubles
+                              # on overflow; the caller replays the block)
 
 
 class CompiledWindowedAgg:
@@ -49,10 +54,19 @@ class CompiledWindowedAgg:
             raise SiddhiAppCreationError(
                 "windowed-agg path needs a single input stream")
         wh = s.window_handler
-        if wh is None or wh.name.lower() != "length":
+        kind = (wh.name.lower() if wh is not None else "")
+        if kind == "length":
+            self.window_kind = "length"
+            self.window = int(wh.params[0].value)
+        elif kind == "time":
+            self.window_kind = "time"
+            self.window_ms = int(wh.params[0].value)
+            self.window = TIME_CAPACITY_START
+            self._ts_base = None      # i64→i32 offset rebasing base
+        else:
             raise SiddhiAppCreationError(
-                "windowed-agg path needs #window.length(n)")
-        self.window = int(wh.params[0].value)
+                "windowed-agg path needs #window.length(n) or "
+                "#window.time(t)")
         definition = app.stream_definitions[s.stream_id]
 
         scope = Scope()
@@ -99,15 +113,24 @@ class CompiledWindowedAgg:
         self.n_partitions = n_partitions
         self.t_per_block = t_per_block
         if use_pallas is None:
-            use_pallas = jax.devices()[0].platform == "tpu" and \
+            use_pallas = self.window_kind == "length" and \
+                jax.devices()[0].platform == "tpu" and \
                 n_partitions % LANES == 0
-        step = (build_wagg_step_pallas(self.window, t_per_block,
-                                       self.want_minmax)
-                if use_pallas else build_wagg_step(self.window,
-                                                   self.want_minmax))
         self.use_pallas = use_pallas
+        self._build_step()
+        self.carry = self._make_carry(n_partitions)
 
-        def full_step(carry: WaggCarry, block: Dict[str, jnp.ndarray]):
+    def _build_step(self):
+        if self.window_kind == "length":
+            step = (build_wagg_step_pallas(self.window, self.t_per_block,
+                                           self.want_minmax)
+                    if self.use_pallas
+                    else build_wagg_step(self.window, self.want_minmax))
+        else:
+            step = build_time_wagg_step(self.window_ms, self.window,
+                                        self.want_minmax)
+
+        def full_step(carry, block: Dict[str, jnp.ndarray]):
             # filter + projection: one fused elementwise program over [P, T]
             n = block["__ts"].size
             cols = {k: v.reshape(-1) for k, v in block.items()
@@ -122,10 +145,22 @@ class CompiledWindowedAgg:
                 if self.value is not None else jnp.zeros(ok.shape,
                                                          jnp.float32))
             shape = block["__ts"].shape
+            if self.window_kind == "time":
+                # i32 ts offsets (rebased in process_block) for
+                # cross-block window expiry
+                return step(carry, vals.reshape(shape), block["__ts32"],
+                            ok.reshape(shape))
             return step(carry, vals.reshape(shape), ok.reshape(shape))
 
-        self._step = jax.jit(full_step, donate_argnums=0)
-        self.carry = make_wagg_carry(n_partitions, self.window)
+        # no donation on the time path: overflow replay re-steps the block
+        # from the PREVIOUS carry, which donation would have invalidated
+        donate = (0,) if self.window_kind == "length" else ()
+        self._step = jax.jit(full_step, donate_argnums=donate)
+
+    def _make_carry(self, n: int):
+        return (make_wagg_carry(n, self.window)
+                if self.window_kind == "length"
+                else make_time_wagg_carry(n, self.window))
 
     def grow(self, n_partitions: int) -> None:
         """Widen the group-lane axis (keyed partitioning slab growth)."""
@@ -133,31 +168,143 @@ class CompiledWindowedAgg:
             return
         if self.use_pallas and n_partitions % LANES:
             n_partitions = ((n_partitions // LANES) + 1) * LANES
-        fresh = make_wagg_carry(n_partitions - self.n_partitions, self.window)
-        self.carry = WaggCarry(*[jnp.concatenate([a, b], axis=0)
-                                 for a, b in zip(self.carry, fresh)])
+        fresh = self._make_carry(n_partitions - self.n_partitions)
+        self.carry = type(self.carry)(
+            *[jnp.concatenate([a, b], axis=0)
+              for a, b in zip(self.carry, fresh)])
         self.n_partitions = n_partitions
+
+    # ------------------------------------------------- time-window capacity
+
+    def overflowed(self) -> bool:
+        """True if any lane evicted a still-in-window entry (time mode) —
+        the just-processed block's results undercount; grow and replay."""
+        return self.window_kind == "time" and \
+            bool(np.asarray(self.carry.overflow).any())
+
+    def grow_capacity(self, new_capacity: int) -> None:
+        """Double the time-window ring (keeps entries, chronological
+        compaction so the slot-fill invariant `valid slots = [0, cnt)`
+        holds in the new ring)."""
+        from ..ops.windowed_agg import TS_EMPTY
+        assert self.window_kind == "time"
+        if new_capacity <= self.window:
+            return
+        old = self.carry
+        P, W = np.asarray(old.ring).shape
+        ring = np.asarray(old.ring)
+        rts = np.asarray(old.ring_ts)
+        cnt = np.array(old.cnt)        # writable copy (compacted counts)
+        new_ring = np.zeros((P, new_capacity), np.float32)
+        new_rts = np.full((P, new_capacity), TS_EMPTY, np.int32)
+        # chronological order survives argsort on ts (TS_EMPTY = empty
+        # sorts first and is dropped)
+        order = np.argsort(rts, axis=1, kind="stable")
+        keep = np.take_along_axis(rts, order, 1) != TS_EMPTY
+        for p in range(P):                      # host-side, grow-time only
+            sel = order[p][keep[p]]
+            k = len(sel)
+            new_ring[p, :k] = ring[p, sel]
+            new_rts[p, :k] = rts[p, sel]
+            cnt[p] = k
+        self.window = new_capacity
+        self.carry = TimeWaggCarry(
+            ring=jnp.asarray(new_ring), ring_ts=jnp.asarray(new_rts),
+            pos=jnp.asarray(cnt % new_capacity, jnp.int32),
+            cnt=jnp.asarray(cnt, jnp.int32),
+            last_ts=old.last_ts,
+            overflow=jnp.zeros((P,), bool))
+        self._build_step()
 
     def current_state(self) -> dict:
         return {"carry": [np.asarray(a) for a in self.carry],
-                "n_partitions": self.n_partitions}
+                "n_partitions": self.n_partitions,
+                "window_kind": self.window_kind, "window": self.window,
+                "ts_base": getattr(self, "_ts_base", None)}
 
     def restore_state(self, state: dict) -> None:
         self.n_partitions = state["n_partitions"]
-        self.carry = WaggCarry(*[jnp.asarray(a) for a in state["carry"]])
+        if state.get("window", self.window) != self.window and \
+                self.window_kind == "time":
+            self.window = state["window"]
+            self._build_step()
+        if self.window_kind == "time":
+            self._ts_base = state.get("ts_base")
+        cls = WaggCarry if self.window_kind == "length" else TimeWaggCarry
+        self.carry = cls(*[jnp.asarray(a) for a in state["carry"]])
 
     def process_block(self, block):
-        """block: [P, T] packed lanes (ops.nfa.pack_blocks) →
-        (sums [P, T], counts [P, T][, mins, maxs]) running aggregates."""
-        self.carry, outs = self._step(self.carry, block)
-        return outs
+        """block: [P, T] packed lanes (ops.nfa.pack_blocks; time mode also
+        needs block['__ts64'] absolute i64 lanes) →
+        (sums [P, T], counts [P, T][, mins, maxs]) running aggregates.
+        Time mode: on slot overflow, grows the ring and replays the block
+        from the pre-block carry, so results are always exact."""
+        if self.window_kind == "length":
+            block = {k: v for k, v in block.items() if k != "__ts64"}
+            self.carry, outs = self._step(self.carry, block)
+            return outs
+        block = self._with_ts_offsets(block)
+        while True:
+            prev = self.carry
+            self.carry, outs = self._step(prev, block)
+            if not self.overflowed():
+                return outs
+            self.carry = prev
+            self.grow_capacity(self.window * 2)
+
+    def _with_ts_offsets(self, block) -> Dict[str, jnp.ndarray]:
+        """Derive the kernel's i32 `__ts32` lanes from the block's absolute
+        i64 `__ts64` lanes, rebasing the carry when offsets approach i32
+        range (x64 is disabled under jit; ~24.8 days of stream time per
+        base — same treatment as the NFA path's ts rebase)."""
+        from ..ops.windowed_agg import TS_EMPTY
+        ts_abs = np.asarray(block["__ts64"], np.int64)
+        valid = np.asarray(block["__valid"])
+        if self._ts_base is None:
+            self._ts_base = int(ts_abs[valid].min()) if valid.any() else 0
+        offs = ts_abs - self._ts_base
+        mx = int(offs[valid].max()) if valid.any() else 0
+        if mx >= 2**31 - 1:
+            delta = int(offs[valid].min())
+            self._ts_base += delta
+            offs = offs - delta
+            if valid.any() and int(offs[valid].max()) >= 2**31 - 1:
+                # one chunk spanning ≥ ~24.8 days of stream time cannot be
+                # rebased — fail loudly rather than wrap i32 silently
+                raise SiddhiAppCreationError(
+                    "time-window device path: a single chunk spans more "
+                    "than 2^31 ms of stream time; split the replay into "
+                    "smaller chunks or use @app:engine('host')")
+            rts = np.asarray(self.carry.ring_ts, np.int64)
+            rts = np.where(rts == TS_EMPTY, TS_EMPTY,
+                           np.maximum(rts - delta, TS_EMPTY + 1))
+            last = np.clip(np.asarray(self.carry.last_ts, np.int64) - delta,
+                           TS_EMPTY + 1, None)
+            self.carry = self.carry._replace(
+                ring_ts=jnp.asarray(rts.astype(np.int32)),
+                last_ts=jnp.asarray(last.astype(np.int32)))
+        out = {k: v for k, v in block.items() if k != "__ts64"}
+        out["__ts32"] = jnp.asarray(
+            np.where(valid, offs, 0).astype(np.int32))
+        return out
 
     def current_aggregates(self) -> Dict[str, np.ndarray]:
         """Per-lane aggregate values right now."""
-        s = np.asarray(self.carry.runsum)
-        c = np.asarray(self.carry.cnt)
+        if self.window_kind == "time":
+            ring = np.asarray(self.carry.ring)
+            rts = np.asarray(self.carry.ring_ts)
+            cnt = np.asarray(self.carry.cnt)
+            now = np.asarray(self.carry.last_ts)
+            valid = (np.arange(self.window)[None, :] < cnt[:, None]) & \
+                (rts > (now - self.window_ms)[:, None])
+            s = np.where(valid, ring, 0.0).sum(axis=1)
+            c = valid.sum(axis=1)
+        else:
+            s = np.asarray(self.carry.runsum)
+            c = np.asarray(self.carry.cnt)
+            ring = None               # D2H of the [P, W] ring only if a
+            valid = None              # min/max output actually needs it
         out = {}
-        ring = None
         for name, kind, _attr in self.outputs:
             if kind == "sum":
                 out[name] = s
@@ -170,8 +317,9 @@ class CompiledWindowedAgg:
             elif kind in ("min", "max"):
                 if ring is None:
                     ring = np.asarray(self.carry.ring)
-                valid = np.arange(self.window)[None, :] < c[:, None]
+                    valid = np.arange(self.window)[None, :] < c[:, None]
                 fill = np.inf if kind == "min" else -np.inf
                 red = np.min if kind == "min" else np.max
-                out[name] = red(np.where(valid, ring, fill), axis=1)
+                masked = np.where(valid, ring, fill)
+                out[name] = red(masked, axis=1)
         return out

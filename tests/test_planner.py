@@ -287,6 +287,37 @@ def test_partitioned_windowed_agg_device_parity():
         assert a[5] == pytest.approx(b[5], abs=1e-4)     # max
 
 
+TIME_WAGG_PART_APP = """
+    define stream S (k int, v float);
+    partition with (k of S) begin
+    @info(name='q')
+    from S[v > 2.0]#window.time(200)
+    select k, sum(v) as total, count() as n, min(v) as lo, max(v) as hi
+    group by k
+    insert into Out;
+    end;
+"""
+
+
+def test_partitioned_time_window_device_parity():
+    """Sliding time windows route to the device ring kernel (masked-
+    reduction expiry); per-event running aggregates match the host per-key
+    instances across expiry boundaries (sends are 10ms apart, window
+    200ms, so entries continuously expire)."""
+    rng = np.random.default_rng(23)
+    rows = [[int(rng.integers(0, 7)),
+             float(np.float32(rng.uniform(0, 10)))] for _ in range(120)]
+    dm_h, host = run_partition(TIME_WAGG_PART_APP, rows, engine="host")
+    dm_d, dev = run_partition(TIME_WAGG_PART_APP, rows)
+    assert not dm_h and dm_d
+    assert len(host) == len(dev) > 0
+    for a, b in zip(host, dev):
+        assert a[0] == b[0] and a[2] == b[2]
+        assert a[1] == pytest.approx(b[1], abs=1e-3)
+        assert a[3] == pytest.approx(b[3], abs=1e-4)
+        assert a[4] == pytest.approx(b[4], abs=1e-4)
+
+
 def test_wagg_int_sum_falls_back_to_host():
     """Exact integer sums can't ride float32 lanes — host fallback."""
     app = WAGG_PART_APP.replace("v float", "v int").replace("v > 2.0",

@@ -196,6 +196,144 @@ def test_wagg_minmax_end_to_end_vs_oracle():
         assert got["hi"][k] == pytest.approx(hi, rel=1e-6), k
 
 
+TIME_APP = """
+define stream S (k int, v float);
+@info(name='q')
+from S[v > 2.0]#window.time(1 sec)
+select k, sum(v) as total, count() as n, min(v) as lo, max(v) as hi
+group by k
+insert into Out;
+"""
+
+
+def _naive_time_window(pids, vals, ts, span_ms, accepted):
+    """Per-event sliding-time reference: (sum, count, min, max) over each
+    lane's events with ts_e > ts_now - span."""
+    out = {}
+    hist = {}
+    results = []
+    for p, v, t, ok in zip(pids, vals, ts, accepted):
+        if not ok:
+            results.append(None)
+            continue
+        h = hist.setdefault(p, [])
+        h.append((t, v))
+        live = [(tt, vv) for tt, vv in h if tt > t - span_ms]
+        hist[p] = live
+        vs = [vv for _, vv in live]
+        results.append((sum(vs), len(vs), min(vs), max(vs)))
+    return results
+
+
+def test_time_wagg_kernel_matches_naive():
+    import jax
+    from siddhi_tpu.ops.windowed_agg import (build_time_wagg_step,
+                                             make_time_wagg_carry)
+    P, T, W, SPAN = 4, 128, 16, 50
+    rng = np.random.default_rng(9)
+    values = rng.uniform(0, 10, (P, T)).astype(np.float32)
+    ts = np.cumsum(rng.integers(1, 20, (P, T)), axis=1).astype(np.int32)
+    accepted = rng.random((P, T)) < 0.8
+    step = jax.jit(build_time_wagg_step(SPAN, W, want_minmax=True))
+    carry, (s, c, mn, mx) = step(make_time_wagg_carry(P, W), values,
+                                 ts, accepted)
+    assert not np.asarray(carry.overflow).any()
+    s, c = np.asarray(s), np.asarray(c)
+    mn, mx = np.asarray(mn), np.asarray(mx)
+    for p in range(P):
+        ref = _naive_time_window([p] * T, values[p], ts[p], SPAN,
+                                 accepted[p])
+        for t in range(T):
+            if ref[t] is None:
+                continue
+            rs, rc, rmn, rmx = ref[t]
+            assert c[p, t] == rc, (p, t)
+            assert s[p, t] == pytest.approx(rs, rel=1e-5), (p, t)
+            assert mn[p, t] == pytest.approx(rmn), (p, t)
+            assert mx[p, t] == pytest.approx(rmx), (p, t)
+
+
+def test_time_wagg_conformance_vs_oracle():
+    """End-to-end: CompiledWindowedAgg time mode vs the partitioned host
+    oracle, absolute epoch-scale timestamps (exercises the i32 rebase)."""
+    n_partitions = 8
+    rng = np.random.default_rng(6)
+    n = 300
+    pids = rng.integers(0, n_partitions, n)
+    vals = rng.uniform(0.0, 10.0, n).astype(np.float32)
+    base = 1 << 41                      # ~2.2e12: epoch-like ms
+    ts = base + np.cumsum(rng.integers(1, 300, n)).astype(np.int64)
+    agg = CompiledWindowedAgg(TIME_APP, n_partitions=n_partitions,
+                              use_pallas=False)
+    cols = {"k": pids.astype(np.float32), "v": vals}
+    i = 0
+    while i < n:
+        j = min(i + 100, n)
+        block, rows = pack_blocks(pids[i:j],
+                                  {k: v[i:j] for k, v in cols.items()},
+                                  ts[i:j], np.zeros(j - i, np.int32),
+                                  n_partitions, base_ts=int(ts[i]),
+                                  return_rows=True)
+        ts64 = np.zeros(block["__ts"].shape, np.int64)
+        ts64[pids[i:j], rows] = ts[i:j]
+        block["__ts64"] = ts64
+        agg.process_block(block)
+        i = j
+    got = agg.current_aggregates()
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:playback
+        define stream S (k int, v float);
+        partition with (k of S) begin
+        @info(name='q')
+        from S[v > 2.0]#window.time(1 sec)
+        select k, sum(v) as total, count() as n, min(v) as lo, max(v) as hi
+        group by k insert into Out; end;
+    """)
+    last = {}
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: [last.__setitem__(e.data[0], tuple(e.data[1:]))
+                     for e in evs]))
+    rt.start()
+    rt.get_input_handler("S").send_batch(
+        {"k": pids.astype(np.int32), "v": vals}, timestamps=ts)
+    rt.shutdown()
+    assert last, "oracle produced nothing"
+    for k, (total, cnt, lo, hi) in last.items():
+        assert int(got["n"][k]) == cnt, k
+        assert got["total"][k] == pytest.approx(total, rel=1e-4), k
+        assert got["lo"][k] == pytest.approx(lo, rel=1e-6), k
+        assert got["hi"][k] == pytest.approx(hi, rel=1e-6), k
+
+
+def test_time_wagg_overflow_grows_and_stays_exact(monkeypatch):
+    """More in-window events than ring capacity: the compiler grows the
+    ring and replays the block — results stay exact."""
+    import siddhi_tpu.plan.wagg_compiler as wc
+    monkeypatch.setattr(wc, "TIME_CAPACITY_START", 4)
+    agg = CompiledWindowedAgg(TIME_APP, n_partitions=2, use_pallas=False)
+    assert agg.window == 4
+    n = 40                              # 40 events inside one 1s window
+    pids = np.zeros(n, np.int64)
+    vals = np.linspace(3.0, 9.0, n).astype(np.float32)
+    ts = 1_000_000 + np.arange(n, dtype=np.int64) * 10
+    block, rows = pack_blocks(pids, {"k": pids.astype(np.float32),
+                                     "v": vals}, ts,
+                              np.zeros(n, np.int32), 2,
+                              base_ts=int(ts[0]), return_rows=True)
+    ts64 = np.zeros(block["__ts"].shape, np.int64)
+    ts64[pids, rows] = ts
+    block["__ts64"] = ts64
+    agg.process_block(block)
+    assert agg.window >= n              # grew past the event count
+    got = agg.current_aggregates()
+    assert int(got["n"][0]) == n
+    assert got["total"][0] == pytest.approx(float(vals.sum()), rel=1e-5)
+    assert got["lo"][0] == pytest.approx(3.0)
+    assert got["hi"][0] == pytest.approx(9.0)
+
+
 def test_wagg_rejects_distinct_aggregate_args():
     """sum(x) + avg(y) can't share the single value lane — must be rejected
     at compile time, not silently aggregate the wrong column."""
