@@ -1,0 +1,28 @@
+"""Shared int32 timestamp-offset machinery for device kernels.
+
+x64 is disabled under jit, so device timestamps ride int32 ms offsets from
+a host-held base; after ~24.8 days of stream time the base must move
+("rebase") and every carried timestamp shifts with it.  Both device paths —
+the NFA (plan/nfa_compiler._maybe_rebase) and the time-window aggregation
+ring (plan/wagg_compiler._with_ts_offsets) — use these helpers so their
+clamp/headroom semantics stay identical.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def safe_max(slack_ms: int) -> int:
+    """Largest representable offset, leaving headroom for `offset + slack`
+    arithmetic (expiry subtraction, deadline addition) plus a 2^21 guard
+    band so a whole ingest block fits past the check."""
+    return (1 << 31) - (1 << 21) - (slack_ms + 1)
+
+
+def shift_clamped(v, delta: int, lo: int) -> jnp.ndarray:
+    """Shift carried int32 ts offsets down by `delta`, clamping at `lo`
+    in int64 so an arbitrarily large delta can't wrap int32 (anything at
+    the clamp floor is expired at every future ts)."""
+    s = np.asarray(v, np.int64) - delta
+    return jnp.asarray(np.maximum(s, lo).astype(np.int32))

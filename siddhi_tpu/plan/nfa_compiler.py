@@ -745,9 +745,9 @@ class CompiledPatternNFA:
 
     def _ts_safe_max(self) -> int:
         # keep ts - slot_start inside int32 even for a slot clamped to
-        # -(within+1): max offset + within + 1 must stay below int32 max
-        w = self.spec.within_ms or 0
-        return (1 << 31) - (1 << 21) - (w + 1)
+        # -(within+1) (shared headroom policy: ops/ts32.py)
+        from ..ops.ts32 import safe_max
+        return safe_max(self.spec.within_ms or 0)
 
     def _maybe_rebase(self, ts_min: int, ts_max: int) -> None:
         """Timestamps ride int32 ms offsets from base_ts, which overflows
@@ -768,17 +768,14 @@ class CompiledPatternNFA:
         # expired regardless of how old, and -(within+1) reads as expired
         # at every ts >= 0 without the expiry subtraction ever leaving
         # int32 range (see _ts_safe_max)
+        from ..ops.ts32 import shift_clamped
         lo = -(self.spec.within_ms + 1) \
             if self.spec.within_ms is not None else 0
-
-        def shift(v, lo_v):
-            s = np.asarray(v, np.int64) - delta
-            return jnp.asarray(np.maximum(s, lo_v).astype(np.int32))
-        carry["slot_start"] = shift(carry["slot_start"], lo)
-        carry["slot_enter"] = shift(carry["slot_enter"], lo)
+        carry["slot_start"] = shift_clamped(carry["slot_start"], delta, lo)
+        carry["slot_enter"] = shift_clamped(carry["slot_enter"], delta, lo)
         if "deadline" in carry:
             # a deadline already due stays due at any clamp ≥ lo
-            carry["deadline"] = shift(carry["deadline"], lo)
+            carry["deadline"] = shift_clamped(carry["deadline"], delta, lo)
         self.carry = carry
         self.base_ts += delta
 

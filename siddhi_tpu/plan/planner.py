@@ -422,11 +422,12 @@ class DeviceWindowedAggRuntime:
                                   np.zeros(n, np.int32), P,
                                   base_ts=int(ts_arr[0]), pad_t_pow2=True,
                                   return_rows=True)
-        # absolute i64 ts lanes: the time-window kernel's expiry must be
-        # comparable ACROSS blocks (the packed __ts is per-block offsets)
-        ts64 = np.zeros(block["__ts"].shape, np.int64)
-        ts64[lanes, rows] = ts_arr
-        block["__ts64"] = ts64
+        if self.cwa.window_kind == "time":
+            # absolute i64 ts lanes: the time kernel's expiry must be
+            # comparable ACROSS blocks (packed __ts is per-block offsets)
+            ts64 = np.zeros(block["__ts"].shape, np.int64)
+            ts64[lanes, rows] = ts_arr
+            block["__ts64"] = ts64
         outs = self.cwa.process_block(block)
         sums = np.asarray(outs[0])
         counts = np.asarray(outs[1])

@@ -257,32 +257,39 @@ class CompiledWindowedAgg:
         i64 `__ts64` lanes, rebasing the carry when offsets approach i32
         range (x64 is disabled under jit; ~24.8 days of stream time per
         base — same treatment as the NFA path's ts rebase)."""
+        from ..ops.ts32 import safe_max, shift_clamped
         from ..ops.windowed_agg import TS_EMPTY
         ts_abs = np.asarray(block["__ts64"], np.int64)
         valid = np.asarray(block["__valid"])
+        if not valid.any():
+            # all-padding block (planner warm trace): don't pin the base
+            out = {k: v for k, v in block.items() if k != "__ts64"}
+            out["__ts32"] = jnp.zeros(ts_abs.shape, jnp.int32)
+            return out
         if self._ts_base is None:
-            self._ts_base = int(ts_abs[valid].min()) if valid.any() else 0
+            self._ts_base = int(ts_abs[valid].min())
         offs = ts_abs - self._ts_base
-        mx = int(offs[valid].max()) if valid.any() else 0
-        if mx >= 2**31 - 1:
+        mx = int(offs[valid].max())
+        safe = safe_max(self.window_ms)
+        if mx > safe:
             delta = int(offs[valid].min())
             self._ts_base += delta
             offs = offs - delta
-            if valid.any() and int(offs[valid].max()) >= 2**31 - 1:
+            if int(offs[valid].max()) > safe:
                 # one chunk spanning ≥ ~24.8 days of stream time cannot be
                 # rebased — fail loudly rather than wrap i32 silently
                 raise SiddhiAppCreationError(
                     "time-window device path: a single chunk spans more "
-                    "than 2^31 ms of stream time; split the replay into "
+                    "than ~24 days of stream time; split the replay into "
                     "smaller chunks or use @app:engine('host')")
+            # empty slots stay TS_EMPTY; live entries clamp just above it
+            # (the clamp floor is expired at every future ts)
             rts = np.asarray(self.carry.ring_ts, np.int64)
-            rts = np.where(rts == TS_EMPTY, TS_EMPTY,
-                           np.maximum(rts - delta, TS_EMPTY + 1))
-            last = np.clip(np.asarray(self.carry.last_ts, np.int64) - delta,
-                           TS_EMPTY + 1, None)
-            self.carry = self.carry._replace(
-                ring_ts=jnp.asarray(rts.astype(np.int32)),
-                last_ts=jnp.asarray(last.astype(np.int32)))
+            shifted = shift_clamped(rts, delta, TS_EMPTY + 1)
+            rts32 = jnp.where(jnp.asarray(rts == TS_EMPTY),
+                              jnp.int32(TS_EMPTY), shifted)
+            last = shift_clamped(self.carry.last_ts, delta, TS_EMPTY + 1)
+            self.carry = self.carry._replace(ring_ts=rts32, last_ts=last)
         out = {k: v for k, v in block.items() if k != "__ts64"}
         out["__ts32"] = jnp.asarray(
             np.where(valid, offs, 0).astype(np.int32))

@@ -1,0 +1,201 @@
+"""Fault-stream + junction conformance ported from the reference corpus
+(stream/FaultStreamTestCase — custom throwing extension, @OnError LOG vs
+STREAM, `!stream` consumers; stream/JunctionTestCase — fan-out and relay;
+stream/CallbackTestCase — stream callbacks by id)."""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.query_api.definition import AttrType
+from siddhi_tpu.utils.extension import FunctionExtension
+
+STREAMS = "define stream cseEventStream (symbol string, price float, " \
+    "volume long);\n"
+
+
+class FaultFunction(FunctionExtension):
+    """≙ the reference's custom:fault() test extension
+    (stream/FaultFunctionExtension.java): throws during evaluation."""
+    return_type = AttrType.DOUBLE
+
+    def apply(self, *args):
+        raise RuntimeError("Error when running the function fault()")
+
+
+def _mgr():
+    m = SiddhiManager()
+    m.set_extension("custom:fault", FaultFunction)
+    return m
+
+
+def _run(m, app, sends, streams=("outputStream",)):
+    rt = m.create_siddhi_app_runtime(app)
+    got = {s: [] for s in streams}
+    for s in streams:
+        rt.add_callback(s, StreamCallback(
+            lambda evs, _s=s: got[_s].extend(tuple(e.data) for e in evs)))
+    rt.start()
+    for sid, row in sends:
+        try:
+            rt.get_input_handler(sid).send(row)
+        except Exception:  # noqa: BLE001 — LOG action surfaces to sender
+            pass
+    rt.shutdown()
+    return got
+
+
+# -------------------------------------------------- FaultStreamTestCase
+
+def test_fault_default_log_no_output():
+    """faultStreamTest1: no @OnError — the failing event produces no
+    output and the engine keeps running."""
+    got = _run(_mgr(), STREAMS + """
+        @info(name='query1')
+        from cseEventStream[custom:fault() > volume]
+        select symbol, price insert into outputStream;""",
+        [("cseEventStream", ["IBM", 0.0, 100]),
+         ("cseEventStream", ["WSO2", 1.0, 10])])
+    assert got["outputStream"] == []
+
+
+def test_fault_explicit_log_action():
+    """faultStreamTest2: @OnError(action='log') behaves like the default."""
+    got = _run(_mgr(), """
+        @OnError(action='log')
+        """ + STREAMS + """
+        @info(name='query1')
+        from cseEventStream[custom:fault() > volume]
+        select symbol, price insert into outputStream;""",
+        [("cseEventStream", ["IBM", 0.0, 100])])
+    assert got["outputStream"] == []
+
+
+def test_fault_stream_action_unconsumed():
+    """faultStreamTest3: action='stream' with no !stream consumer — the
+    fault event is dropped silently, normal output stays empty."""
+    got = _run(_mgr(), """
+        @OnError(action='stream')
+        """ + STREAMS + """
+        @info(name='query1')
+        from cseEventStream[custom:fault() > volume]
+        select symbol, price insert into outputStream;""",
+        [("cseEventStream", ["IBM", 0.0, 100])])
+    assert got["outputStream"] == []
+
+
+def test_fault_stream_consumer_receives_error_payload():
+    """faultStreamTest4: a `from !cseEventStream` query sees the failing
+    event's attributes plus _error."""
+    m = _mgr()
+    rt = m.create_siddhi_app_runtime("""
+        @OnError(action='stream')
+        """ + STREAMS + """
+        @info(name='query1')
+        from cseEventStream[custom:fault() > volume]
+        select symbol, price insert into outputStream;
+        @info(name='query2')
+        from !cseEventStream
+        select symbol, price, _error insert into faultStream;""")
+    ok, fault = [], []
+    rt.add_callback("outputStream", StreamCallback(
+        lambda evs: ok.extend(tuple(e.data) for e in evs)))
+    rt.add_callback("faultStream", StreamCallback(
+        lambda evs: fault.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    rt.get_input_handler("cseEventStream").send(["IBM", 0.0, 100])
+    rt.shutdown()
+    assert ok == []
+    assert len(fault) == 1
+    assert fault[0][0] == "IBM" and fault[0][1] == pytest.approx(0.0)
+    assert "fault()" in str(fault[0][2])
+
+
+def test_two_onerror_streams_isolated():
+    """faultStreamTest10 shape: two @OnError streams route independently."""
+    m = _mgr()
+    rt = m.create_siddhi_app_runtime("""
+        @OnError(action='stream')
+        define stream A (v long);
+        @OnError(action='stream')
+        define stream B (v long);
+        from A[custom:fault() > v] select v insert into OutA;
+        from B select v insert into OutB;
+        from !A select v, _error insert into FaultA;
+        from !B select v, _error insert into FaultB;""")
+    fa, fb, ob = [], [], []
+    rt.add_callback("FaultA", StreamCallback(
+        lambda evs: fa.extend(tuple(e.data) for e in evs)))
+    rt.add_callback("FaultB", StreamCallback(
+        lambda evs: fb.extend(tuple(e.data) for e in evs)))
+    rt.add_callback("OutB", StreamCallback(
+        lambda evs: ob.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    rt.get_input_handler("A").send([1])
+    rt.get_input_handler("B").send([2])
+    rt.shutdown()
+    assert len(fa) == 1 and fa[0][0] == 1
+    assert fb == []
+    assert ob == [(2,)]
+
+
+# ----------------------------------------------------- JunctionTestCase
+
+def test_junction_fanout_to_multiple_queries():
+    """multiThreadedTest shape: one stream feeds N queries; each sees
+    every event."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (v int);
+        from S select v insert into Out1;
+        from S select v insert into Out2;
+        from S select v insert into Out3;""")
+    outs = {f"Out{i}": [] for i in (1, 2, 3)}
+    for s in outs:
+        rt.add_callback(s, StreamCallback(
+            lambda evs, _s=s: outs[_s].extend(e.data[0] for e in evs)))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(20):
+        h.send([i])
+    rt.shutdown()
+    for s, vals in outs.items():
+        assert vals == list(range(20)), s
+
+
+def test_junction_relay_chain():
+    """oneToOneTest shape: query output re-enters another junction —
+    events relay A → B → C in order."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream A (symbol string, price int);
+        from A select symbol, price insert into B;
+        from B select symbol, price insert into C;""")
+    got = []
+    rt.add_callback("C", StreamCallback(
+        lambda evs: got.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    rt.get_input_handler("A").send(["IBM", 10])
+    rt.get_input_handler("A").send(["WSO2", 20])
+    rt.shutdown()
+    assert got == [("IBM", 10), ("WSO2", 20)]
+
+
+def test_stream_callback_by_stream_id_sees_inner_stream():
+    """CallbackTestCase shape: a StreamCallback attached to an
+    intermediate stream id observes the relay traffic."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream A (v int);
+        from A[v > 0] select v insert into Mid;
+        from Mid select v * 2 as v insert into Out;""")
+    mid, out = [], []
+    rt.add_callback("Mid", StreamCallback(
+        lambda evs: mid.extend(e.data[0] for e in evs)))
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: out.extend(e.data[0] for e in evs)))
+    rt.start()
+    for v in (-1, 1, 2):
+        rt.get_input_handler("A").send([v])
+    rt.shutdown()
+    assert mid == [1, 2]
+    assert out == [2, 4]
