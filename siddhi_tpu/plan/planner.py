@@ -424,9 +424,12 @@ class DeviceWindowedAggRuntime:
                                   return_rows=True)
         if self.cwa.window_kind == "time":
             # absolute i64 ts lanes: the time kernel's expiry must be
-            # comparable ACROSS blocks (packed __ts is per-block offsets)
+            # comparable ACROSS blocks (packed __ts is per-block offsets);
+            # externalTime reads the event's ts attribute instead
+            src = (np.asarray(data.columns[self.cwa.ts_attr], np.int64)
+                   if self.cwa.ts_attr else ts_arr)
             ts64 = np.zeros(block["__ts"].shape, np.int64)
-            ts64[lanes, rows] = ts_arr
+            ts64[lanes, rows] = src
             block["__ts64"] = ts64
         outs = self.cwa.process_block(block)
         sums = np.asarray(outs[0])

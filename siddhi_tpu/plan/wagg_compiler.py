@@ -18,6 +18,7 @@ import numpy as np
 
 from ..compiler import SiddhiCompiler
 from ..query_api import Filter, Query, SingleInputStream, WindowHandler
+from ..query_api.definition import AttrType
 from ..query_api.expression import AttributeFunction, Constant, Variable
 from ..utils.errors import SiddhiAppCreationError
 from .expr_compiler import EvalCtx, ExprCompiler, Scope
@@ -57,17 +58,45 @@ class CompiledWindowedAgg:
         kind = (wh.name.lower() if wh is not None else "")
         if kind == "length":
             self.window_kind = "length"
+            self.ts_attr = None
             self.window = int(wh.params[0].value)
-        elif kind == "time":
+        elif kind in ("time", "externaltime"):
+            # time(t): arrival-ts driven; externalTime(tsAttr, t): the same
+            # masked-expiry ring driven by the event's own timestamp
+            # attribute (reference ExternalTimeWindowProcessor)
             self.window_kind = "time"
-            self.window_ms = int(wh.params[0].value)
+            if kind == "externaltime":
+                if len(wh.params) != 2 or \
+                        not isinstance(wh.params[0], Variable):
+                    raise SiddhiAppCreationError(
+                        "externalTime needs (tsAttr, window)")
+                self.ts_attr = wh.params[0].attribute
+                span = wh.params[1]
+            else:
+                self.ts_attr = None
+                span = wh.params[0] if wh.params else None
+            if not isinstance(span, Constant):
+                raise SiddhiAppCreationError(
+                    f"{wh.name} needs a constant window length")
+            self.window_ms = int(span.value)
             self.window = TIME_CAPACITY_START
             self._ts_base = None      # i64→i32 offset rebasing base
         else:
             raise SiddhiAppCreationError(
-                "windowed-agg path needs #window.length(n) or "
-                "#window.time(t)")
+                "windowed-agg path needs #window.length(n), "
+                "#window.time(t) or #window.externalTime(tsAttr, t)")
         definition = app.stream_definitions[s.stream_id]
+        if self.ts_attr is not None:
+            at = {a.name: a.type for a in definition.attributes}.get(
+                self.ts_attr)
+            if at is None:
+                raise SiddhiAppCreationError(
+                    f"externalTime: '{self.ts_attr}' is not an attribute "
+                    f"of '{s.stream_id}'")
+            if at not in (AttrType.LONG, AttrType.INT):
+                raise SiddhiAppCreationError(
+                    f"externalTime: '{self.ts_attr}' must be INT/LONG, "
+                    f"got {at}")
 
         scope = Scope()
         scope.add_primary(s.stream_id, s.stream_ref, definition)
@@ -271,6 +300,13 @@ class CompiledWindowedAgg:
         offs = ts_abs - self._ts_base
         mx = int(offs[valid].max())
         safe = safe_max(self.window_ms)
+        if mx <= safe and int(offs[valid].min()) < -safe:
+            # event-supplied (externalTime) timestamps arbitrarily older
+            # than the base would wrap i32 into the far future — fail
+            # loudly (anything that old is expired data or a clock error)
+            raise SiddhiAppCreationError(
+                "time-window device path: an event timestamp is more than "
+                "~24 days older than the stream's time base")
         if mx > safe:
             delta = int(offs[valid].min())
             self._ts_base += delta

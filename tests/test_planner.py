@@ -318,6 +318,37 @@ def test_partitioned_time_window_device_parity():
         assert a[4] == pytest.approx(b[4], abs=1e-4)
 
 
+EXTTIME_WAGG_PART_APP = """
+    define stream S (k int, ets long, v float);
+    partition with (k of S) begin
+    @info(name='q')
+    from S[v > 2.0]#window.externalTime(ets, 200)
+    select k, sum(v) as total, count() as n
+    group by k
+    insert into Out;
+    end;
+"""
+
+
+def test_partitioned_external_time_window_device_parity():
+    """externalTime(tsAttr, t) rides the same device time-ring, driven by
+    the event's own timestamp attribute."""
+    rng = np.random.default_rng(31)
+    ets = 1_000_000
+    rows = []
+    for _ in range(100):
+        ets += int(rng.integers(1, 120))
+        rows.append([int(rng.integers(0, 5)), ets,
+                     float(np.float32(rng.uniform(0, 10)))])
+    dm_h, host = run_partition(EXTTIME_WAGG_PART_APP, rows, engine="host")
+    dm_d, dev = run_partition(EXTTIME_WAGG_PART_APP, rows)
+    assert not dm_h and dm_d
+    assert len(host) == len(dev) > 0
+    for a, b in zip(host, dev):
+        assert a[0] == b[0] and a[2] == b[2]
+        assert a[1] == pytest.approx(b[1], abs=1e-3)
+
+
 def test_wagg_int_sum_falls_back_to_host():
     """Exact integer sums can't ride float32 lanes — host fallback."""
     app = WAGG_PART_APP.replace("v float", "v int").replace("v > 2.0",

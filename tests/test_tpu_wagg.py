@@ -334,6 +334,45 @@ def test_time_wagg_overflow_grows_and_stays_exact(monkeypatch):
     assert got["hi"][0] == pytest.approx(9.0)
 
 
+def test_external_time_rejects_bad_shapes():
+    from siddhi_tpu.utils.errors import SiddhiAppCreationError
+    head = "define stream S (k int, ets long, txt string, v float);\n"
+    for window in ("externalTime(ets)",          # missing window length
+                   "externalTime(bogus, 200)",   # unknown attribute
+                   "externalTime(txt, 200)"):    # non-integer attribute
+        with pytest.raises(SiddhiAppCreationError):
+            CompiledWindowedAgg(head + f"""
+                @info(name='q')
+                from S#window.{window}
+                select k, sum(v) as total group by k insert into Out;
+            """, n_partitions=4, use_pallas=False)
+
+
+def test_time_wagg_rejects_far_past_timestamps():
+    """An event timestamp ~25 days older than the pinned base must fail
+    loudly, not wrap i32 into the far future."""
+    from siddhi_tpu.utils.errors import SiddhiAppCreationError
+    agg = CompiledWindowedAgg(TIME_APP, n_partitions=2, use_pallas=False)
+
+    def block_at(ts0):
+        pids = np.zeros(2, np.int64)
+        ts = np.asarray([ts0, ts0 + 1], np.int64)
+        vals = np.asarray([5.0, 6.0], np.float32)
+        b, rows = pack_blocks(pids, {"k": pids.astype(np.float32),
+                                     "v": vals}, ts,
+                              np.zeros(2, np.int32), 2,
+                              base_ts=int(ts[0]), return_rows=True)
+        ts64 = np.zeros(b["__ts"].shape, np.int64)
+        ts64[pids, rows] = ts
+        b["__ts64"] = ts64
+        return b
+
+    base = 1 << 41
+    agg.process_block(block_at(base))
+    with pytest.raises(SiddhiAppCreationError):
+        agg.process_block(block_at(base - (1 << 31) - 10_000))
+
+
 def test_wagg_rejects_distinct_aggregate_args():
     """sum(x) + avg(y) can't share the single value lane — must be rejected
     at compile time, not silently aggregate the wrong column."""
