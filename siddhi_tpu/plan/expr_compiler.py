@@ -619,7 +619,12 @@ def _obj_compare(a, b, py):
     aa = np.asarray(a, object)
     bb = np.asarray(b, object)
     if aa.ndim == 0 and bb.ndim == 0:
-        return np.bool_(py(aa.item(), bb.item()))
+        x, y = aa.item(), bb.item()
+        if x is None or y is None:
+            # reference law: ANY null operand compares false, every op
+            # (CompareConditionExpressionExecutor.execute)
+            return np.bool_(False)
+        return np.bool_(py(x, y))
     n = max(aa.size if aa.ndim else 1, bb.size if bb.ndim else 1)
     aa = np.broadcast_to(aa, (n,))
     bb = np.broadcast_to(bb, (n,))

@@ -38,8 +38,9 @@ from ..query_api import (AbsentStreamStateElement, CountStateElement,
                          LogicalStateElement, NextStateElement, Query,
                          StateInputStream, StateType, StreamStateElement)
 from ..query_api.definition import AttrType
-from ..query_api.expression import (AttributeFunction, Constant, IsNull, Not,
-                                    TimeConstant, Variable)
+from ..query_api.expression import (And, AttributeFunction, Compare,
+                                    CompareOp, Constant, IsNull, Not, Or,
+                                    TimeConstant, Variable, variables_of)
 from ..utils.errors import SiddhiAppCreationError
 from .expr_compiler import EvalCtx, ExprCompiler, Scope
 
@@ -281,17 +282,28 @@ class CompiledPatternNFA:
                     self.stream_codes[side.stream_id] = \
                         len(self.stream_codes)
 
-        # attribute schema: union over referenced streams; numeric only
+        # attribute schema: union over referenced streams.  Numeric attrs
+        # ride lanes directly; STRING attrs referenced in equality
+        # conditions or captures are dictionary-encoded onto integer lanes
+        # (codes exact in float32 up to 2^24 values; the host owns the
+        # dictionary) — the columnar replacement for the reference's
+        # Object[]-typed StreamEvent payloads carrying strings
+        # (event/stream/StreamEvent.java:40-57).
         self.attr_names: List[str] = []
         self.attr_types: Dict[str, AttrType] = {}
+        self.real_types: Dict[str, AttrType] = {}
+        str_attrs: set = set()
         for u in self.units:
             for side in u.sides:
                 for a in side.definition.attributes:
-                    if a.name not in self.attr_types:
-                        if a.type not in _NUMERIC:
-                            continue    # non-numeric attrs stay host-side
-                        self.attr_names.append(a.name)
-                        self.attr_types[a.name] = a.type
+                    if a.name not in self.real_types:
+                        self.real_types[a.name] = a.type
+                        if a.type in _NUMERIC:
+                            self.attr_names.append(a.name)
+                            self.attr_types[a.name] = a.type
+                        elif a.type == AttrType.STRING:
+                            str_attrs.add(a.name)
+        self._setup_string_encoding(str_attrs, query, parameterize)
 
         # ---- capture rows: one per capturing side
         rows: List[_Side] = []
@@ -491,6 +503,8 @@ class CompiledPatternNFA:
         import warnings
         warned = set()
         for (_r, a, _w) in self.cap_lane:
+            if a in self.encoded_attrs:
+                continue       # dictionary codes are capped at 2^24
             if self.attr_types.get(a) in (AttrType.INT, AttrType.LONG) and \
                     a not in warned:
                 warned.add(a)
@@ -498,6 +512,128 @@ class CompiledPatternNFA:
                     f"TPU NFA path: {self.attr_types[a].name} attribute "
                     f"'{a}' rides a float32 capture lane; values above "
                     f"2**24 lose precision on decode", stacklevel=2)
+
+    # -------------------------------------------- string dictionary coding
+
+    def _setup_string_encoding(self, str_attrs: set, query,
+                               parameterize: bool) -> None:
+        """Find STRING attrs used by this query, validate their usage
+        (equality compares and captures only — codes carry no order),
+        rewrite plan-time string constants to their codes, and register
+        the attrs as LONG code lanes."""
+        self.str_encoder: Dict[Any, int] = {}
+        self.str_decoder: List[Any] = []
+        self.encoded_attrs: set = set()
+        if not str_attrs:
+            return
+
+        def is_str_var(e) -> bool:
+            return isinstance(e, Variable) and e.attribute in str_attrs
+
+        def with_null_guards(cmp: Compare, str_vars) -> Any:
+            # host compare executors treat ANY null operand as false
+            # (expr_compiler compare lowering); nulls encode as code 0, so
+            # every string compare gets `var != 0` guards
+            out = cmp
+            for v in str_vars:
+                out = And(out, Compare(v, CompareOp.NEQ,
+                                       Constant(0, "long")))
+            return out
+
+        def rewrite(e):
+            if isinstance(e, Compare):
+                ls, rs = is_str_var(e.left), is_str_var(e.right)
+                if ls or rs:
+                    if e.op not in (CompareOp.EQ, CompareOp.NEQ):
+                        _reject("string attributes support only ==/!= on "
+                                "the device (dictionary codes carry no "
+                                "order)")
+                    if ls and rs:
+                        self.encoded_attrs.add(e.left.attribute)
+                        self.encoded_attrs.add(e.right.attribute)
+                        return with_null_guards(e, (e.left, e.right))
+                    var, const = (e.left, e.right) if ls else \
+                        (e.right, e.left)
+                    if not (isinstance(const, Constant) and
+                            isinstance(const.value, str)):
+                        _reject("string attributes compare only against "
+                                "string constants or string attributes on "
+                                "the device")
+                    self.encoded_attrs.add(var.attribute)
+                    code = self._encode_str(const.value)
+                    cc = Constant(code, "long")
+                    return with_null_guards(
+                        Compare(var if ls else cc, e.op,
+                                cc if ls else var), (var,))
+                # no direct string side: any nested string var (functions,
+                # arithmetic) is untranslatable
+                for v in variables_of(e):
+                    if is_str_var(v):
+                        _reject(f"string attribute '{v.attribute}' is "
+                                f"only supported in ==/!= compares and "
+                                f"captures on the device")
+                return e
+            if isinstance(e, And):
+                return And(rewrite(e.left), rewrite(e.right))
+            if isinstance(e, Or):
+                return Or(rewrite(e.left), rewrite(e.right))
+            if isinstance(e, Not):
+                return Not(rewrite(e.expr))
+            for v in variables_of(e):
+                if is_str_var(v):
+                    _reject(f"string attribute '{v.attribute}' is only "
+                            f"supported in ==/!= compares and captures "
+                            f"on the device")
+            return e
+
+        for u in self.units:
+            for side in u.sides:
+                side.filters = [rewrite(f) for f in side.filters]
+        for oa in query.selector.attributes:
+            for v in variables_of(oa.expr):
+                if is_str_var(v):
+                    self.encoded_attrs.add(v.attribute)
+        if self.encoded_attrs and parameterize:
+            _reject("string conditions are not parameterizable "
+                    "(pattern-bank mode lowers constants to float lanes)")
+        for a in sorted(self.encoded_attrs):
+            self.attr_names.append(a)
+            self.attr_types[a] = AttrType.LONG
+
+    def _encode_str(self, v) -> int:
+        code = self.str_encoder.get(v)
+        if code is None:
+            code = len(self.str_encoder) + 1    # 0 = null/padding/missing
+            if code > (1 << 24):
+                # raised at ingest: the junction's @OnError boundary
+                # LOG-drops or fault-routes the chunk (a runtime data
+                # error, not an app-definition one)
+                from ..utils.errors import SiddhiAppRuntimeException
+                raise SiddhiAppRuntimeException(
+                    "string dictionary exceeded 2^24 distinct values "
+                    "(codes must stay exact in float32 lanes); "
+                    "re-plan with @app:engine('host')")
+            self.str_encoder[v] = code
+            self.str_decoder.append(v)
+        return code
+
+    def encode_column(self, col) -> np.ndarray:
+        """String column → float32 code lane (dictionary grows on first
+        sight of a value; ingest-side, host).  Nulls map to the reserved
+        code 0, which every rewritten compare guards against — host
+        parity: null operands compare false."""
+        out = np.empty(len(col), np.float32)
+        for i, v in enumerate(col):
+            v = v.item() if hasattr(v, "item") else v
+            out[i] = 0 if v is None else self._encode_str(v)
+        return out
+
+    def output_type(self, attr: str) -> AttrType:
+        """The user-facing type of a selected attribute (encoded lanes
+        decode back to STRING)."""
+        if attr in self.encoded_attrs:
+            return AttrType.STRING
+        return self.attr_types[attr]
 
     @staticmethod
     def _pick_query(app, query_name) -> Query:
@@ -530,16 +666,18 @@ class CompiledPatternNFA:
         _scan_vars(expr, note_gate)
 
         scope = Scope()
-        # current event attributes (scalars broadcast over K)
+        # current event attributes (scalars broadcast over K); encoded
+        # string attrs resolve as their LONG code lanes
         for a in side.definition.attributes:
             if a.name not in self.attr_types:
                 continue
 
             def g(ctx, _a=a.name):
                 return ctx.columns[_a]
-            scope.add(None, a.name, a.type, g)
-            scope.add(side.stream_id, a.name, a.type, g)
-            scope.add(side.ref, a.name, a.type, g)
+            lane_t = self.attr_types[a.name]
+            scope.add(None, a.name, lane_t, g)
+            scope.add(side.stream_id, a.name, lane_t, g)
+            scope.add(side.ref, a.name, lane_t, g)
         # other states' captures: [K] lanes (first bank at index 0/None,
         # last bank at index -1 for count rows)
         for other in self.rows:
@@ -551,16 +689,21 @@ class CompiledPatternNFA:
                     other.stream_id != other.ref:
                 qualifiers.append(other.stream_id)
             for a in other.definition.attributes:
+                if a.name not in self.attr_types:
+                    continue    # unresolvable attrs reject at compile,
+                    #             not KeyError at runtime
+
                 def gq(ctx, _r=other.ref, _a=a.name):
                     return ctx.qualified[(_r, 0)][_a]
 
                 def gql(ctx, _r=other.ref, _a=a.name):
                     q = ctx.qualified.get((_r, -1))
                     return (q or ctx.qualified[(_r, 0)])[_a]
+                lane_t = self.attr_types[a.name]
                 for qn in qualifiers:
-                    scope.add(qn, a.name, a.type, gq, index=0)
-                    scope.add(qn, a.name, a.type, gq, index=None)
-                    scope.add(qn, a.name, a.type, gql, index=-1)
+                    scope.add(qn, a.name, lane_t, gq, index=0)
+                    scope.add(qn, a.name, lane_t, gq, index=None)
+                    scope.add(qn, a.name, lane_t, gql, index=-1)
         if self._param_map:
             compiled = _ParamExprCompiler(scope, self._param_map).compile(
                 expr)
@@ -684,12 +827,22 @@ class CompiledPatternNFA:
     def current_state(self) -> Dict[str, Any]:
         return {"carry": {k: np.asarray(v) for k, v in self.carry.items()},
                 "base_ts": self.base_ts,
-                "n_partitions": self.n_partitions}
+                "n_partitions": self.n_partitions,
+                # captured codes are only meaningful with their dictionary
+                "str_decoder": list(self.str_decoder)}
 
     def restore_state(self, state: Dict[str, Any]) -> None:
         self.n_partitions = state["n_partitions"]
         self.carry = {k: jnp.asarray(v) for k, v in state["carry"].items()}
         self.base_ts = state["base_ts"]
+        dec = state.get("str_decoder")
+        if dec is not None and self.encoded_attrs:
+            # the carry is replaced wholesale by the snapshot's, so its
+            # codes are only meaningful with the snapshot's dictionary —
+            # adopt it unconditionally (same app ⇒ plan-time constants
+            # occupy the same prefix)
+            self.str_decoder = list(dec)
+            self.str_encoder = {v: i + 1 for i, v in enumerate(dec)}
         k = int(self.carry["slot_state"].shape[1])
         if k != self.spec.n_slots:    # snapshot taken after slot growth
             self.spec = self.spec._replace(n_slots=k)
@@ -735,7 +888,12 @@ class CompiledPatternNFA:
         else:
             codes = np.asarray([self.stream_codes[s] for s in stream_names],
                                np.int32)
-        cols = {a: np.asarray(columns[a]) for a in self.attr_names}
+        cols = {}
+        for a in self.attr_names:
+            c = columns[a]
+            if a in self.encoded_attrs:
+                c = self.encode_column(c)
+            cols[a] = np.asarray(c)
         block = pack_blocks(np.asarray(partition_ids), cols,
                             np.asarray(timestamps), codes,
                             self.n_partitions, base_ts=self.base_ts,
@@ -803,6 +961,9 @@ class CompiledPatternNFA:
                 at = self.attr_types.get(attr)
                 if at in (AttrType.INT, AttrType.LONG):
                     v = int(round(v))
+                if attr in self.encoded_attrs:
+                    # code → original string (0 = never-written lane)
+                    v = self.str_decoder[v - 1] if v >= 1 else None
                 vals[name] = v
             out.append((int(p), int(ts[p, t, k]) + (self.base_ts or 0),
                         vals))

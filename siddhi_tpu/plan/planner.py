@@ -144,8 +144,9 @@ class DevicePatternRuntime:
         self._ub_active = 0
 
         # output definition straight from the capture-decode plan
+        # (encoded string captures decode back to STRING)
         target = getattr(q.output_stream, "target_id", "") or qr.name
-        attrs = [Attribute(name, self.nfa.attr_types[attr])
+        attrs = [Attribute(name, self.nfa.output_type(attr))
                  for (name, _idx, attr, _w) in self.nfa.select_outputs]
         out_def = StreamDefinition(target, attrs)
         self.head = qr._finish_device_chain(out_def, factory)
@@ -205,8 +206,13 @@ class DevicePatternRuntime:
         cols = {}
         for a in self.nfa.attr_names:
             col = data.columns.get(a)
-            cols[a] = (np.asarray(col, np.float32) if col is not None
-                       else np.zeros(n, np.float32))
+            if a in self.nfa.encoded_attrs:
+                # raw string column — the NFA dictionary-encodes it
+                cols[a] = (col if col is not None
+                           else np.full(n, None, object))
+            else:
+                cols[a] = (np.asarray(col, np.float32) if col is not None
+                           else np.zeros(n, np.float32))
         matches = self.nfa.process_events(
             pids, cols, np.asarray(data.timestamps, np.int64),
             stream_codes=np.full(n, stream_code, np.int32),
@@ -223,12 +229,12 @@ class DevicePatternRuntime:
         out_cols: Dict[str, np.ndarray] = {}
         for (name, _idx, attr, _w) in self.nfa.select_outputs:
             vals = [m[2][name] for m in matches]
-            if name in self._nullable_out:
+            dt = self._dtype_for(self.nfa.output_type(attr))
+            if name in self._nullable_out or dt is object:
                 col = np.empty(len(vals), object)
                 col[:] = vals
             else:
-                col = np.asarray(vals,
-                                 self._dtype_for(self.nfa.attr_types[attr]))
+                col = np.asarray(vals, dt)
             out_cols[name] = col
         ts = np.asarray([m[1] for m in matches], np.int64)
         self.head.process(EventChunk.from_columns(names, ts, out_cols))

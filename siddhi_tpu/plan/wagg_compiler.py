@@ -302,9 +302,11 @@ class CompiledWindowedAgg:
         safe = safe_max(self.window_ms)
         if mx <= safe and int(offs[valid].min()) < -safe:
             # event-supplied (externalTime) timestamps arbitrarily older
-            # than the base would wrap i32 into the far future — fail
-            # loudly (anything that old is expired data or a clock error)
-            raise SiddhiAppCreationError(
+            # than the base would wrap i32 into the far future — a runtime
+            # data error: the junction's @OnError boundary LOG-drops or
+            # fault-routes the chunk
+            from ..utils.errors import SiddhiAppRuntimeException
+            raise SiddhiAppRuntimeException(
                 "time-window device path: an event timestamp is more than "
                 "~24 days older than the stream's time base")
         if mx > safe:
@@ -314,7 +316,8 @@ class CompiledWindowedAgg:
             if int(offs[valid].max()) > safe:
                 # one chunk spanning ≥ ~24.8 days of stream time cannot be
                 # rebased — fail loudly rather than wrap i32 silently
-                raise SiddhiAppCreationError(
+                from ..utils.errors import SiddhiAppRuntimeException
+                raise SiddhiAppRuntimeException(
                     "time-window device path: a single chunk spans more "
                     "than ~24 days of stream time; split the replay into "
                     "smaller chunks or use @app:engine('host')")

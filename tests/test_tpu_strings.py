@@ -1,0 +1,160 @@
+"""Dictionary-encoded string attributes on the device NFA path: equality
+conditions and cross-state string captures ride integer code lanes; any
+other string usage falls back to the host cleanly (the regression this
+guards: a string condition used to plan onto the device and then crash at
+ingest, silently dropping events)."""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+APP = """
+define stream Trades (symbol string, price float);
+@info(name='q')
+from every e1=Trades[symbol == 'IBM' and price > 100.0]
+    -> e2=Trades[symbol == e1.symbol and price > e1.price]
+    within 10 sec
+select e1.symbol as sym, e1.price as p1, e2.price as p2
+insert into Alerts;
+"""
+
+SENDS = [("IBM", 101.0), ("WSO2", 150.0), ("IBM", 120.0),
+         ("IBM", 90.0), ("IBM", 130.0), ("MSFT", 200.0)]
+
+
+def run(app, sends, engine=None, out="Alerts", persist_mid=False):
+    m = SiddhiManager()
+    prefix = f"@app:engine('{engine}') " if engine else ""
+    if persist_mid:
+        from siddhi_tpu.core.snapshot import InMemoryPersistenceStore
+        m.set_persistence_store(InMemoryPersistenceStore())
+    rt = m.create_siddhi_app_runtime(prefix + app)
+    got = []
+    rt.add_callback(out, StreamCallback(
+        lambda evs: got.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    ts = 1_000_000
+    mid = len(sends) // 2
+    for i, (sym, price) in enumerate(sends):
+        rt.get_input_handler("Trades").send([sym, price], timestamp=ts)
+        ts += 100
+        if persist_mid and i == mid:
+            snap = rt.snapshot()
+            rt.restore(snap)
+    backend = rt.query_runtimes["q"].backend
+    reason = rt.query_runtimes["q"].backend_reason
+    rt.shutdown()
+    return backend, reason, got
+
+
+def test_string_equality_and_capture_parity():
+    bh, _, host = run(APP, SENDS, engine="host")
+    bd, reason, dev = run(APP, SENDS)
+    assert bh == "host"
+    assert bd == "device", reason
+    assert host == dev
+    assert host == [("IBM", 101.0, 120.0), ("IBM", 120.0, 130.0)]
+
+
+def test_string_not_equal_parity():
+    app = APP.replace("symbol == e1.symbol", "symbol != e1.symbol")
+    bh, _, host = run(app, SENDS, engine="host")
+    bd, reason, dev = run(app, SENDS)
+    assert bd == "device", reason
+    assert host == dev and len(host) > 0
+
+
+def test_string_order_compare_falls_back():
+    app = APP.replace("symbol == 'IBM'", "symbol > 'A'")
+    bd, reason, _ = run(app, SENDS)
+    assert bd == "host"
+    assert "==/!=" in (reason or "")
+
+
+def test_string_function_falls_back():
+    app = APP.replace("symbol == 'IBM'", "str:length(symbol) == 3")
+    bd, _, _ = run(app, SENDS)
+    assert bd == "host"
+
+
+def test_string_events_are_not_silently_dropped():
+    """The original bug: device-planned string condition crashed at ingest
+    and the junction swallowed it — zero output while the host produced
+    matches. Whatever the backend, output must equal the host's."""
+    app = """
+    define stream Trades (symbol string, price float);
+    @info(name='q')
+    from every e1=Trades[symbol == 'IBM' and price > 100.0]
+        -> e2=Trades[price > e1.price] within 10 sec
+    select e1.price as p1, e2.price as p2 insert into Alerts;
+    """
+    _, _, host = run(app, SENDS, engine="host")
+    _, _, auto = run(app, SENDS)
+    assert auto == host and len(host) > 0
+
+
+def test_string_dictionary_survives_snapshot_restore():
+    bh, _, host = run(APP, SENDS, engine="host")
+    bd, _, dev = run(APP, SENDS, persist_mid=True)
+    assert bd == "device"
+    assert dev == host
+
+
+def test_null_strings_never_match_like_host():
+    """Host compare executors treat null operands as false; null codes (0)
+    must behave identically on the device — null==null and null!='X' are
+    both false."""
+    sends = [(None, 101.0), (None, 120.0), ("IBM", 150.0),
+             (None, 200.0), ("IBM", 250.0)]
+    for app in (APP,
+                APP.replace("symbol == e1.symbol",
+                            "symbol != e1.symbol")):
+        bh, _, host = run(app, sends, engine="host")
+        bd, reason, dev = run(app, sends)
+        assert bd == "device", reason
+        assert host == dev, (app, host, dev)
+
+
+def test_partitioned_string_pattern_parity():
+    """String conditions inside a keyed partition (lanes + dictionary)."""
+    app = """
+    define stream Trades (acct int, symbol string, price float);
+    partition with (acct of Trades) begin
+    @info(name='q')
+    from every e1=Trades[symbol == 'IBM'] ->
+         e2=Trades[symbol == e1.symbol and price > e1.price]
+        within 10 sec
+    select e1.symbol as sym, e2.price as p2 insert into Alerts;
+    end;
+    """
+    rng = np.random.default_rng(3)
+    syms = ["IBM", "WSO2", "MSFT"]
+    sends = []
+    ts = 1_000_000
+    rows = []
+    for _ in range(60):
+        rows.append([int(rng.integers(0, 4)),
+                     syms[int(rng.integers(0, 3))],
+                     float(np.round(rng.uniform(0, 100), 1))])
+
+    def run_part(engine=None):
+        m = SiddhiManager()
+        prefix = (f"@app:engine('{engine}') " if engine else "")
+        rt = m.create_siddhi_app_runtime(prefix + "@app:playback " + app)
+        got = []
+        rt.add_callback("Alerts", StreamCallback(
+            lambda evs: got.extend(tuple(e.data) for e in evs)))
+        rt.start()
+        t = 1_000_000
+        for r in rows:
+            rt.get_input_handler("Trades").send(r, timestamp=t)
+            t += 10
+        dm = rt.partition_runtimes[0].device_mode
+        rt.shutdown()
+        return dm, got
+
+    dm_h, host = run_part("host")
+    dm_d, dev = run_part()
+    assert not dm_h and dm_d
+    assert sorted(host) == sorted(dev)
+    assert len(host) > 0

@@ -350,8 +350,9 @@ def test_external_time_rejects_bad_shapes():
 
 def test_time_wagg_rejects_far_past_timestamps():
     """An event timestamp ~25 days older than the pinned base must fail
-    loudly, not wrap i32 into the far future."""
-    from siddhi_tpu.utils.errors import SiddhiAppCreationError
+    loudly (runtime data error — the junction's @OnError boundary routes
+    it), not wrap i32 into the far future."""
+    from siddhi_tpu.utils.errors import SiddhiAppRuntimeException
     agg = CompiledWindowedAgg(TIME_APP, n_partitions=2, use_pallas=False)
 
     def block_at(ts0):
@@ -369,7 +370,7 @@ def test_time_wagg_rejects_far_past_timestamps():
 
     base = 1 << 41
     agg.process_block(block_at(base))
-    with pytest.raises(SiddhiAppCreationError):
+    with pytest.raises(SiddhiAppRuntimeException):
         agg.process_block(block_at(base - (1 << 31) - 10_000))
 
 
