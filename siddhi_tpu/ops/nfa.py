@@ -301,6 +301,12 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
             # reference removes the partial from that side's pending list)
             newA = at & (stream == u.stream_a) & conds[u.cond_a] & ~bitA
             newB = at & (stream == u.stream_b) & conds[u.cond_b] & ~bitB
+            if not u.is_and:
+                # or: when ONE event satisfies both sides, the left side
+                # captures and completes first — the right side's partner
+                # is already gone (oracle: left pre-processor runs first,
+                # LogicalPreStateProcessor partner removal)
+                newB = newB & ~newA
             s.write_all(newA, u.row_a, ev_rows)
             s.write_all(newB, u.row_b, ev_rows)
             haveA, haveB = bitA | newA, bitB | newB
@@ -359,11 +365,19 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
         # injected TIMER rows (stream -2) are not events: the oracle's
         # absent_tick never runs the per-event reset barrier
         is_real = valid & (stream != -2)
-        strict = np.asarray([u.kind in ("simple", "count") for u in units] +
-                            [False], bool)
+        # logical units are strict too: a sequence partial whose or/and
+        # unit matched NEITHER side on this event dies — EXCEPT an and-
+        # partial that already satisfied one side (the oracle's logical
+        # pending entry survives while waiting for its partner)
+        strict = np.asarray([u.kind in ("simple", "count", "logical")
+                             for u in units] + [False], bool)
+        logical_u = np.asarray([u.kind == "logical" for u in units] +
+                               [False], bool)
         at_strict = jnp.asarray(strict)[jnp.clip(st_pre, 0, S)]
+        at_logical = jnp.asarray(logical_u)[jnp.clip(st_pre, 0, S)]
+        half_done = at_logical & (s.lmask != 0)
         kill = is_real & (st_pre >= 0) & (s.st >= 0) & at_strict & \
-            ~(advanced | appended)
+            ~(advanced | appended) & ~half_done
         s.st = jnp.where(kill, -1, s.st)
 
     # ---- arming a fresh partial at unit 0 (reference `every` re-arm /
@@ -415,6 +429,8 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
     elif u0.kind == "logical":
         cA = valid & (stream == u0.stream_a) & conds[u0.cond_a][0]
         cB = valid & (stream == u0.stream_b) & conds[u0.cond_b][0]
+        if not u0.is_and:
+            cB = cB & ~cA       # or: same-event double match, left wins
         arm = cA | cB
         both = (cA & cB) if u0.is_and else (cA | cB)
         t, _live0, completed = _land_static(spec, 0)
@@ -449,6 +465,8 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
     if u0.kind == "logical":
         cA = valid & (stream == u0.stream_a) & conds[u0.cond_a][0]
         cB = valid & (stream == u0.stream_b) & conds[u0.cond_b][0]
+        if not u0.is_and:
+            cB = cB & ~cA       # or: left side captures on a double match
         s.write_all(armed_here & cA, u0.row_a, ev_rows)
         s.write_all(armed_here & cB, u0.row_b, ev_rows)
     else:

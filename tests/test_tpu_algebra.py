@@ -87,6 +87,74 @@ def test_logical_and_first_side_wins():
     assert_parity(app, sends)
 
 
+def test_logical_or_same_event_left_side_wins():
+    """One event satisfying BOTH or-sides captures only the left side
+    (oracle: the left pre-processor completes first and removes the
+    partner; LogicalPreStateProcessor)."""
+    app = STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 10.0] ->
+             e2=A[v > e1.v] or e3=A[k == 2]
+        select e1.v as v1, e2.v as v2, e3.v as v3 insert into Out;
+    """
+    # k=2 event also has v > e1.v: both sides true
+    assert_parity(app, [A(1_000_000, 0, 20.0), A(1_000_100, 2, 30.0)])
+
+
+def test_sequence_logical_unit_is_strict():
+    """A sequence partial whose or-unit matches neither side on the next
+    event dies (strict contiguity applies to logical units too)."""
+    app = STREAMS.replace("define stream A", "define stream A2").replace(
+        "define stream B", "define stream B2") + """
+        @info(name='q')
+        from every e1=A2[v > 20.0],
+             e2=A2[v > e1.v] or e3=A2[k == 2]
+        select e1.v as v1, e2.v as v2, e3.v as v3 insert into Out;
+    """
+    app = app.replace("A2", "A").replace("B2", "B")
+    sends = [A(1_000_000, 0, 59.6), A(1_000_100, 0, 55.6),
+             A(1_000_200, 2, 55.7), A(1_000_300, 0, 57.6)]
+    assert_parity(app, sends)
+
+
+def test_logical_and_same_event_both_capture():
+    """One event satisfying BOTH and-sides completes the unit with both
+    captures referencing that event (host law)."""
+    app = STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 10.0] ->
+             e2=A[v > e1.v] and e3=A[k == 2]
+        select e1.v as v1, e2.v as v2, e3.v as v3 insert into Out;
+    """
+    assert_parity(app, [A(1_000_000, 0, 20.0), A(1_000_100, 2, 30.0)])
+
+
+def test_sequence_and_half_done_partial_survives():
+    """A sequence and-partial with one side satisfied survives events that
+    match neither free side (the oracle's logical pending entry waits for
+    its partner); a partial with NO side satisfied dies."""
+    app = STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 20.0], e2=A[v > e1.v] and e3=B[w > 5.0]
+        select e1.v as v1, e2.v as v2, e3.w as w3 insert into Out;
+    """
+    assert_parity(app, [A(1, 0, 30.0), A(2, 0, 40.0), A(3, 0, 50.0),
+                        B(4, 0, 9.0)])
+    assert_parity(app, [A(1, 0, 30.0), A(2, 0, 40.0), A(3, 0, 10.0),
+                        B(4, 0, 9.0)])
+
+
+def test_leading_or_same_event_left_side_wins():
+    """A leading or-group armed by an event satisfying BOTH sides captures
+    only the left side."""
+    app = STREAMS + """
+        @info(name='q')
+        from every (e1=A[v > 10.0] or e2=A[k == 2]) -> e3=A[v > 50.0]
+        select e1.v as v1, e2.v as v2, e3.v as v3 insert into Out;
+    """
+    assert_parity(app, [A(1, 2, 20.0), A(2, 0, 60.0)])
+
+
 def test_logical_or_null_side_decodes_none():
     app = STREAMS + """
         @info(name='q')
