@@ -28,18 +28,24 @@ from .processor import Processor
 
 
 class _AggSpec:
-    __slots__ = ("name", "arg", "arg_type", "col_name", "output_type")
+    __slots__ = ("name", "arg", "arg_type", "col_name", "output_type",
+                 "cls")
 
-    def __init__(self, name: str, arg: Optional[CompiledExpr], col_name: str):
+    def __init__(self, name: str, arg: Optional[CompiledExpr],
+                 col_name: str, cls=None):
         self.name = name
         self.arg = arg
         self.arg_type = arg.type if arg is not None else None
         self.col_name = col_name
-        proto = AGGREGATORS[name](self.arg_type)
-        self.output_type = proto.output_type
+        # cls: an extension AttributeAggregator subclass registered via
+        # SiddhiManager.set_extension (≙ the reference's custom
+        # StringConcatAggregator-style test extensions,
+        # query/selector/attribute/aggregator SPI)
+        self.cls = cls or AGGREGATORS[name]
+        self.output_type = self.cls(self.arg_type).output_type
 
     def new_instance(self):
-        return AGGREGATORS[self.name](self.arg_type)
+        return self.cls(self.arg_type)
 
 
 class QuerySelector(Processor):
@@ -63,6 +69,9 @@ class QuerySelector(Processor):
         def resolver(f: AttributeFunction):
             if is_aggregator(f.namespace, f.name, len(f.args)):
                 return self._register_agg(f, compiler)
+            ext = self._find_extension_aggregator(f, compiler)
+            if ext is not None:
+                return ext
             return prev_resolver(f) if prev_resolver else None
 
         input_scope.function_resolver = resolver
@@ -110,15 +119,35 @@ class QuerySelector(Processor):
         self.offset = selector.offset
         input_scope.function_resolver = prev_resolver
 
-    def _register_agg(self, f: AttributeFunction, compiler) -> CompiledExpr:
+    def _register_agg(self, f: AttributeFunction, compiler,
+                      cls=None) -> CompiledExpr:
         col = f"__agg_{len(self.agg_specs)}"
         arg = compiler.compile(f.args[0]) if f.args else None
-        spec = _AggSpec(f.name.lower(), arg, col)
+        spec = _AggSpec(f.name.lower(), arg, col, cls=cls)
         self.agg_specs.append(spec)
 
         def getter(ctx, name=col):
             return ctx.columns[name]
         return CompiledExpr(getter, spec.output_type)
+
+    def _find_extension_aggregator(self, f: AttributeFunction, compiler):
+        """Custom attribute aggregators from the extension registry
+        (reference: siddhiManager.setExtension + AttributeAggregator SPI,
+        query/extension test corpus)."""
+        from .aggregator import AttributeAggregator
+        reg = getattr(compiler, "extension_registry", None)
+        if reg is None:
+            return None
+        impl = reg.find_function(f.namespace or "", f.name)
+        if not (isinstance(impl, type) and
+                issubclass(impl, AttributeAggregator)):
+            return None
+        if len(f.args) != 1:
+            from ..utils.errors import SiddhiAppCreationError
+            raise SiddhiAppCreationError(
+                f"aggregator extension '{f.namespace}:{f.name}' takes "
+                f"exactly one argument, got {len(f.args)}")
+        return self._register_agg(f, compiler, cls=impl)
 
     # ------------------------------------------------------------ runtime
 

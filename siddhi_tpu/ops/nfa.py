@@ -357,10 +357,11 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
             s.cnt_prev = jnp.where(ok & (c2 == u.max_count), -1, s.cnt_prev)
             appended = appended | ok
 
-    # ---- SEQUENCE strict contiguity: partials at simple/count units must
-    # advance or append on every event or die (per-event resetState
-    # barriers, StreamPreStateProcessor.java:263-279); logical/absent
-    # partials survive (processAndReturn keeps them)
+    # ---- SEQUENCE strict contiguity: partials at simple/count/logical
+    # units must advance or append on every event or die (per-event
+    # resetState barriers, StreamPreStateProcessor.java:263-279); an `and`
+    # partial with one side already satisfied waits for its partner, and
+    # absent partials survive (processAndReturn keeps them)
     if spec.is_sequence:
         # injected TIMER rows (stream -2) are not events: the oracle's
         # absent_tick never runs the per-event reset barrier
@@ -437,7 +438,11 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
         arm_match = both if completed else jnp.zeros((), bool)
         arm_state = jnp.where(both, jnp.int32(-2 if completed else t),
                               jnp.int32(0))
-        arm_lmask = jnp.where(cA, 1, 0) | jnp.where(cB, 2, 0)
+        # a completed leading unit advances with a CLEAN mask — stale side
+        # bits would leak into a later logical unit (land() zeroes lmask
+        # on advance; the arm path must match)
+        arm_lmask = jnp.where(both, 0,
+                              jnp.where(cA, 1, 0) | jnp.where(cB, 2, 0))
         arm_cnt_prev = jnp.int32(0 if _live0 else -1)
         # capture whichever side(s) matched
         arm_row_writes = []     # handled below with per-side predicates

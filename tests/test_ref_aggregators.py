@@ -1,0 +1,188 @@
+"""Attribute-aggregator conformance ported from the reference corpus
+(query/aggregator/ — And/Or/MaxForever/MinForever/Max aggregator test
+cases).  Behaviors mirrored; assertions are the reference tests'
+expectations."""
+from ref_harness import run_query
+
+
+# --------------------------------------------- MaxForever / MinForever
+
+def test_max_forever_never_decreases():
+    """testMaxForeverAggregatorExtension1: maxForever keeps the historical
+    maximum even after larger values leave any window."""
+    run_query("""
+        define stream inputStream (price1 double, price2 double,
+                                   price3 double);
+        @info(name='query1')
+        from inputStream select maxForever(price1) as maxForeverValue
+        insert into outputStream;""",
+        [("inputStream", [36.0, 36.75, 35.75]),
+         ("inputStream", [37.88, 38.12, 37.62]),
+         ("inputStream", [39.00, 39.25, 38.62]),
+         ("inputStream", [36.88, 37.75, 36.75]),
+         ("inputStream", [38.12, 38.12, 37.75]),
+         ("inputStream", [38.12, 40.0, 37.75])],
+        [(36.0,), (37.88,), (39.0,), (39.0,), (39.0,), (39.0,)],
+        stream="outputStream", playback=True)
+
+
+def test_max_forever_with_window_still_monotonic():
+    """maxForever inside a length window ignores expiry."""
+    run_query("""
+        define stream S (v double);
+        @info(name='query1')
+        from S#window.length(1)
+        select maxForever(v) as m insert into outputStream;""",
+        [("S", [5.0]), ("S", [9.0]), ("S", [3.0])],
+        [(5.0,), (9.0,), (9.0,)], stream="outputStream", playback=True)
+
+
+def test_min_forever_never_increases():
+    run_query("""
+        define stream inputStream (price1 double);
+        @info(name='query1')
+        from inputStream select minForever(price1) as m
+        insert into outputStream;""",
+        [("inputStream", [36.0]), ("inputStream", [35.0]),
+         ("inputStream", [37.0])],
+        [(36.0,), (35.0,), (35.0,)], stream="outputStream", playback=True)
+
+
+# --------------------------------------------------------- and / or
+
+AND_APP = """
+    define stream cscStream (messageID string, isFraud bool, price double);
+    @info(name='query1')
+    from cscStream#window.lengthBatch(3)
+    select messageID, and(isFraud) as isValidTransaction
+    group by messageID
+    insert all events into outputStream;
+"""
+
+
+def test_and_aggregator_all_true():
+    """testAndAggregatorTrueOnlyScenario: and() over a batch of trues."""
+    run_query(AND_APP,
+              [("cscStream", ["messageId1", True, 35.75]),
+               ("cscStream", ["messageId1", True, 35.75]),
+               ("cscStream", ["messageId1", True, 35.75])],
+              [("messageId1", True)], stream="outputStream", playback=True)
+
+
+def test_and_aggregator_all_false():
+    run_query(AND_APP,
+              [("cscStream", ["messageId1", False, 35.75]),
+               ("cscStream", ["messageId1", False, 35.75]),
+               ("cscStream", ["messageId1", False, 35.75])],
+              [("messageId1", False)], stream="outputStream", playback=True)
+
+
+def test_and_aggregator_mixed():
+    run_query(AND_APP,
+              [("cscStream", ["messageId1", True, 35.75]),
+               ("cscStream", ["messageId1", False, 35.75]),
+               ("cscStream", ["messageId1", True, 35.75])],
+              [("messageId1", False)], stream="outputStream", playback=True)
+
+
+def test_or_aggregator_any_true():
+    app = AND_APP.replace("and(isFraud)", "or(isFraud)")
+    run_query(app,
+              [("cscStream", ["messageId1", False, 35.75]),
+               ("cscStream", ["messageId1", True, 35.75]),
+               ("cscStream", ["messageId1", False, 35.75])],
+              [("messageId1", True)], stream="outputStream", playback=True)
+
+
+def test_or_aggregator_all_false():
+    app = AND_APP.replace("and(isFraud)", "or(isFraud)")
+    run_query(app,
+              [("cscStream", ["messageId1", False, 35.75]),
+               ("cscStream", ["messageId1", False, 35.75]),
+               ("cscStream", ["messageId1", False, 35.75])],
+              [("messageId1", False)], stream="outputStream", playback=True)
+
+
+def test_and_aggregator_sliding_window_expiry():
+    """and() over a sliding length window recomputes as events expire."""
+    run_query("""
+        define stream S (ok bool);
+        @info(name='query1')
+        from S#window.length(2)
+        select and(ok) as allok insert into outputStream;""",
+        [("S", [True]), ("S", [False]), ("S", [True]), ("S", [True])],
+        [(True,), (False,), (False,), (True,)],
+        stream="outputStream", playback=True)
+
+
+# ------------------------------------------- custom aggregator extension
+
+def test_custom_string_concat_aggregator_extension():
+    """query/extension corpus shape (StringConcatAggregatorString): a
+    user-registered AttributeAggregator resolves by ns:name in selects."""
+    import numpy as np
+    from siddhi_tpu import SiddhiManager, StreamCallback
+    from siddhi_tpu.core.aggregator import AttributeAggregator
+    from siddhi_tpu.core.event import CURRENT, EXPIRED, RESET
+    from siddhi_tpu.query_api.definition import AttrType
+
+    class StringConcatAggregator(AttributeAggregator):
+        name = "concat"
+
+        def __init__(self, input_type):
+            super().__init__(input_type)
+            self.parts = []
+
+        @property
+        def output_type(self):
+            return AttrType.STRING
+
+        def process(self, values, types):
+            out = np.empty(len(types), object)
+            for i, t in enumerate(types):
+                if t == CURRENT:
+                    self.parts.append(str(values[i]))
+                elif t == EXPIRED:
+                    self.parts.remove(str(values[i]))
+                elif t == RESET:
+                    self.parts.clear()
+                out[i] = "".join(self.parts)
+            return out
+
+        def state(self):
+            return {"parts": list(self.parts)}
+
+        def restore(self, s):
+            self.parts = list(s["parts"])
+
+    m = SiddhiManager()
+    m.set_extension("custom:concat", StringConcatAggregator)
+    rt = m.create_siddhi_app_runtime("""
+        @app:playback
+        define stream S (sym string);
+        @info(name='q')
+        from S#window.length(2)
+        select custom:concat(sym) as joined insert into Out;""")
+    got = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: got.extend(e.data[0] for e in evs)))
+    rt.start()
+    ts = 1_000_000
+    for s in ("A", "B", "C"):
+        rt.get_input_handler("S").send([s], timestamp=ts)
+        ts += 100
+    rt.shutdown()
+    assert got == ["A", "AB", "BC"]
+
+
+# ------------------------------------------------------------- stdDev
+
+def test_stddev_aggregator():
+    """Attribute stdDev over a growing set (reference
+    attribute/StdDevAggregator tests)."""
+    run_query("""
+        define stream S (v double);
+        @info(name='query1')
+        from S select stdDev(v) as sd insert into outputStream;""",
+        [("S", [2.0]), ("S", [4.0])],
+        [(0.0,), (1.0,)], stream="outputStream", playback=True)

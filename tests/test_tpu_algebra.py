@@ -155,6 +155,22 @@ def test_leading_or_same_event_left_side_wins():
     assert_parity(app, [A(1, 2, 20.0), A(2, 0, 60.0)])
 
 
+def test_leading_or_arm_leaves_clean_lmask_for_downstream_logical():
+    """A leading or-group that completes on arming must hand the partial to
+    the next unit with a CLEAN side mask — stale bits made a downstream
+    `and` believe one side was already satisfied."""
+    app = STREAMS + """
+        @info(name='q')
+        from every (e1=A[v > 10.0] or e2=B[w > 100.0])
+             -> e3=A[v > 50.0] and e4=B[w > 5.0]
+        select e1.v as v1, e3.v as v3, e4.w as w4 insert into Out;
+    """
+    assert_parity(app, [A(1, 0, 20.0), B(2, 0, 9.0)])
+    app_seq = app.replace("-> e3=", ", e3=")
+    assert_parity(app_seq, [A(1, 0, 20.0), A(2, 0, 30.0), A(3, 0, 60.0),
+                            B(4, 0, 9.0)])
+
+
 def test_logical_or_null_side_decodes_none():
     app = STREAMS + """
         @info(name='q')
