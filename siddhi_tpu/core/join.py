@@ -293,8 +293,12 @@ class JoinRuntime:
             else:
                 # record table: condition pushdown, one native store probe
                 # per arriving row (≙ AbstractRecordTable.find with the
-                # compiled condition's per-probe parameters)
-                chunks = [table.find(cc, data, i) for i in range(n)]
+                # compiled condition's per-probe parameters).  One lock
+                # acquisition for the whole chunk so a concurrent
+                # insert/delete cannot yield an inconsistent join view
+                # across rows (RLock: find()'s nested acquire is safe)
+                with table.lock:
+                    chunks = [table.find(cc, data, i) for i in range(n)]
                 buf = EventChunk.concat(chunks)
                 rows, off = [], 0
                 for c in chunks:

@@ -251,7 +251,19 @@ class StateUnit:
         kept: List[StateEvent] = []
         for se in self.pending:
             if self._expired(se, ts):
-                if self.within_every_pre is not None:
+                # forward the expired partial to the every-group head
+                # EXCEPT when that head is this very unit: the reference
+                # would then addEveryState into the LinkedList it is
+                # iterating (StreamPreStateProcessor.java:298-306 +
+                # updateState :280-288 → ConcurrentModificationException),
+                # i.e. the self-forward path is broken/unreachable
+                # upstream — here the partial simply dies, matching the
+                # device kernel's within-expiry (`A -> every B within t`
+                # stops firing t after the chain start).  Forwards to a
+                # DIFFERENT head (multi-unit groups, leading groups) keep
+                # reference behavior.
+                if self.within_every_pre is not None and \
+                        self.within_every_pre is not self:
                     self.within_every_pre.add_every_state(se)
                     self.within_every_pre.update_state()
                 continue
@@ -471,7 +483,10 @@ class StateUnit:
         partner = self.partner
         for se in self.pending:
             if self._expired(se, now):
-                if self.within_every_pre is not None:
+                # self-forward would mutate the list under iteration —
+                # see process_and_return
+                if self.within_every_pre is not None and \
+                        self.within_every_pre is not self:
                     self.within_every_pre.add_every_state(se)
                     self.within_every_pre.update_state()
                 continue

@@ -365,7 +365,15 @@ class AbstractRecordTable:
                        assignments: List[Tuple[str, RecordExpr]],
                        add_records: List[Dict[str, Any]]) -> None:
         """Default: per-row update-if-present-else-add. Stores with a native
-        upsert (SQL ON CONFLICT ...) override."""
+        upsert (SQL ON CONFLICT ...) override — SQLiteStore does when a
+        primary key is declared.
+
+        SINGLE-WRITER ASSUMPTION: the engine serializes its own calls
+        under `self.lock`, but the find→write pair is not a store-level
+        transaction — a concurrent EXTERNAL writer (another process on the
+        same backing store) or a crash between the probe and the write can
+        double-insert.  Stores shared with external writers must override
+        this with their native atomic upsert."""
         for pr, rec in zip(param_rows, add_records):
             if any(True for _ in self.find_records(condition, pr)):
                 self.update_records(condition, [pr], assignments)

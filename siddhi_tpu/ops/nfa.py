@@ -85,6 +85,11 @@ class NfaSpec(NamedTuple):
     is_sequence: bool = False
     arm_once: bool = False            # single-shot arming
     every_group_end: int = 0          # last unit of the `every` re-arm group
+    tail_every_start: int = -1        # first unit of a trailing `every`
+    #                                   group: a completing partial re-arms
+    #                                   there (captures intact) instead of
+    #                                   dying — `A -> every B` semantics
+    #                                   (StateInputStreamParser.java:272-273)
 
     @property
     def n_states(self) -> int:
@@ -176,6 +181,10 @@ class _StepState:
         self.m_ts = jnp.zeros((K,), jnp.int32)
         self.m_enter = jnp.zeros((K,), jnp.int32)
         self.m_seq = jnp.zeros((K,), jnp.int32)
+        # captures snapshotted AT COMPLETION — a trailing-every re-arm may
+        # clear group rows in the live slot after the match is recorded
+        R, C = self.caps.shape[1], self.caps.shape[2]
+        self.m_caps = jnp.zeros((K, R, C), jnp.float32)
 
     def land(self, pred, j_from: int, base_ts, fwd_cnt=None, fwd_dead=None):
         """Advance `pred` slots out of unit j_from at time base_ts.
@@ -187,11 +196,52 @@ class _StepState:
         if completed:
             self.m_mask = self.m_mask | pred
             self.m_ts = jnp.where(pred, base_ts, self.m_ts)
+            self.m_caps = jnp.where(pred[:, None, None], self.caps,
+                                    self.m_caps)
             # oracle emission order for same-event completions follows the
             # last unit's pending-list insertion order
             self.m_enter = jnp.where(pred, self.enter, self.m_enter)
             self.m_seq = jnp.where(pred, self.seq, self.m_seq)
-            self.st = jnp.where(pred, -1, self.st)
+            if spec.tail_every_start >= 0:
+                # trailing `every`: the match is emitted AND the partial
+                # re-arms at the group start, keeping its pre-group
+                # captures (the reference's nextEveryStatePreProcessor
+                # loop, StreamPostStateProcessor.java:66-68); group-side
+                # captures are overwritten by the next firing
+                te = spec.tail_every_start
+                self.st = jnp.where(pred, te, self.st)
+                # the oracle APPENDS re-armed clones to the pending list in
+                # emission order, so future same-ts ties must rank them
+                # after older entries and in their prior pending order:
+                # fresh seq = counter + rank by prior (enter, seq)
+                e, sq = self.enter, self.seq
+                less = (e[None, :] < e[:, None]) | \
+                    ((e[None, :] == e[:, None]) & (sq[None, :] < sq[:, None]))
+                rank = jnp.sum(pred[None, :] & less, axis=1)
+                self.seq = jnp.where(pred, self.arm_seq + rank, self.seq)
+                self.arm_seq = self.arm_seq + \
+                    jnp.sum(pred.astype(jnp.int32))
+                self.enter = jnp.where(pred, base_ts, self.enter)
+                if self.lmask is not None:
+                    self.lmask = jnp.where(pred, 0, self.lmask)
+                # the oracle's re-arm clone clears LOGICAL side captures
+                # (StateUnit.add_every_state; reference
+                # LogicalPreStateProcessor.addEveryState) — simple rows are
+                # overwritten on the next match and stay
+                group_log_rows = [r for u in spec.units[te:]
+                                  for r in (u.row_a, u.row_b)
+                                  if u.kind == "logical" and r >= 0]
+                if group_log_rows:
+                    R = self.caps.shape[1]
+                    rm = np.zeros((R,), bool)
+                    rm[group_log_rows] = True
+                    sel = pred[:, None, None] & \
+                        jnp.asarray(rm)[None, :, None]
+                    self.caps = jnp.where(sel, jnp.float32(0), self.caps)
+                # count units are compile-rejected alongside trailing
+                # every; pre-group absent deadlines are never revisited
+            else:
+                self.st = jnp.where(pred, -1, self.st)
             if live0 and self.cnt_prev is not None:
                 # trailing min-0 count: match emitted on arrival, slot dies
                 pass
@@ -465,7 +515,6 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
             virgin_dies = valid & (stream != -2) & (s.armed_total == 0)
             s.armed_total = jnp.where(virgin_dies, 2, s.armed_total)
 
-    caps_snap = s.caps          # match decode sees pre-arm captures
     s.clear_slot(armed_here)
     if u0.kind == "logical":
         cA = valid & (stream == u0.stream_a) & conds[u0.cond_a][0]
@@ -484,6 +533,7 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
     emit_arm = armed_here & arm_match
     s.m_mask = s.m_mask | emit_arm
     s.m_ts = jnp.where(emit_arm, ts, s.m_ts)
+    s.m_caps = jnp.where(emit_arm[:, None, None], s.caps, s.m_caps)
     s.m_enter = jnp.where(emit_arm, ts, s.m_enter)
     s.m_seq = jnp.where(emit_arm, s.arm_seq, s.m_seq)
     live_arm = armed_here & ~arm_match
@@ -515,7 +565,7 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
             fire = valid & (s.st == j) & (s.deadline <= ts)
             s.land(fire, j, s.deadline)
 
-    match_caps = jnp.where(emit_arm[:, None, None], s.caps, caps_snap)
+    match_caps = s.m_caps
 
     out = {"slot_state": s.st, "slot_start": s.start,
            "slot_enter": s.enter, "slot_seq": s.seq, "arm_seq": s.arm_seq,

@@ -96,6 +96,7 @@ class _Lowering:
         self.units: List[_UnitDesc] = []
         self.is_every = False
         self.every_group_end = 0
+        self.tail_every_start = -1
         self.group_within: Optional[int] = None
         elements = _flatten_next(sis.state)
         first = elements[0]
@@ -111,11 +112,45 @@ class _Lowering:
                             "host-only")
                 self.group_within = first.within_ms
             elements = elements[1:]
+        # trailing `every` (`A -> every B` — the continuous-monitoring
+        # staple, StateInputStreamParser.java:272-273): the completing
+        # partial re-arms at the group start instead of dying.  Mid-chain
+        # `every` would fork partials (a clone waits at the group start
+        # while the original advances) — host-only.
+        tail = None
+        if elements and isinstance(elements[-1], EveryStateElement):
+            tail = elements[-1]
+            elements = elements[:-1]
         for el in elements:
             if isinstance(el, EveryStateElement):
-                _reject("`every` is supported only on the leading element "
-                        "or prefix group")
+                _reject("mid-chain `every` forks partials and is host-only "
+                        "(leading and trailing `every` compile)")
             self._lower_element(el)
+        if tail is not None:
+            if not self.units:
+                _reject("internal: trailing every with empty prefix")
+            if tail.within_ms is not None:
+                _reject("`within` on a trailing `every` group is host-only")
+            self.tail_every_start = len(self.units)
+            for el in _flatten_next(tail.state):
+                if isinstance(el, EveryStateElement):
+                    _reject("nested `every` is host-only")
+                self._lower_element(el)
+            for u in self.units[self.tail_every_start:]:
+                if u.kind not in ("simple", "logical"):
+                    _reject(f"a trailing `every` group supports simple/"
+                            f"logical conditions only (got {u.kind})")
+            if any(u.kind == "count" for u in self.units):
+                # the oracle's re-arm clone shares/forks kleene chains in
+                # ways the slot ring does not model — verified host-only
+                _reject("kleene counts in a trailing-`every` chain are "
+                        "host-only")
+            if any(u.kind == "absent" for u in self.units):
+                # prefix absent deadlines interacting with tail re-arms
+                # have no conformance coverage yet — host-only until the
+                # oracle parity is demonstrated
+                _reject("absent states in a trailing-`every` chain are "
+                        "host-only")
         self._validate()
 
     def _side_of(self, el: StreamStateElement, idx_hint: int) -> _Side:
@@ -247,7 +282,12 @@ class CompiledPatternNFA:
 
     def __init__(self, app_string, n_partitions: int,
                  n_slots: int = 8, query_name: Optional[str] = None,
-                 parameterize: bool = False, query: Optional[Query] = None):
+                 parameterize: bool = False, query: Optional[Query] = None,
+                 mesh: Any = "auto"):
+        """mesh: "auto" (default) shards the partition axis over all local
+        devices when more than one exists (parallel/mesh.auto_mesh); a
+        jax.sharding.Mesh pins an explicit mesh; None forces single-device.
+        The partition lane count rounds up to a mesh-size multiple."""
         app = (SiddhiCompiler.parse(app_string)
                if isinstance(app_string, str) else app_string)
         self.app = app
@@ -491,11 +531,16 @@ class CompiledPatternNFA:
             matched_lane=tuple(matched_lane),
             attr_names=tuple(self.attr_names), is_every=is_every,
             is_sequence=self.is_sequence, arm_once=arm_once,
-            every_group_end=low.every_group_end)
+            every_group_end=low.every_group_end,
+            tail_every_start=low.tail_every_start)
         self.has_absent = any(u.kind == "absent" for u in self.units)
+        from ..parallel.mesh import auto_mesh, round_up_partitions
+        self.mesh = auto_mesh() if isinstance(mesh, str) and mesh == "auto" \
+            else mesh
+        n_partitions = round_up_partitions(n_partitions, self.mesh)
         self.n_partitions = n_partitions
-        self.carry = make_carry(self.spec, n_partitions)
-        self._step = jax.jit(build_block_step(self.spec), donate_argnums=0)
+        self.carry = self._place_carry(make_carry(self.spec, n_partitions))
+        self._step = self._jit_step()
         self.base_ts: Optional[int] = None
 
         # capture lanes ride float32: INT/LONG values above 2**24 round
@@ -763,14 +808,34 @@ class CompiledPatternNFA:
 
     # ------------------------------------------------------------ execution
 
+    def _place_carry(self, carry: Dict[str, Any]) -> Dict[str, jnp.ndarray]:
+        """Device placement: partition-axis sharded over the mesh when one
+        is set (parallel/mesh.py), plain device arrays otherwise."""
+        if self.mesh is None:
+            return {k: jnp.asarray(v) for k, v in carry.items()}
+        from ..parallel.mesh import shard_carry
+        return shard_carry(carry, self.mesh)
+
+    def _jit_step(self):
+        if self.mesh is None:
+            return jax.jit(build_block_step(self.spec), donate_argnums=0)
+        from ..parallel.mesh import jit_engine_step
+        return jit_engine_step(self.spec, self.mesh)
+
     def grow(self, n_partitions: int) -> None:
         """Widen the partition axis (slab growth for keyed partitioning);
-        existing lane state is preserved, new lanes start empty."""
+        existing lane state is preserved, new lanes start empty.  Under a
+        mesh the new count rounds up to a mesh-size multiple and the grown
+        carry is re-placed shard-wise."""
+        from ..parallel.mesh import round_up_partitions
+        n_partitions = round_up_partitions(n_partitions, self.mesh)
         if n_partitions <= self.n_partitions:
             return
         fresh = make_carry(self.spec, n_partitions - self.n_partitions)
-        self.carry = {k: jnp.concatenate([self.carry[k], fresh[k]], axis=0)
-                      for k in self.carry}
+        self.carry = self._place_carry(
+            {k: np.concatenate([np.asarray(self.carry[k]),
+                                np.asarray(fresh[k])], axis=0)
+             for k in self.carry})
         self.n_partitions = n_partitions
 
     def grow_slots(self, n_slots: int) -> None:
@@ -780,29 +845,29 @@ class CompiledPatternNFA:
         if n_slots <= self.spec.n_slots:
             return
         pad = n_slots - self.spec.n_slots
-        c = dict(self.carry)
+        c = {k: np.asarray(v) for k, v in self.carry.items()}
         P = self.n_partitions
         R, C = max(self.spec.n_rows, 1), max(self.spec.n_caps, 1)
 
         def cat(key, fill, shape, dt):
-            c[key] = jnp.concatenate(
-                [c[key], jnp.full(shape, fill, dt)], axis=1)
-        cat("slot_state", -1, (P, pad), jnp.int32)
-        cat("slot_start", 0, (P, pad), jnp.int32)
-        cat("slot_enter", 0, (P, pad), jnp.int32)
-        cat("slot_seq", 0, (P, pad), jnp.int32)
-        c["captures"] = jnp.concatenate(
-            [c["captures"], jnp.zeros((P, pad, R, C), jnp.float32)], axis=1)
+            c[key] = np.concatenate(
+                [c[key], np.full(shape, fill, dt)], axis=1)
+        cat("slot_state", -1, (P, pad), np.int32)
+        cat("slot_start", 0, (P, pad), np.int32)
+        cat("slot_enter", 0, (P, pad), np.int32)
+        cat("slot_seq", 0, (P, pad), np.int32)
+        c["captures"] = np.concatenate(
+            [c["captures"], np.zeros((P, pad, R, C), np.float32)], axis=1)
         if "cnt_cur" in c:
-            cat("cnt_cur", 0, (P, pad), jnp.int32)
-            cat("cnt_prev", -1, (P, pad), jnp.int32)
+            cat("cnt_cur", 0, (P, pad), np.int32)
+            cat("cnt_prev", -1, (P, pad), np.int32)
         if "lmask" in c:
-            cat("lmask", 0, (P, pad), jnp.int32)
+            cat("lmask", 0, (P, pad), np.int32)
         if "deadline" in c:
-            cat("deadline", 0, (P, pad), jnp.int32)
-        self.carry = c
+            cat("deadline", 0, (P, pad), np.int32)
+        self.carry = self._place_carry(c)
         self.spec = self.spec._replace(n_slots=n_slots)
-        self._step = jax.jit(build_block_step(self.spec), donate_argnums=0)
+        self._step = self._jit_step()
 
     def max_active_slots(self) -> int:
         """Device reduction: the fullest partition's live-partial count."""
@@ -832,8 +897,20 @@ class CompiledPatternNFA:
                 "str_decoder": list(self.str_decoder)}
 
     def restore_state(self, state: Dict[str, Any]) -> None:
-        self.n_partitions = state["n_partitions"]
-        self.carry = {k: jnp.asarray(v) for k, v in state["carry"].items()}
+        from ..parallel.mesh import round_up_partitions
+        snap_p = state["n_partitions"]
+        carry = {k: np.asarray(v) for k, v in state["carry"].items()}
+        # a snapshot from a different device count may not divide the mesh:
+        # pad with empty lanes up to a shardable count
+        self.n_partitions = round_up_partitions(snap_p, self.mesh)
+        if self.n_partitions > snap_p:
+            pad = self.n_partitions - snap_p
+            fresh = make_carry(
+                self.spec._replace(n_slots=carry["slot_state"].shape[1]),
+                pad)
+            carry = {k: np.concatenate([carry[k], np.asarray(fresh[k])],
+                                       axis=0) for k in carry}
+        self.carry = self._place_carry(carry)
         self.base_ts = state["base_ts"]
         dec = state.get("str_decoder")
         if dec is not None and self.encoded_attrs:
@@ -846,8 +923,7 @@ class CompiledPatternNFA:
         k = int(self.carry["slot_state"].shape[1])
         if k != self.spec.n_slots:    # snapshot taken after slot growth
             self.spec = self.spec._replace(n_slots=k)
-            self._step = jax.jit(build_block_step(self.spec),
-                                 donate_argnums=0)
+            self._step = self._jit_step()
 
     def process_block(self, block: Dict[str, np.ndarray]):
         """Run one [P, T] packed block; returns raw match buffers."""
@@ -863,8 +939,9 @@ class CompiledPatternNFA:
         self._maybe_rebase(now_ms, now_ms)
         block = make_timer_block(self.n_partitions, now_ms - self.base_ts,
                                  self.attr_names)
-        mask, caps, ts, enter, seq = self.process_block(
-            {k: jnp.asarray(v) for k, v in block.items()})
+        # numpy leaves: jit places them per its in_shardings (sharded under
+        # a mesh) — pre-committing to one device would conflict
+        mask, caps, ts, enter, seq = self.process_block(block)
         return self.decode_matches(mask, caps, ts, enter, seq)
 
     def process_events(self, partition_ids: np.ndarray,
@@ -1005,8 +1082,12 @@ class CompiledPatternBank:
                  ring: int = 0):
         import jax
         from ..ops.nfa import build_bank_step, make_bank_carry
+        # the bank carries its own [N, P, ...] state and steps it with its
+        # own jit; multi-device banks go through parallel/distributed.
+        # DistributedPatternBank, so the inner NFA stays single-device
         self.nfa = CompiledPatternNFA(apps[0], n_partitions=n_partitions,
-                                      n_slots=n_slots, parameterize=True)
+                                      n_slots=n_slots, parameterize=True,
+                                      mesh=None)
         self.n_patterns = len(apps)
         self.n_partitions = n_partitions
         # top_k over the per-partition counts caps the ring at P

@@ -101,6 +101,9 @@ class SQLiteStore(AbstractQueryableRecordTable):
         self._bools = [a.name for a in definition.attributes
                        if a.type == AttrType.BOOL]
         self.sql_log: List[str] = []
+        from ..query_api import find_annotation
+        pk_ann = find_annotation(definition.annotations, "primarykey")
+        self._pk: List[str] = pk_ann.positional() if pk_ann else []
         cols = []
         for a in definition.attributes:
             t = _SQL_TYPE.get(a.type)
@@ -109,12 +112,23 @@ class SQLiteStore(AbstractQueryableRecordTable):
                     f"sqlite store: unsupported attribute type {a.type} "
                     f"for '{a.name}'")
             cols.append(f'{_q(a.name)} {t}')
+        if self._pk:
+            cols.append(f'PRIMARY KEY ({", ".join(_q(k) for k in self._pk)})')
         # engine probes may come from any junction/worker thread; all calls
         # are serialized by AbstractRecordTable.lock
         self._conn = sqlite3.connect(db, check_same_thread=False)
         self._conn.execute(
             f'CREATE TABLE IF NOT EXISTS {_q(table)} ({", ".join(cols)})')
         self._conn.commit()
+        # a pre-existing table (CREATE IF NOT EXISTS no-op) may lack the
+        # declared PK — ON CONFLICT(pk) would then raise OperationalError
+        # at runtime, so verify the REAL schema before enabling the native
+        # upsert path
+        actual_pk = [r[1] for r in sorted(
+            (r for r in self._conn.execute(
+                f'PRAGMA table_info({_q(table)})') if r[5] > 0),
+            key=lambda r: r[5])]
+        self._pk_native = bool(self._pk) and actual_pk == list(self._pk)
 
     def validate_expr(self, e) -> None:
         """Refuse IR whose SQLite semantics diverge from the engine's
@@ -125,6 +139,15 @@ class SQLiteStore(AbstractQueryableRecordTable):
             raise SiddhiAppCreationError(
                 "sqlite store: '%' on REAL operands truncates to INTEGER "
                 "in SQLite (engine fmod semantics diverge)")
+        import math
+        if isinstance(e, Const) and isinstance(e.value, float) and \
+                not math.isfinite(e.value):
+            # repr(inf)/repr(nan) render as bare `inf`/`nan` — invalid
+            # SQLite syntax; refuse at compile time (clean host fallback)
+            # instead of an OperationalError at probe time
+            raise SiddhiAppCreationError(
+                "sqlite store: non-finite float constants are not "
+                "renderable as SQLite literals")
         for c in record_expr_children(e):
             self.validate_expr(c)
 
@@ -174,6 +197,69 @@ class SQLiteStore(AbstractQueryableRecordTable):
         sql = f'DELETE FROM {_q(self._table)} WHERE {_render(condition)}'
         for pr in (param_rows or [{}]):
             self._exec(sql, pr)
+        self._conn.commit()
+
+    def _pk_equality(self, e) -> Optional[Dict[str, Any]]:
+        """When the condition is exactly an AND-chain of equality tests
+        covering the declared primary key, return {pk col: operand node}
+        (Param or Const); else None.  Shape alone is NOT sufficient for
+        the native upsert — the caller must also check per row that each
+        compared operand VALUE equals the value being inserted into that
+        PK column, otherwise `on T.pk == <something else>` would match a
+        different row than ON CONFLICT(pk) does."""
+        ops: Dict[str, Any] = {}
+
+        def walk(x) -> bool:
+            if isinstance(x, BoolAnd):
+                return walk(x.left) and walk(x.right)
+            if isinstance(x, Cmp) and x.op == "==":
+                side = (x.left if isinstance(x.left, Col) else
+                        x.right if isinstance(x.right, Col) else None)
+                other = x.right if side is x.left else x.left
+                if side is not None and isinstance(other, (Param, Const)):
+                    ops[side.name] = other
+                    return True
+            return False
+        if e is not None and walk(e) and set(ops) == set(self._pk):
+            return ops
+        return None
+
+    def upsert_records(self, condition, param_rows, assignments,
+                       add_records) -> None:
+        """Native atomic upsert via INSERT ... ON CONFLICT when a primary
+        key is declared, the match condition is PK equality, AND (per row)
+        the compared values equal the inserted PK values — only then do
+        engine find-then-update semantics coincide with ON CONFLICT(pk).
+        Closes the probe→write race of the SPI default against external
+        writers on the same database; non-coinciding rows take the SPI
+        default path."""
+        ops = self._pk_equality(condition) if self._pk_native else None
+        if ops is None:
+            super().upsert_records(condition, param_rows, assignments,
+                                   add_records)
+            return
+        cols = self.names
+        sets = ", ".join(f'{_q(c)} = {_render(e)}' for c, e in assignments)
+        sql = (f'INSERT INTO {_q(self._table)} '
+               f'({", ".join(_q(c) for c in cols)}) '
+               f'VALUES ({", ".join(":__ins_" + c for c in cols)}) '
+               f'ON CONFLICT({", ".join(_q(k) for k in self._pk)}) '
+               f'DO UPDATE SET {sets}')
+        logged = False
+        for pr, rec in zip(param_rows, add_records):
+            cmp_vals = {k: (pr.get(op.name) if isinstance(op, Param)
+                            else op.value) for k, op in ops.items()}
+            if any(cmp_vals[k] != rec.get(k) for k in self._pk):
+                # condition matches a row other than the one being
+                # inserted — ON CONFLICT semantics diverge, use the
+                # find-then-write default for this row
+                super().upsert_records(condition, [pr], assignments, [rec])
+                continue
+            if not logged:
+                self.sql_log.append(sql)
+                logged = True
+            self._conn.execute(sql, _clean_params(
+                {**pr, **{"__ins_" + c: rec.get(c) for c in cols}}))
         self._conn.commit()
 
     def contains_records(self, condition, params) -> bool:

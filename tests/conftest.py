@@ -1,9 +1,14 @@
 """Test configuration: force a virtual 8-device CPU mesh so sharding tests run
 without TPU hardware (the driver separately dry-runs multi-chip compilation).
 
-Must run before any jax import: the axon TPU plugin registers itself whenever
-PALLAS_AXON_POOL_IPS is set, regardless of JAX_PLATFORMS, so both are forced.
-"""
+The axon TPU plugin registers itself from a sitecustomize hook AT INTERPRETER
+START (before conftest runs), importing jax with JAX_PLATFORMS=axon already
+snapshotted — so scrubbing os.environ here is NOT enough: the platform choice
+must be overridden through jax.config on the already-imported module.  The
+backend itself is still uninitialised at conftest time (no jax.devices() call
+has happened), so the override + XLA_FLAGS below take effect.  A hard assert
+guards the whole suite: round-2's conftest silently lost this fight and every
+"virtual 8-device" test actually ran on the single real TPU chip."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -12,6 +17,14 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8 and jax.devices()[0].platform == "cpu", \
+    (f"test suite needs the virtual 8-device CPU mesh, got "
+     f"{len(jax.devices())}x {jax.devices()[0].platform} — the axon plugin "
+     f"won the platform fight again (see conftest docstring)")
 
 # Build the native .so if the toolchain is present and it's missing/stale, so
 # test runs exercise the real C++ path rather than the numpy fallback.
@@ -25,3 +38,102 @@ if os.path.exists(_src) and (
         or os.path.getmtime(_so) < os.path.getmtime(_src)):
     subprocess.run(["make", "-C", os.path.join(_here, "native")],
                    capture_output=True)
+
+
+# --------------------------------------------------------------------------
+# Device-hit telemetry (VERDICT r2 next #6): ref_harness.run_query records
+# whether each conformance test actually exercised the device engine.  At
+# session end the per-suite counts are written to docs/device_hits.json;
+# when the session collected every suite listed in tests/device_hit_floor
+# .json (i.e. a full run), a drop below the floor FAILS the run, and the
+# generated table in docs/conformance_map.md is refreshed.
+
+_COLLECTED_FILES = set()
+
+
+def pytest_collection_modifyitems(session, config, items):
+    for it in items:
+        _COLLECTED_FILES.add(it.nodeid.split("::")[0].split("/")[-1])
+
+
+def _device_hit_counts():
+    import sys
+    rh = None
+    for name, mod in list(sys.modules.items()):
+        if name.endswith("ref_harness") and getattr(mod, "TELEMETRY", None):
+            rh = mod
+            break
+    if rh is None:
+        return None
+    per = {}
+    for nodeid, dev in rh.TELEMETRY:
+        suite = nodeid.split("::")[0].split("/")[-1]
+        test = nodeid.split(" ")[0]
+        tot, hits = per.setdefault(suite, (set(), set()))
+        tot.add(test)
+        if dev:
+            hits.add(test)
+    return {s: {"tests": len(t), "device_hits": len(h)}
+            for s, (t, h) in sorted(per.items())}
+
+
+def pytest_sessionfinish(session, exitstatus):
+    import json
+    if exitstatus != 0:
+        # aborted/failing runs have partial telemetry — don't clobber the
+        # generated docs or mask the real failure with a floor error
+        return
+    counts = _device_hit_counts()
+    if not counts:
+        return
+    floor_path = os.path.join(_here, "tests", "device_hit_floor.json")
+    floor = {}
+    if os.path.exists(floor_path):
+        with open(floor_path) as f:
+            floor = json.load(f)
+    if floor and not set(floor) <= _COLLECTED_FILES:
+        return              # partial run: don't clobber full-run telemetry
+    out = os.path.join(_here, "docs", "device_hits.json")
+    with open(out, "w") as f:
+        json.dump(counts, f, indent=1, sort_keys=True)
+    if not floor:
+        return
+    _refresh_conformance_map(counts)
+    bad = {s: (counts.get(s, {}).get("device_hits", 0), need)
+           for s, need in floor.items()
+           if counts.get(s, {}).get("device_hits", 0) < need}
+    if bad:
+        import pytest
+        pytest.exit(
+            "device-hit regression: " + ", ".join(
+                f"{s} hit {got}<{need}" for s, (got, need) in bad.items()),
+            returncode=1)
+
+
+def _refresh_conformance_map(counts):
+    path = os.path.join(_here, "docs", "conformance_map.md")
+    if not os.path.exists(path):
+        return
+    begin, end = "<!-- device-hit:begin -->", "<!-- device-hit:end -->"
+    rows = "\n".join(
+        f"| `{s}` | {c['tests']} | {c['device_hits']} |"
+        for s, c in counts.items())
+    total_t = sum(c["tests"] for c in counts.values())
+    total_h = sum(c["device_hits"] for c in counts.values())
+    block = (f"{begin}\n## Device-hit telemetry (generated by the test "
+             f"run)\n\nPer conformance suite: how many `run_query` tests "
+             f"re-executed on the DEVICE engine (planner-compiled) and "
+             f"asserted backend-identical output — the floor is enforced "
+             f"by `tests/device_hit_floor.json` on full runs.\n\n"
+             f"| suite | harness tests | device-validated |\n|---|---|---|\n"
+             f"{rows}\n| **total** | **{total_t}** | **{total_h}** |\n{end}")
+    with open(path) as f:
+        text = f.read()
+    if begin in text:
+        import re
+        text = re.sub(re.escape(begin) + ".*?" + re.escape(end), block,
+                      text, flags=re.S)
+    else:
+        text = text.rstrip() + "\n\n" + block + "\n"
+    with open(path, "w") as f:
+        f.write(text)

@@ -8,11 +8,19 @@ them through the public API.  When the planner routes the query to the
 device engine the same expectations apply — backend-identical output is
 asserted by running both engines.
 """
+import os
 from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from siddhi_tpu import QueryCallback, SiddhiManager, StreamCallback
+
+# device-hit telemetry (VERDICT r2 next #6): every run_query records
+# whether the planner actually executed the device engine, keyed by the
+# running test.  conftest aggregates per suite at session end, regenerates
+# the table in docs/conformance_map.md, and fails the run if a full-suite
+# session regresses below tests/device_hit_floor.json.
+TELEMETRY: List[Tuple[str, bool]] = []
 
 
 def _norm(rows):
@@ -87,6 +95,8 @@ def run_query(app: str, sends: Sequence, expected: Sequence,
             f"host removed {removed!r}, expected {list(expected_removed)!r}"
     got_d, removed_d, backends = run_once(app, sends, cb_q, stream,
                                           playback, advance_to, None)
+    TELEMETRY.append((os.environ.get("PYTEST_CURRENT_TEST", "?"),
+                      any(b == "device" for b in backends.values())))
     if any(b == "device" for b in backends.values()):
         assert norm(_norm(got_d)) == norm(_norm(got)), \
             f"device diverged: {got_d!r} vs host {got!r}"

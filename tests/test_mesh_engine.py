@@ -1,0 +1,141 @@
+"""Engine-integrated mesh sharding (VERDICT r2 next #2): the planner-built
+device path must shard the partition axis over all local devices — these
+tests run on the conftest-forced 8-virtual-device CPU mesh and assert
+device==host THROUGH THE PUBLIC SiddhiManager API, plus sharded snapshot /
+restore and keyed-lane slab growth.
+
+Reference semantics: partition/PartitionRuntime.java:255-308 (per-key
+runtime clones — here rows of one mesh-sharded state slab)."""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+PAT_APP = """
+define stream S (sym string, price float, kind int);
+partition with (sym of S) begin
+@info(name='q')
+from every e1=S[kind == 0 and price > 50.0] ->
+     e2=S[kind == 1 and price > e1.price]
+    within 10 sec
+select e1.price as p1, e2.price as p2
+insert into Out;
+end;
+"""
+
+
+def _batches(n_keys=32, n_batches=3, n=128, seed=11):
+    rng = np.random.default_rng(seed)
+    out, t0 = [], 1_000_000
+    for _ in range(n_batches):
+        out.append((
+            {"sym": np.asarray([f"k{i}" for i in
+                                rng.integers(0, n_keys, n)], object),
+             "price": rng.uniform(0, 100, n).astype(np.float32),
+             "kind": rng.integers(0, 2, n).astype(np.int32)},
+            t0 + np.arange(n, dtype=np.int64)))
+        t0 += 20_000
+    return out
+
+
+def _run(app, engine, batches, restore_mid=False):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(f"@app:playback "
+                                     f"@app:engine('{engine}') {app}")
+    got = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: got.extend((round(e.data[0], 3), round(e.data[1], 3))
+                               for e in evs)))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for bi, (cols, ts) in enumerate(batches):
+        h.send_batch(cols, timestamps=ts)
+        if restore_mid and bi == 0:
+            # snapshot → fresh runtime → restore → continue
+            snap = rt.snapshot()
+            rt.shutdown()
+            rt = m.create_siddhi_app_runtime(
+                f"@app:playback @app:engine('{engine}') {app}")
+            rt.restore(snap)
+            rt.add_callback("Out", StreamCallback(
+                lambda evs: got.extend(
+                    (round(e.data[0], 3), round(e.data[1], 3))
+                    for e in evs)))
+            rt.start()
+            h = rt.get_input_handler("S")
+    return sorted(got), rt
+
+
+def _device_nfa(rt):
+    prs = rt.partition_runtimes
+    assert prs and prs[0].device_mode
+    return next(iter(prs[0].device_query_runtimes.values())) \
+        .device_runtime.nfa
+
+
+def test_public_api_pattern_sharded_matches_host():
+    import jax
+    batches = _batches()
+    dev, dev_rt = _run(PAT_APP, "device", batches)
+    nfa = _device_nfa(dev_rt)
+    assert nfa.mesh is not None and \
+        int(nfa.mesh.devices.size) == len(jax.devices())
+    # carry leaves actually live on every device of the mesh
+    devs = {d for v in nfa.carry.values() for d in v.sharding.device_set}
+    assert len(devs) == len(jax.devices())
+    dev_rt.shutdown()
+    host, host_rt = _run(PAT_APP, "host", batches)
+    host_rt.shutdown()
+    assert len(dev) > 0 and dev == host
+
+
+def test_sharded_snapshot_restore_continues():
+    batches = _batches(seed=5)
+    dev, dev_rt = _run(PAT_APP, "device", batches, restore_mid=True)
+    dev_rt.shutdown()
+    host, host_rt = _run(PAT_APP, "host", batches)
+    host_rt.shutdown()
+    assert len(dev) > 0 and dev == host
+
+
+def test_keyed_lane_growth_under_mesh():
+    # more keys than the initial slab capacity (GROW_START=8): the sharded
+    # carry must grow in mesh-divisible steps without losing live partials
+    import jax
+    batches = _batches(n_keys=100, n=256, seed=7)
+    dev, dev_rt = _run(PAT_APP, "device", batches)
+    nfa = _device_nfa(dev_rt)
+    nd = len(jax.devices())
+    assert nfa.n_partitions >= 100 and nfa.n_partitions % nd == 0
+    dev_rt.shutdown()
+    host, host_rt = _run(PAT_APP, "host", batches)
+    host_rt.shutdown()
+    assert len(dev) > 0 and dev == host
+
+
+def test_unpartitioned_pattern_rounds_lane_count():
+    import jax
+    app = """
+    define stream S (price float, kind int);
+    @info(name='q')
+    from every e1=S[kind == 0] -> e2=S[kind == 1 and price > e1.price]
+        within 10 sec
+    select e1.price as p1, e2.price as p2 insert into Out;
+    """
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(f"@app:playback "
+                                     f"@app:engine('device') {app}")
+    got = []
+    rt.add_callback("Out", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    qr = rt.query_runtimes["q"]
+    nfa = qr.device_runtime.nfa
+    assert nfa.n_partitions == len(jax.devices())   # 1 rounded up
+    rng = np.random.default_rng(0)
+    n = 64
+    rt.get_input_handler("S").send_batch(
+        {"price": rng.uniform(0, 100, n).astype(np.float32),
+         "kind": rng.integers(0, 2, n).astype(np.int32)},
+        timestamps=1_000_000 + np.arange(n, dtype=np.int64))
+    rt.shutdown()
+    assert len(got) > 0

@@ -482,3 +482,27 @@ def test_sqlite_snapshot_skips_external_state():
     events = rt.query("from StockTable select symbol, volume")
     assert [tuple(e.data) for e in events] == [("IBM", 5)]
     rt.shutdown()
+
+
+def test_sqlite_store_native_upsert_on_conflict():
+    """With a declared @PrimaryKey and a PK-equality match condition the
+    sqlite store must use its atomic INSERT ... ON CONFLICT upsert (no
+    probe→write race against external writers) — visible in sql_log."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(APP_HEAD + """
+        @Store(type='sqlite') @PrimaryKey('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        from UpdateStockStream update or insert into StockTable
+            set StockTable.volume = UpdateStockStream.volume
+            on StockTable.symbol == UpdateStockStream.symbol;""")
+    rt.start()
+    h = rt.get_input_handler("UpdateStockStream")
+    for i, row in enumerate([["IBM", 75.6, 10], ["IBM", 75.6, 30],
+                             ["WSO2", 55.6, 5]]):
+        h.send(row, timestamp=1_000_000 + i * 100)
+    table = _sqlite_table_of(rt)
+    rows = sorted(table.find_records(None, {}), key=lambda r: r["symbol"])
+    assert [(r["symbol"], r["volume"]) for r in rows] == \
+        [("IBM", 30), ("WSO2", 5)]
+    assert any("ON CONFLICT" in s for s in table.sql_log), table.sql_log
+    rt.shutdown()

@@ -1,0 +1,194 @@
+"""Device/host conformance for trailing `every` (VERDICT r2 next #5):
+`A -> every B` — the continuous-monitoring staple — must compile onto the
+NFA kernel and match the host oracle byte-for-byte, including re-arm
+floods into the slot ring and `within` bounding every firing from the
+chain start.
+
+Reference: util/parser/StateInputStreamParser.java:272-273 (the last post
+processor of the every group loops to its first pre processor),
+StreamPostStateProcessor.java:66-68 (addEveryState clone)."""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+STREAMS = """
+define stream A (k int, v float);
+define stream B (k int, w float);
+"""
+
+
+def run_app(app, sends, engine=None):
+    prefix = f"@app:engine('{engine}') " if engine else ""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(prefix + app)
+    out = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: out.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    for sid, row, ts in sends:
+        rt.get_input_handler(sid).send(row, timestamp=ts)
+    backend = rt.query_runtimes["q"].backend
+    reason = rt.query_runtimes["q"].backend_reason
+    rt.shutdown()
+    return backend, reason, out
+
+
+def assert_parity(app, sends):
+    bh, _, host = run_app(app, sends, engine="host")
+    bd, reason, dev = run_app(app, sends)
+    assert bh == "host"
+    assert bd == "device", f"did not plan onto the device: {reason}"
+    assert host == dev, f"host={host} dev={dev}"
+    return host
+
+
+def A(ts, k, v):
+    return ("A", [k, v], ts)
+
+
+def B(ts, k, w):
+    return ("B", [k, w], ts)
+
+
+def test_simple_tail_every_fires_per_match():
+    app = STREAMS + """
+        @info(name='q')
+        from e1=A[v > 10.0] -> every e2=B[w > e1.v]
+        select e1.v as v1, e2.w as w2 insert into Out;
+    """
+    # one arming A, then every qualifying B fires (b=25, b=30, not b=5)
+    out = assert_parity(app, [
+        A(1000, 1, 20.0), B(1100, 1, 25.0), B(1200, 1, 5.0),
+        B(1300, 1, 30.0), A(1400, 1, 90.0), B(1500, 1, 50.0)])
+    assert len(out) >= 3
+
+
+def test_leading_and_trailing_every():
+    app = STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 10.0] -> every e2=B[w > e1.v]
+        select e1.v as v1, e2.w as w2 insert into Out;
+    """
+    # each armed A keeps firing on every later qualifying B
+    out = assert_parity(app, [
+        A(1000, 1, 20.0), A(1100, 1, 40.0), B(1200, 1, 25.0),
+        B(1300, 1, 45.0), B(1400, 1, 50.0), A(1500, 1, 60.0),
+        B(1600, 1, 70.0)])
+    assert len(out) >= 5
+
+
+def test_tail_every_with_within_expires():
+    app = STREAMS + """
+        @info(name='q')
+        from every e1=A[v > 10.0] -> every e2=B[w > e1.v] within 2 sec
+        select e1.v as v1, e2.w as w2 insert into Out;
+    """
+    # firings stop once the chain start is > 2s old
+    assert_parity(app, [
+        A(1000, 1, 20.0), B(1500, 1, 25.0), B(2500, 1, 30.0),
+        B(3500, 1, 40.0),          # expired for the first A
+        A(4000, 1, 15.0), B(4500, 1, 50.0), B(7000, 1, 60.0)])
+
+
+def test_tail_every_logical_or_group():
+    app = STREAMS + """
+        @info(name='q')
+        from e1=A[v > 10.0] -> every (e2=B[w > 5.0] or e3=A[k == 7])
+        select e1.v as v1, e2.w as w2, e3.v as v3 insert into Out;
+    """
+    assert_parity(app, [
+        A(1000, 1, 20.0), B(1100, 1, 8.0), A(1200, 7, 3.0),
+        B(1300, 1, 9.0), A(1400, 7, 4.0), B(1500, 1, 2.0)])
+
+
+def test_tail_every_group_two_steps():
+    app = STREAMS + """
+        @info(name='q')
+        from e1=A[v > 10.0] -> every (e2=B[w > 5.0] -> e3=B[w > e2.w])
+        select e1.v as v1, e2.w as w2, e3.w as w3 insert into Out;
+    """
+    # the two-step group re-arms as a whole after each completion
+    assert_parity(app, [
+        A(1000, 1, 20.0), B(1100, 1, 6.0), B(1200, 1, 9.0),
+        B(1300, 1, 7.0), B(1400, 1, 11.0), B(1500, 1, 3.0),
+        B(1600, 1, 8.0)])
+
+
+def test_tail_every_sequence_mode():
+    app = STREAMS + """
+        @info(name='q')
+        from e1=A[v > 10.0], every e2=A[v > e1.v]
+        select e1.v as v1, e2.v as v2 insert into Out;
+    """
+    # SEQUENCE: the re-armed partial must advance on the very next event
+    # or die (per-event reset barriers)
+    assert_parity(app, [
+        A(1000, 1, 20.0), A(1100, 1, 30.0), A(1200, 1, 25.0),
+        A(1300, 1, 40.0)])
+
+
+def test_tail_every_rearm_flood_grows_slots():
+    """Many armed chains all re-firing: the keyed engine path must grow
+    its slot ring rather than drop (StreamPreStateProcessor pending lists
+    never drop)."""
+    app = """
+    define stream S (sym string, price float, kind int);
+    partition with (sym of S) begin
+    @info(name='q')
+    from every e1=S[kind == 0] -> every e2=S[kind == 1 and price > e1.price]
+    select e1.price as p1, e2.price as p2 insert into Out;
+    end;
+    """
+    rng = np.random.default_rng(3)
+    n = 400
+    cols = {"sym": np.asarray([f"k{i}" for i in
+                               rng.integers(0, 4, n)], object),
+            "price": rng.uniform(0, 100, n).astype(np.float32),
+            "kind": rng.integers(0, 2, n).astype(np.int32)}
+    ts = 1_000_000 + np.arange(n, dtype=np.int64)
+
+    def run(engine):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(
+            f"@app:playback @app:engine('{engine}') {app}")
+        got = []
+        rt.add_callback("Out", StreamCallback(
+            lambda evs: got.extend((round(e.data[0], 3), round(e.data[1], 3))
+                                   for e in evs)))
+        rt.start()
+        rt.get_input_handler("S").send_batch(cols, timestamps=ts)
+        rt.shutdown()
+        return sorted(got)
+
+    host = run("host")
+    dev = run("device")
+    assert len(host) > 100 and host == dev
+
+
+def test_mid_chain_every_still_host_only():
+    app = STREAMS + """
+        @info(name='q')
+        from e1=A[v > 10.0] -> every e2=B[w > 5.0] -> e3=A[v > 50.0]
+        select e1.v as v1, e2.w as w2, e3.v as v3 insert into Out;
+    """
+    b, reason, _ = run_app(app, [A(1000, 1, 20.0), B(1100, 1, 8.0),
+                                 A(1200, 1, 60.0)])
+    assert b == "host" and "every" in (reason or "")
+
+
+def test_tail_every_group_within_expiry_parity():
+    """Top-level within + multi-unit trailing group: the oracle forwards a
+    C-expired partial to the group head B (different unit — reference
+    behavior), where it dies on its own expiry check; the kernel just
+    expires the slot.  Outputs must agree."""
+    app = STREAMS + """
+        @info(name='q')
+        from (e1=A[v > 10.0] -> every (e2=B[w > 5.0] -> e3=B[w > e2.w]))
+            within 2 sec
+        select e1.v as v1, e2.w as w2, e3.w as w3 insert into Out;
+    """
+    assert_parity(app, [
+        A(1000, 1, 20.0), B(1400, 1, 6.0), B(1800, 1, 9.0),
+        B(2600, 1, 7.0), B(3200, 1, 11.0),    # expired for the chain
+        A(4000, 1, 15.0), B(4400, 1, 6.0), B(4800, 1, 8.0)])

@@ -112,26 +112,31 @@ def test_three_state_chain_conformance():
 
 
 def test_sharded_step_runs_on_virtual_mesh():
-    """Partition axis sharded over the 8 virtual CPU devices (conftest)."""
+    """Partition axis sharded over the 8 virtual CPU devices (conftest):
+    the engine's jit_engine_step path vs the unsharded compile."""
     import jax
-    from siddhi_tpu.ops.nfa import pack_blocks
-    from siddhi_tpu.parallel.mesh import (build_sharded_step,
-                                          make_sharded_carry, partition_mesh)
+    import jax.numpy as jnp
+    from siddhi_tpu.ops.nfa import make_carry, pack_blocks
+    from siddhi_tpu.parallel.mesh import (jit_engine_step, partition_mesh,
+                                          shard_carry)
     n_partitions = 16
-    nfa = CompiledPatternNFA(APP, n_partitions=n_partitions, n_slots=8)
+    nfa = CompiledPatternNFA(APP, n_partitions=n_partitions, n_slots=8,
+                             mesh=None)
     mesh = partition_mesh()
-    carry = make_sharded_carry(nfa.spec, n_partitions, mesh)
-    step = build_sharded_step(nfa.spec, mesh)
+    carry = shard_carry(make_carry(nfa.spec, n_partitions), mesh)
+    step = jit_engine_step(nfa.spec, mesh)
     pids, prices, kind, ts = gen_events(7, 256, n_partitions)
     cols = {"partition": pids.astype(np.float32), "price": prices,
             "kind": kind.astype(np.float32)}
     codes = np.zeros(len(pids), np.int32)
     block = pack_blocks(pids, cols, ts, codes, n_partitions,
                         base_ts=int(ts[0]))
-    carry, (mask, caps, mts), stats = step(carry, block)
+    carry, (mask, caps, mts, _enter, _seq) = step(carry, block)
+    assert len({d for v in carry.values()
+                for d in v.sharding.device_set}) == 8
     # same events through the unsharded path must match exactly
     tpu = nfa.process_events(pids, cols, ts)
-    assert int(stats["matches"]) == len(tpu)
+    assert int(jnp.sum(mask.astype(jnp.int32))) == len(tpu)
 
 
 def test_pattern_bank_counts_match_individual_runs():
