@@ -122,11 +122,20 @@ class QueryRuntime:
 
         if isinstance(q.input_stream, SingleInputStream):
             if self._device_key_executors is not None:
-                # keyed (partition) mode: device or raise, as below
-                from ..plan.planner import DeviceWindowedAggRuntime
-                self.device_runtime = DeviceWindowedAggRuntime(
-                    self, q.input_stream, factory,
-                    self._device_key_executors)
+                # keyed (partition) mode: device or raise, as below.
+                # The Pallas ring path (group == partition key) first;
+                # the grouped-agg kernel covers finer group-bys, running
+                # aggregates and INT/LONG values
+                from ..plan.planner import (DeviceGroupedAggRuntime,
+                                            DeviceWindowedAggRuntime)
+                try:
+                    self.device_runtime = DeviceWindowedAggRuntime(
+                        self, q.input_stream, factory,
+                        self._device_key_executors)
+                except SiddhiAppCreationError:
+                    self.device_runtime = DeviceGroupedAggRuntime(
+                        self, q.input_stream, factory,
+                        key_executors=self._device_key_executors)
                 self.backend = "device"
                 return
             dev, reason = None, "inside host partition clone"

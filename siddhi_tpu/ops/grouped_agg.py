@@ -1,0 +1,196 @@
+"""Grouped window / running aggregation kernel — the device QuerySelector.
+
+Replaces the reference's per-group HashMap of aggregator objects
+(query/selector/QuerySelector.java:171+, GroupByKeyGenerator.java) with a
+dense per-(lane, group) state slab:
+
+    ring_f/ring_i [P, W, V]  — window contents per value expression
+                               (length windows; W == 0 → no window)
+    ring_gid [P, W]          — each slot's group id
+    fsum/isum [P, G, V]      — per-group running sums (paired lanes)
+    gcnt      [P, G]         — per-group live counts (shared: every
+                               aggregate sees the same accepted events)
+    *min/*max [P, G, V]      — add-only extrema (minForever/maxForever,
+                               and plain min/max when there is no window)
+
+    step = lax.scan over T  ∘  vmap over P
+
+P is the partition-lane axis (1 for non-partitioned queries): groups of
+different lanes are distinct aggregator states, exactly like the
+reference's per-key QuerySelector clones.  V indexes the DISTINCT value
+expressions of the select (sum(volume), avg(price), ... — each gets its
+own lane; float-typed and int-typed expressions ride separate banks so
+both stay exact).  An arriving event updates its group's state (evicting
+the window's oldest entry from ITS group first) and emits that group's
+aggregates — the reference's CURRENT/EXPIRED algebra netted per event.
+
+Numeric exactness:
+  - float bank: f32 values with TWO-FLOAT (TwoSum/Dekker) running sums —
+    (hi, lo) pairs whose f64 sum tracks the true sum to ~2^-48 relative
+    error, so egress agrees with the host oracle's float64 accumulation
+    at float32 precision (plain Kahan is NOT enough: its runsum alone can
+    sit one f32 ulp off, which the conformance corpus' f32-normalised
+    equality catches).
+  - int bank: i32 values with EXACT sums via a hi/lo split: every value
+    v = (v >> 16) * 65536 + (v & 65535); both partial sums stay inside
+    i32 exactly while a group holds < 32768 live entries (windows are
+    plan-capped; the no-window running mode guards the live count at
+    egress), and the host reassembles int64 = hi * 65536 + lo — this is
+    what lets `sum(volume long)` run on device with exact integer
+    equality (reference SumAttributeAggregatorExecutor long/int
+    variants); |v| >= 2^31 is a rejected data error.
+
+Windowed min/max need no decrement state: the ring materialises the
+window, so extrema are masked reductions over the arriving group's slots
+(same dissolution of the sliding-extremum problem as windowed_agg.py).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+INT_EXACT_MAX = 1 << 31        # |int value| bound for i32 device lanes
+INT_GROUP_MAX = 1 << 15        # live entries per group for exact int sums
+_SPLIT = 65536                 # int hi/lo split base (16 bits)
+
+I32_MAX = np.int32(np.iinfo(np.int32).max)
+I32_MIN = np.int32(np.iinfo(np.int32).min)
+
+
+class GroupedAggCarry(NamedTuple):
+    ring_f: jnp.ndarray     # [P, W, VF] f32
+    ring_i: jnp.ndarray     # [P, W, VI] i32
+    ring_gid: jnp.ndarray   # [P, W] i32
+    pos: jnp.ndarray        # [P] i32
+    cnt: jnp.ndarray        # [P] i32
+    fsum_hi: jnp.ndarray    # [P, G, VF] f32 two-float hi
+    fsum_lo: jnp.ndarray    # [P, G, VF] f32 two-float lo
+    isum_hi: jnp.ndarray    # [P, G, VI] i32 split hi
+    isum_lo: jnp.ndarray    # [P, G, VI] i32 split lo
+    gcnt: jnp.ndarray       # [P, G] i32
+    fmin_f: jnp.ndarray     # [P, G, VF] f32 add-only min
+    fmax_f: jnp.ndarray     # [P, G, VF] f32 add-only max
+    fmin_i: jnp.ndarray     # [P, G, VI] i32 add-only min
+    fmax_i: jnp.ndarray     # [P, G, VI] i32 add-only max
+
+
+def make_grouped_carry(n_lanes: int, window: int, n_groups: int,
+                       n_float: int, n_int: int) -> GroupedAggCarry:
+    P, W, G, VF, VI = n_lanes, window, n_groups, n_float, n_int
+    return GroupedAggCarry(
+        ring_f=jnp.zeros((P, W, VF), jnp.float32),
+        ring_i=jnp.zeros((P, W, VI), jnp.int32),
+        ring_gid=jnp.full((P, W), -1, jnp.int32),
+        pos=jnp.zeros((P,), jnp.int32),
+        cnt=jnp.zeros((P,), jnp.int32),
+        fsum_hi=jnp.zeros((P, G, VF), jnp.float32),
+        fsum_lo=jnp.zeros((P, G, VF), jnp.float32),
+        isum_hi=jnp.zeros((P, G, VI), jnp.int32),
+        isum_lo=jnp.zeros((P, G, VI), jnp.int32),
+        gcnt=jnp.zeros((P, G), jnp.int32),
+        # ±inf sentinels (not ±F32_MAX): an infinite input value must
+        # propagate to min/max output exactly as the host oracle's does
+        fmin_f=jnp.full((P, G, VF), jnp.inf, jnp.float32),
+        fmax_f=jnp.full((P, G, VF), -jnp.inf, jnp.float32),
+        fmin_i=jnp.full((P, G, VI), I32_MAX, jnp.int32),
+        fmax_i=jnp.full((P, G, VI), I32_MIN, jnp.int32))
+
+
+def _two_sum(a, b):
+    """Error-free transform: a + b = s + err exactly (Knuth TwoSum)."""
+    s = a + b
+    bb = s - a
+    err = (a - (s - bb)) + (b - bb)
+    return s, err
+
+
+def _pair_add(hi, lo, x, ok):
+    """Add x ([V]) to the (hi, lo) two-float accumulators where ok."""
+    s, e = _two_sum(hi, x)
+    lo2 = lo + e
+    hi2 = s + lo2                      # fast renormalisation keeps the
+    lo3 = lo2 - (hi2 - s)              # pair non-overlapping
+    return jnp.where(ok, hi2, hi), jnp.where(ok, lo3, lo)
+
+
+def build_grouped_step(window: int, want_minmax: bool, want_forever: bool):
+    """fn(carry, vals_f [P,T,VF], vals_i [P,T,VI], gids [P,T] i32,
+    accepted [P,T]) → (carry, outs): per-event aggregates of the arriving
+    event's group after the update — a 13-tuple of [P, T, ...] arrays
+    (fsum hi/lo, isum hi/lo, cnt, windowed min/max per bank, forever
+    min/max per bank).  Positions with accepted=False carry junk and are
+    discarded host-side.
+
+    window == 0 → running (no-window) mode: no eviction, and plain
+    min/max equal the forever lanes."""
+    W = window
+
+    def lane_step(carry, xs):
+        (rf, ri, rgid, pos, cnt, fhi, flo, ihi, ilo, gc,
+         mnf, mxf, mni, mxi) = carry
+        xf, xi, g, ok = xs
+
+        if W > 0:
+            oh = jnp.arange(W) == pos
+            evict = ok & (cnt == W)
+            old_f = jnp.sum(jnp.where(oh[:, None], rf, 0), axis=0)   # [VF]
+            old_i = jnp.sum(jnp.where(oh[:, None], ri, 0), axis=0)   # [VI]
+            old_g = jnp.sum(jnp.where(oh, rgid, 0))
+            h2, l2 = _pair_add(fhi[old_g], flo[old_g], -old_f, evict)
+            fhi = fhi.at[old_g].set(h2)
+            flo = flo.at[old_g].set(l2)
+            ihi = ihi.at[old_g].add(
+                jnp.where(evict, -(old_i >> 16), 0))
+            ilo = ilo.at[old_g].add(
+                jnp.where(evict, -(old_i & (_SPLIT - 1)), 0))
+            gc = gc.at[old_g].add(jnp.where(evict, -1, 0))
+            rf = jnp.where(ok & oh[:, None], xf[None, :], rf)
+            ri = jnp.where(ok & oh[:, None], xi[None, :], ri)
+            rgid = jnp.where(ok & oh, g, rgid)
+            pos = jnp.where(ok, (pos + 1) % W, pos)
+            cnt = jnp.where(ok, jnp.minimum(cnt + 1, W), cnt)
+
+        h2, l2 = _pair_add(fhi[g], flo[g], xf, ok)
+        fhi = fhi.at[g].set(h2)
+        flo = flo.at[g].set(l2)
+        ihi = ihi.at[g].add(jnp.where(ok, xi >> 16, 0))
+        ilo = ilo.at[g].add(jnp.where(ok, xi & (_SPLIT - 1), 0))
+        gc = gc.at[g].add(jnp.where(ok, 1, 0))
+        if want_forever or (want_minmax and W == 0):
+            mnf = mnf.at[g].min(jnp.where(ok, xf, mnf[g]))
+            mxf = mxf.at[g].max(jnp.where(ok, xf, mxf[g]))
+            mni = mni.at[g].min(jnp.where(ok, xi, mni[g]))
+            mxi = mxi.at[g].max(jnp.where(ok, xi, mxi[g]))
+
+        if want_minmax and W > 0:
+            live = ((jnp.arange(W) < cnt) & (rgid == g))[:, None]
+            w_mnf = jnp.min(jnp.where(live, rf, jnp.inf), axis=0)
+            w_mxf = jnp.max(jnp.where(live, rf, -jnp.inf), axis=0)
+            w_mni = jnp.min(jnp.where(live, ri, I32_MAX), axis=0)
+            w_mxi = jnp.max(jnp.where(live, ri, I32_MIN), axis=0)
+        else:
+            w_mnf, w_mxf, w_mni, w_mxi = mnf[g], mxf[g], mni[g], mxi[g]
+        out = (fhi[g], flo[g], ihi[g], ilo[g], gc[g],
+               w_mnf, w_mxf, w_mni, w_mxi,
+               mnf[g], mxf[g], mni[g], mxi[g])
+        return (rf, ri, rgid, pos, cnt, fhi, flo, ihi, ilo, gc,
+                mnf, mxf, mni, mxi), out
+
+    def per_lane(carry_l, f_l, i_l, g_l, ok_l):
+        return jax.lax.scan(lane_step, carry_l, (f_l, i_l, g_l, ok_l))
+
+    def step(carry: GroupedAggCarry, vals_f, vals_i, gids, accepted):
+        new_c, outs = jax.vmap(per_lane)(tuple(carry), vals_f, vals_i,
+                                         gids, accepted)
+        return GroupedAggCarry(*new_c), outs
+
+    return step
+
+
+def reassemble_int_sums(sum_hi: np.ndarray, sum_lo: np.ndarray
+                        ) -> np.ndarray:
+    """hi/lo split partial sums → exact int64 totals (host egress side)."""
+    return sum_hi.astype(np.int64) * _SPLIT + sum_lo.astype(np.int64)

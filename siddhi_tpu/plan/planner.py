@@ -493,6 +493,133 @@ class DeviceWindowedAggRuntime:
         self.key_lanes = dict(state["key_lanes"])
 
 
+class DeviceGroupedAggRuntime:
+    """Aggregation query on the grouped/running device kernel
+    (plan/gagg_compiler.CompiledGroupedAgg → ops/grouped_agg): group-by
+    keys finer than (or different from) the partition key, no-window
+    running aggregates, minForever/maxForever, and exact INT/LONG sums.
+    Keyed mode maps partition keys to lanes (like DevicePatternRuntime);
+    unkeyed mode runs one lane."""
+
+    backend = "device"
+
+    def __init__(self, query_runtime, sis, factory,
+                 key_executors: Optional[Dict[str, Any]] = None):
+        from ..core.event import dtype_for
+        from ..core.query_runtime import ProcessStreamReceiver
+        from ..query_api.query import OutputEventsFor
+        from .gagg_compiler import CompiledGroupedAgg
+
+        qr = query_runtime
+        app = qr.app_runtime
+        q = qr.query
+        sel = q.selector
+        if sel.having is not None or sel.order_by or \
+                sel.limit is not None or sel.offset is not None:
+            raise SiddhiAppCreationError(
+                "device grouped-agg path: having/order-by/limit are "
+                "host-only")
+        if getattr(q.output_stream, "events_for",
+                   OutputEventsFor.CURRENT) != OutputEventsFor.CURRENT:
+            raise SiddhiAppCreationError(
+                "device grouped-agg path: expired-event output is "
+                "host-only")
+        if any(_scan_fns(e, _is_time_fn)
+               for e in [oa.expr for oa in sel.attributes] +
+               [h.expr for h in sis.handlers
+                if hasattr(h, "expr")]):
+            raise SiddhiAppCreationError(
+                "device grouped-agg path: timestamp functions need int64 "
+                "host evaluation")
+        if app.has_named_window(sis.stream_id):
+            raise SiddhiAppCreationError(
+                "device grouped-agg path: named-window input is host-only")
+        self.keyed = key_executors is not None
+        self.cga = CompiledGroupedAgg(app.app, q,
+                                      n_lanes=GROW_START if self.keyed
+                                      else 1)
+        if self.keyed:
+            ex = key_executors.get(self.cga.stream_id)
+            if ex is None:
+                raise SiddhiAppCreationError(
+                    f"device grouped-agg path: stream "
+                    f"'{self.cga.stream_id}' has no partition key executor")
+            self.key_executor = ex
+        self.key_lanes: Dict[Any, int] = {}
+        self.qr = qr
+        self._dtype_for = dtype_for
+
+        attrs = [Attribute(name,
+                           self.cga.output_attr_type(kind, attr))
+                 for (name, kind, attr) in self.cga.outputs]
+        target = getattr(q.output_stream, "target_id", "") or qr.name
+        out_def = StreamDefinition(target, attrs)
+        self.head = qr._finish_device_chain(out_def, factory)
+
+        recv = ProcessStreamReceiver(
+            _DeviceIngress(self, 0, self.cga.stream_id), qr.lock,
+            app.latency_tracker_for(qr.name), qr.name, app.app_ctx)
+        app.junction_of(self.cga.stream_id, sis.is_inner,
+                        sis.is_fault).subscribe(recv)
+        qr.receivers[self.cga.stream_id] = recv
+
+    # ------------------------------------------------------------ ingest
+
+    def ingest(self, stream_code: int, stream_id: str, chunk) -> None:
+        from ..core.event import CURRENT, EventChunk
+        data = chunk.only(CURRENT)
+        if data.is_empty:
+            return
+        if self.keyed:
+            keys = self.key_executor.keys(data)
+            keep = np.asarray([k is not None for k in keys], bool)
+            if not keep.all():
+                data = data.mask(keep)
+                keys = [k for k in keys if k is not None]
+                if data.is_empty:
+                    return
+            lanes = map_keys_to_lanes(self.key_lanes, keys,
+                                      self.cga.n_lanes,
+                                      self.cga.grow_lanes)
+        else:
+            lanes = np.zeros(len(data), np.int64)
+        res = self.cga.process(lanes, data)
+        if res is None:
+            return
+        ok = res.pop("mask")
+        names = [o[0] for o in self.cga.outputs]
+        cols: Dict[str, np.ndarray] = {}
+        for (name, kind, attr) in self.cga.outputs:
+            dt = self._dtype_for(self.cga.output_attr_type(kind, attr))
+            v = res[name]
+            if dt is object:
+                col = np.empty(len(v), object)
+                col[:] = list(v)
+                cols[name] = col
+            else:
+                cols[name] = np.asarray(v).astype(dt)
+        out_ts = np.asarray(data.timestamps)[ok]
+        self.head.process(EventChunk.from_columns(names, out_ts, cols))
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        pass
+
+    def shutdown(self) -> None:
+        pass
+
+    # ------------------------------------------------------------ snapshot
+
+    def current_state(self) -> dict:
+        return {"cga": self.cga.current_state(),
+                "key_lanes": dict(self.key_lanes)}
+
+    def restore_state(self, state: dict) -> None:
+        self.cga.restore_state(state["cga"])
+        self.key_lanes = dict(state["key_lanes"])
+
+
 class DeviceFilterRuntime:
     """Stateless filter/project query as one jitted column program — the
     device replacement for the reference's per-event expression-tree DFS
@@ -699,6 +826,24 @@ def plan_state_runtime(query_runtime, sis: StateInputStream, factory):
 
 
 def plan_single_runtime(query_runtime, sis, factory):
-    """Device compile for a stateless filter/project query."""
+    """Device compile for a single-stream query: aggregation/window shapes
+    go to the grouped-agg kernel, stateless filter/project to the jitted
+    column program."""
+    from ..core.aggregator import is_aggregator
+    from ..query_api import WindowHandler
+
+    def is_agg(e):
+        return is_aggregator(e.namespace, e.name, len(e.args))
+
+    q = query_runtime.query
+    has_window = any(isinstance(h, WindowHandler) for h in sis.handlers)
+    has_agg = any(_scan_fns(oa.expr, is_agg)
+                  for oa in q.selector.attributes) or \
+        (q.selector.having is not None and
+         _scan_fns(q.selector.having, is_agg))
+    if has_window or has_agg or q.selector.group_by:
+        return _plan(query_runtime,
+                     lambda: DeviceGroupedAggRuntime(query_runtime, sis,
+                                                     factory))
     return _plan(query_runtime,
                  lambda: DeviceFilterRuntime(query_runtime, sis, factory))

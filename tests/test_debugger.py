@@ -50,14 +50,25 @@ def test_next_steps_to_following_terminal():
 
 
 def test_get_query_state():
-    m = SiddhiManager()
-    rt = m.create_siddhi_app_runtime("""
+    app = """
         define stream S (symbol string, price float);
         @info(name='q1') from S select symbol, sum(price) as t
         group by symbol insert into Out;
-    """)
+    """
+    # host engine: selector aggregate state is introspectable
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("@app:engine('host') " + app)
     dbg = rt.debug()
     rt.get_input_handler("S").send(["IBM", 5.0])
     state = dbg.get_query_state("q1")
     assert any("selector" in k for k in state)
     rt.shutdown()
+    # device engine (grouped-agg kernel): the device state is exposed
+    m2 = SiddhiManager()
+    rt2 = m2.create_siddhi_app_runtime(app)
+    dbg2 = rt2.debug()
+    rt2.get_input_handler("S").send(["IBM", 5.0])
+    state2 = dbg2.get_query_state("q1")
+    assert rt2.query_runtimes["q1"].backend == "device"
+    assert state2 and all(v is not None for v in state2.values())
+    rt2.shutdown()

@@ -351,12 +351,16 @@ def test_partitioned_external_time_window_device_parity():
         assert a[1] == pytest.approx(b[1], abs=1e-3)
 
 
-def test_wagg_int_sum_falls_back_to_host():
-    """Exact integer sums can't ride float32 lanes — host fallback."""
+def test_wagg_int_sum_compiles_via_grouped_kernel():
+    """Exact integer sums ride the grouped-agg kernel's i32 hi/lo lanes
+    (ops/grouped_agg.py) — no more host fallback for INT/LONG values."""
     app = WAGG_PART_APP.replace("v float", "v int").replace("v > 2.0",
                                                             "v > 2")
-    dm, _ = run_partition(app, [[0, 3], [0, 4]])
-    assert not dm
+    dm, out = run_partition(app, [[0, 3], [0, 4], [1, 9]])
+    assert dm
+    dm_h, out_h = run_partition("@app:engine('host') " + app,
+                                [[0, 3], [0, 4], [1, 9]])
+    assert not dm_h and sorted(out) == sorted(out_h)
 
 
 def test_filter_project_device_parity():
@@ -400,7 +404,9 @@ def test_filter_string_condition_falls_back():
     rt.shutdown()
 
 
-def test_window_query_stays_host():
+def test_window_agg_query_compiles_to_device():
+    """Round 3: plain length-window aggregation queries compile onto the
+    grouped-agg kernel (previously host-only)."""
     app = """
         define stream S (v float);
         @info(name='q')
@@ -408,5 +414,12 @@ def test_window_query_stays_host():
     """
     m = SiddhiManager()
     rt = m.create_siddhi_app_runtime(app)
-    assert rt.query_runtimes["q"].backend == "host"
+    assert rt.query_runtimes["q"].backend == "device"
     rt.shutdown()
+    # unsupported window kinds still fall back with a reason
+    m2 = SiddhiManager()
+    rt2 = m2.create_siddhi_app_runtime(app.replace("window.length(3)",
+                                                   "window.lengthBatch(3)"))
+    assert rt2.query_runtimes["q"].backend == "host"
+    assert "lengthBatch" in (rt2.query_runtimes["q"].backend_reason or "")
+    rt2.shutdown()
