@@ -203,6 +203,39 @@ class JoinRuntime:
             except Exception:  # noqa: BLE001 — any shape issue → cross path
                 pass
 
+        # device probe (VERDICT r2 next #7): the `on` condition over the
+        # arriving-chunk × buffer cross product — the reference's per-event
+        # JoinProcessor.find() hot loop (JoinProcessor.java:36-122) — as
+        # one [n, m] broadcast program on the device.  Built when the
+        # condition compiles under jnp over numeric attributes; DOUBLE
+        # attributes are excluded (f32 lanes would flip borderline
+        # compares vs the host's float64) and INT/LONG columns are
+        # range-guarded per probe (2^24 f32 exactness).  Falls back to the
+        # host numpy mask with self.device_probe_reason recorded.  When a
+        # PK/@Index hash probe exists, the host O(1) lookup wins — the
+        # device brute-force cross is for non-indexable conditions.
+        self.device_probe = None
+        self.device_probe_reason: Optional[str] = None
+        from ..plan.planner import engine_mode
+        app_obj = getattr(app, "app", None)
+        mode = engine_mode(app_obj) if app_obj is not None else "host"
+        if mode == "host":
+            self.device_probe_reason = (
+                "device join probe: engine mode 'host'"
+                if app_obj is not None
+                else "device join probe: inside host partition clone")
+        elif jis.on is None:
+            self.device_probe_reason = \
+                "device join probe: no on-condition (pure cross product)"
+        elif self._table_conds:
+            self.device_probe_reason = \
+                "device join probe: PK/@Index hash probe is faster on host"
+        elif self.agg_runtime is not None:
+            self.device_probe_reason = \
+                "device join probe: aggregation sides are host-only"
+        else:
+            self._try_build_device_probe(jis, scope)
+
         qr._finish_chain([], scope, self.union_def, factory)
         self.head = qr._chain_head([])
 
@@ -226,6 +259,268 @@ class JoinRuntime:
     def windows(self) -> List[WindowProcessor]:
         return [w for w in (self.left.window, self.right.window)
                 if w is not None]
+
+    # ------------------------------------------------------- device probe
+
+    def _try_build_device_probe(self, jis, scope) -> None:
+        from ..query_api.definition import AttrType
+        from ..query_api.expression import variables_of
+        from ..plan.expr_compiler import ExprCompiler as _EC
+
+        from ..query_api.expression import Compare, MathExpr
+
+        def _fail(reason):
+            self.device_probe_reason = "device join probe: " + reason
+
+        # timestamp functions would read a zeros placeholder in the probe
+        # ctx — the sibling device paths reject them the same way
+        from ..plan.planner import _is_time_fn, _scan_fns
+        if _scan_fns(jis.on, _is_time_fn):
+            return _fail("timestamp functions need int64 host evaluation")
+
+        types = {}
+        for s in (self.left, self.right):
+            for a in s.definition.attributes:
+                types.setdefault((s.ref, a.name), a.type)
+                types.setdefault((s.stream_id, a.name), a.type)
+                types.setdefault((None, a.name), a.type)
+
+        def is_str_var(e):
+            from ..query_api.expression import Variable
+            return isinstance(e, Variable) and \
+                types.get((e.stream_id, e.attribute)) == AttrType.STRING
+
+        # STRING attributes ride dictionary-code lanes (one shared dict →
+        # code equality ⟺ string equality), so they are legal ONLY as
+        # both sides of an ==/!= compare.  Anything else — order compares
+        # (codes carry no order), string constants, functions — rejects.
+        self._str_join_attrs = set()
+
+        def scan(e):
+            from ..query_api.expression import Compare, CompareOp
+            if isinstance(e, Compare):
+                ls, rs = is_str_var(e.left), is_str_var(e.right)
+                if ls or rs:
+                    if not (ls and rs) or e.op not in (CompareOp.EQ,
+                                                       CompareOp.NEQ):
+                        raise ValueError(
+                            "string attributes join only via ==/!= "
+                            "against string attributes on the device")
+                    self._str_join_attrs.add(e.left.attribute)
+                    self._str_join_attrs.add(e.right.attribute)
+                    return
+            for f in getattr(e, "__dataclass_fields__", {}):
+                v = getattr(e, f)
+                vs = v if isinstance(v, list) else [v]
+                for x in vs:
+                    if hasattr(x, "__dataclass_fields__"):
+                        scan(x)
+            if is_str_var(e):
+                raise ValueError(
+                    f"string attribute '{e.attribute}' outside an ==/!= "
+                    f"compare")
+        try:
+            scan(jis.on)
+        except ValueError as ve:
+            return _fail(str(ve))
+
+        # INT/LONG variables are range-guarded per column (2^24), but
+        # arithmetic ON them (L.id * R.id) can leave the exact range even
+        # when the columns are inside it — reject at build
+        def int_in_math(e, inside=False) -> bool:
+            from ..query_api.expression import Variable as _V
+            if isinstance(e, _V) and inside and \
+                    types.get((e.stream_id, e.attribute)) in \
+                    (AttrType.INT, AttrType.LONG):
+                return True
+            inside = inside or isinstance(e, MathExpr)
+            for f in getattr(e, "__dataclass_fields__", {}):
+                v = getattr(e, f)
+                vs = v if isinstance(v, list) else [v]
+                for x in vs:
+                    if hasattr(x, "__dataclass_fields__") and \
+                            int_in_math(x, inside):
+                        return True
+            return False
+        if int_in_math(jis.on):
+            return _fail("arithmetic on INT/LONG attributes can leave the "
+                         "f32 exact-integer range")
+        for v in variables_of(jis.on):
+            t = types.get((v.stream_id, v.attribute))
+            if t is None:
+                continue            # resolution errors surface on host
+            if t == AttrType.DOUBLE:
+                return _fail(f"DOUBLE attribute '{v.attribute}' needs the "
+                             f"host's float64 compare")
+            if t == AttrType.OBJECT:
+                return _fail(f"non-numeric attribute '{v.attribute}'")
+            if t == AttrType.STRING and \
+                    v.attribute not in self._str_join_attrs:
+                return _fail(f"string attribute '{v.attribute}' outside "
+                             f"an ==/!= compare")
+        try:
+            import jax
+            import jax.numpy as jnp
+            # device scope: validated string attrs re-typed as LONG (they
+            # arrive as dictionary-code lanes), everything else mirrored
+            # from the joined scope's wiring
+            dev_scope = Scope()
+            seen_u: set = set()
+            for s in (self.left, self.right):
+                for a in s.definition.attributes:
+                    t = AttrType.LONG if (
+                        a.type == AttrType.STRING and
+                        a.name in self._str_join_attrs) else a.type
+
+                    def g(ctx, _r=s.ref, _a=a.name):
+                        return ctx.qualified[(_r, 0)][_a]
+                    dev_scope.add(s.ref, a.name, t, g)
+                    if s.stream_id != s.ref:
+                        dev_scope.add(s.stream_id, a.name, t, g)
+                    if a.name not in seen_u:
+                        seen_u.add(a.name)
+                        dev_scope.add(None, a.name, t, g)
+            dev_on = _EC(dev_scope, jnp).compile(jis.on)
+
+            refs = []
+            for s in (self.left, self.right):
+                names = [a.name for a in s.definition.attributes]
+                keys = [s.ref] + ([s.stream_id]
+                                  if s.stream_id != s.ref else [])
+                refs.append((keys, names))
+
+            def probe(lcols, rcols, lvalid, rvalid, cap):
+                q = {}
+                for (keys, names), cols, expand in (
+                        (refs[0], lcols, 0), (refs[1], rcols, 1)):
+                    cc = {a: (cols[a][:, None] if expand == 0
+                              else cols[a][None, :]) for a in names
+                          if a in cols}
+                    for k in keys:
+                        q[(k, 0)] = cc
+                n = lvalid.shape[0] * rvalid.shape[0]
+                ctx = EvalCtx({}, jnp.zeros((1,), jnp.int32), n,
+                              qualified=q)
+                m = jnp.asarray(dev_on.fn(ctx), bool)
+                m = jnp.broadcast_to(m, (lvalid.shape[0],
+                                         rvalid.shape[0]))
+                m = m & lvalid[:, None] & rvalid[None, :]
+                flat = m.reshape(-1)
+                # device-side compaction: shipping the full [n, m] mask
+                # through a remote tunnel costs ~n*m bytes; the first-cap
+                # matching pair indices (row-major == host emission
+                # order) + the true count cost ~cap
+                (idx,) = jnp.nonzero(flat, size=cap, fill_value=-1)
+                return idx.astype(jnp.int32), \
+                    jnp.sum(flat.astype(jnp.int32))
+
+            self._probe_jit = jax.jit(probe, static_argnums=4)
+            self._probe_cap = 4096
+            # warm trace at [1, 1] so untraceable conditions (functions,
+            # scripts, table membership) reject at build time
+            warm = {}
+            for (keys, names), s in ((refs[0], self.left),
+                                     (refs[1], self.right)):
+                warm[s.side] = {
+                    a.name: jnp.zeros((1,), jnp.float32)
+                    for a in s.definition.attributes
+                    if a.type not in (AttrType.STRING, AttrType.OBJECT)
+                    or a.name in self._str_join_attrs}
+            self._probe_jit(warm["left"], warm["right"],
+                            jnp.zeros((1,), bool), jnp.zeros((1,), bool),
+                            4)
+            self.device_probe = probe
+            self._str_codes: Dict = {}
+            # condition-referenced attrs per definition: a referenced
+            # column that arrives object-typed (outer-join nulls upstream)
+            # must force the host mask, not vanish from the feed
+            self._cond_attrs = {v.attribute for v in variables_of(jis.on)}
+            self._int24 = [
+                (s.side, a.name)
+                for s in (self.left, self.right)
+                for a in s.definition.attributes
+                if a.type in (AttrType.INT, AttrType.LONG)]
+        except Exception as e:  # noqa: BLE001 — any trace failure → host
+            _fail(f"condition not device-traceable ({e})")
+
+    def _device_pairs(self, side: JoinSide, data: EventChunk,
+                      buf: EventChunk):
+        """(sel_data, sel_buf) matching-pair indices in host emission
+        order via the device probe, or None when a runtime guard (int
+        2^24 exactness) demands the host path."""
+        import jax.numpy as jnp
+        left_first = side.side == "left"
+        chunks = {"left": data if left_first else buf,
+                  "right": buf if left_first else data}
+        cols = {}
+        for sd, c in chunks.items():
+            cc = {}
+            for a in c.names:
+                col = c.columns[a]
+                if col.dtype == object:
+                    if a not in self._str_join_attrs:
+                        if a in self._cond_attrs:
+                            # a numeric column promoted to object (nulls
+                            # from an upstream outer join): host mask owns
+                            # null-compare semantics
+                            return None
+                        continue
+                    # string ==/!= rides shared dictionary-code lanes;
+                    # nulls guard to the host mask (reference law:
+                    # null == null is FALSE — code 0 == 0 would be true)
+                    enc = np.empty(len(col), np.float32)
+                    codes = self._str_codes
+                    for i, v in enumerate(col):
+                        if v is None:
+                            return None
+                        code = codes.get(v)
+                        if code is None:
+                            code = len(codes) + 1
+                            if code > (1 << 24):
+                                return None     # dictionary exhausted
+                            codes[v] = code
+                        enc[i] = code
+                    cc[a] = jnp.asarray(enc)
+                    continue
+                if (sd, a) in getattr(self, "_int24", ()) and len(col) \
+                        and np.abs(np.asarray(col, np.int64)).max() >= \
+                        (1 << 24):
+                    return None     # would round on f32 lanes
+                cc[a] = jnp.asarray(np.asarray(col, np.float32))
+            cols[sd] = cc
+        nl, nr = len(chunks["left"]), len(chunks["right"])
+        # pow2 padding caps retraces at log(max shape) per axis — sliding
+        # buffers grow one event at a time, and an XLA compile per
+        # distinct (n, m) would dwarf the probe
+        nl2 = 1 << max(nl - 1, 0).bit_length()
+        nr2 = 1 << max(nr - 1, 0).bit_length()
+        if nl2 != nl or nr2 != nr:
+            for sd, want in (("left", nl2), ("right", nr2)):
+                cols[sd] = {a: jnp.concatenate(
+                    [v, jnp.zeros((want - v.shape[0],), v.dtype)])
+                    if v.shape[0] != want else v
+                    for a, v in cols[sd].items()}
+        lv = jnp.asarray(np.arange(nl2) < nl)
+        rv = jnp.asarray(np.arange(nr2) < nr)
+        while True:
+            idx, count = self._probe_jit(cols["left"], cols["right"],
+                                         lv, rv, self._probe_cap)
+            count = int(count)
+            if count <= self._probe_cap:
+                break
+            # overflow: grow the compaction buffer (new static cap → one
+            # retrace) and re-run — results stay exact
+            cap = self._probe_cap
+            while cap < count:
+                cap *= 2
+            self._probe_cap = cap
+        idx = np.asarray(idx[:count], np.int64)
+        li, rj = idx // nr2, idx % nr2
+        if not left_first:
+            li, rj = rj, li
+            order = np.lexsort((rj, li))    # host order: data-major
+            li, rj = li[order], rj[order]
+        return li, rj
 
     # ------------------------------------------------------------ event flow
 
@@ -337,23 +632,29 @@ class JoinRuntime:
             return
 
         # cross product: row i of data × row j of buffer
-        li = np.repeat(np.arange(n), m)
-        rj = np.tile(np.arange(m), n)
-        qualified = {}
-        for s, c, idx in ((side, data, li), (opposite, buf, rj)):
-            cols = {a: c.columns[a][idx] for a in c.names}
-            qualified[(s.ref, 0)] = cols
-            if s.stream_id != s.ref:
-                qualified[(s.stream_id, 0)] = cols
-        if self.on is not None:
-            ctx = EvalCtx({}, data.timestamps[li], n * m,
-                          qualified=qualified)
-            mask = np.asarray(self.on.fn(ctx), bool)
-            if mask.ndim == 0:
-                mask = np.full(n * m, bool(mask))
+        sel = None
+        if self.device_probe is not None:
+            sel = self._device_pairs(side, data, buf)
+        if sel is not None:
+            sel_l, sel_r = sel
         else:
-            mask = np.ones(n * m, bool)
-        sel_l, sel_r = li[mask], rj[mask]
+            li = np.repeat(np.arange(n), m)
+            rj = np.tile(np.arange(m), n)
+            if self.on is not None:
+                qualified = {}
+                for s, c, idx in ((side, data, li), (opposite, buf, rj)):
+                    cols = {a: c.columns[a][idx] for a in c.names}
+                    qualified[(s.ref, 0)] = cols
+                    if s.stream_id != s.ref:
+                        qualified[(s.stream_id, 0)] = cols
+                ctx = EvalCtx({}, data.timestamps[li], n * m,
+                              qualified=qualified)
+                mask = np.asarray(self.on.fn(ctx), bool)
+                if mask.ndim == 0:
+                    mask = np.full(n * m, bool(mask))
+            else:
+                mask = np.ones(n * m, bool)
+            sel_l, sel_r = li[mask], rj[mask]
         if outer_this:
             matched = np.zeros(n, bool)
             matched[sel_l] = True

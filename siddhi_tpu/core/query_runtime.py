@@ -153,6 +153,13 @@ class QueryRuntime:
         elif isinstance(q.input_stream, JoinInputStream):
             from .join import JoinRuntime
             self.join_runtime = JoinRuntime(self, q.input_stream, factory)
+            # the on-condition probe — the join's per-event hot loop — may
+            # have compiled to the device; buffers/windows stay host
+            if self.join_runtime.device_probe is not None:
+                self.backend = "device"
+            else:
+                self.backend_reason = \
+                    self.join_runtime.device_probe_reason
         elif isinstance(q.input_stream, StateInputStream):
             if self._device_key_executors is not None:
                 # keyed (partition) mode: device or raise — the caller

@@ -118,9 +118,9 @@ class CompiledGroupedAgg:
         self._n_int = 0
 
         def value_of(ast) -> _Value:
-            for k, v in by_ast.items():
-                if k == ast:
-                    return v
+            v = by_ast.get(ast)      # frozen dataclasses: hash == eq
+            if v is not None:
+                return v
             ce = host.compile(ast)
             if ce.type not in _NUM_TYPES:
                 _reject(f"aggregate argument type {ce.type} not numeric")

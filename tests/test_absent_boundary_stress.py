@@ -1,0 +1,247 @@
+"""Absent/timer boundary stress (VERDICT r2 next #10): dense real events
+interleaved with `not … for t` deadlines landing exactly at block
+boundaries and TIMER-granularity edges, device vs host oracle.
+
+The device path injects host-scheduled TIMER rows (stream code -2) through
+the same NFA lanes as real events (ops/nfa.py make_timer_block); between
+host scheduling granularity and device block boundaries there is an
+ordering seam — these tests pin it to the oracle at the edges where it
+would crack: deadline == block edge, deadline == event ts, deadlines with
+no quiet gap, cascading absents, and re-arm floods.
+
+Reference: AbsentStreamPreStateProcessor.java:63-96,231 (waitingTime
+scheduling), util/Scheduler.java:180-211 (TIMER injection).
+"""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+
+STREAMS = """
+define stream A (k int, v float);
+define stream B (k int, w float);
+define stream C (k int, u float);
+"""
+
+
+def run_app(app, batches, engine=None, until=None):
+    """batches: list of either ('advance', ts) or a list of
+    (stream, row, ts) sends delivered as ONE batch per stream in order —
+    each batch is one device block (one junction chunk)."""
+    prefix = f"@app:engine('{engine}') " if engine else ""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(prefix + app)
+    out = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: out.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    for batch in batches:
+        if isinstance(batch, tuple) and batch[0] == "advance":
+            rt.app_ctx.timestamp_generator.observe_event_time(batch[1])
+            rt.app_ctx.scheduler.advance_to(batch[1])
+            continue
+        for sid, row, ts in batch:
+            rt.get_input_handler(sid).send(row, timestamp=ts)
+    if until is not None:
+        rt.app_ctx.timestamp_generator.observe_event_time(until)
+        rt.app_ctx.scheduler.advance_to(until)
+    backend = rt.query_runtimes["q"].backend
+    reason = rt.query_runtimes["q"].backend_reason
+    rt.shutdown()
+    return backend, reason, out
+
+
+def assert_parity(app, batches, until=None, expect_device=True):
+    bh, _, host = run_app(app, batches, engine="host", until=until)
+    bd, reason, dev = run_app(app, batches, until=until)
+    assert bh == "host"
+    if expect_device:
+        assert bd == "device", f"did not plan onto the device: {reason}"
+    assert host == dev, f"host={host} dev={dev}"
+    return host
+
+
+def A(ts, v, k=1):
+    return ("A", [k, v], ts)
+
+
+def B(ts, w, k=1):
+    return ("B", [k, w], ts)
+
+
+def C(ts, u, k=1):
+    return ("C", [k, u], ts)
+
+
+ABSENT_APP = "@app:playback " + STREAMS + """
+    @info(name='q')
+    from every e1=A[v > 20.0] -> not B[w > e1.v] for 1 sec
+    select e1.v as v1 insert into Out;
+"""
+
+ABSENT_THEN_APP = "@app:playback " + STREAMS + """
+    @info(name='q')
+    from every e1=A[v > 20.0] -> not B[w > e1.v] for 1 sec -> e3=C[u > 0.0]
+    select e1.v as v1, e3.u as u3 insert into Out;
+"""
+
+CASCADE_APP = "@app:playback " + STREAMS + """
+    @info(name='q')
+    from every e1=A[v > 20.0] -> not B[w > 0.0] for 1 sec
+         -> not C[u > 0.0] for 1 sec
+    select e1.v as v1 insert into Out;
+"""
+
+
+# ------------------------------------------------- deadline at block edges
+
+@pytest.mark.parametrize("edge_delta", [-1, 0, 1])
+def test_deadline_at_block_boundary(edge_delta):
+    """The arming block ends right where the deadline lands (±1 ms): a
+    real event opens the next block exactly at/around deadline ts 2000."""
+    batches = [
+        [A(1000, 25.0)],                       # block 1: arm; deadline 2000
+        [A(2000 + edge_delta, 30.0)],          # block 2 opens at the edge
+    ]
+    assert_parity(ABSENT_APP, batches, until=4000)
+
+
+@pytest.mark.parametrize("gap", [0, 1, 999, 1000])
+def test_dense_events_straddling_deadline(gap):
+    """Dense A traffic while an earlier partial's deadline expires
+    mid-block; suppressing B lands `gap` ms before the deadline."""
+    batches = [
+        [A(1000, 25.0), A(1200, 26.0), A(1400, 27.0)],
+        [B(2000 - gap, 26.5)],                 # kills partials with v<26.5
+        [A(2100, 30.0), A(2300, 31.0)],
+        [B(2350, 100.0)],                      # kills everything armed
+    ]
+    assert_parity(ABSENT_APP, batches, until=5000)
+
+
+def test_same_ts_event_and_deadline():
+    """An event carrying EXACTLY the deadline timestamp — the oracle
+    fires the absent at ts >= deadline before routing decisions differ."""
+    batches = [
+        [A(1000, 25.0)],
+        [C(2000, 5.0)],        # C at the exact deadline of e1's absent
+        [C(2500, 7.0)],
+    ]
+    assert_parity(ABSENT_THEN_APP, batches, until=4000)
+
+
+def test_absent_then_state_captures_next_event():
+    """After the quiet period confirms, the NEXT C completes — the device
+    slot advancing on the deadline must capture events after, not at,
+    the confirmation."""
+    batches = [
+        [A(1000, 25.0)],
+        [C(1500, 3.0)],                  # before deadline: must NOT match
+        ("advance", 2000),               # deadline fires between blocks
+        [C(2200, 4.0)],                  # first C after confirmation
+    ]
+    assert_parity(ABSENT_THEN_APP, batches, until=4000)
+
+
+# ---------------------------------------------------- cascading absents
+
+def test_cascading_absents_quiet_stream():
+    """A then two quiet seconds → both absents confirm off pure TIMER
+    advances (no real events in between)."""
+    assert_parity(CASCADE_APP, [[A(1000, 25.0)]], until=3500)
+
+
+def test_cascading_absents_second_killed():
+    """First absent confirms at 2000; a C inside the second window kills
+    the chain."""
+    batches = [
+        [A(1000, 25.0)],
+        ("advance", 2000),
+        [C(2500, 1.0)],
+    ]
+    assert_parity(CASCADE_APP, batches, until=4000)
+
+
+def test_cascading_absents_advance_exactly_on_deadlines():
+    """Virtual time advanced to EXACTLY each cascaded deadline, one at a
+    time (TIMER granularity edge: timers fire at notify_at precision)."""
+    batches = [
+        [A(1000, 25.0)],
+        ("advance", 2000),
+        ("advance", 3000),
+    ]
+    assert_parity(CASCADE_APP, batches, until=3000)
+
+
+# ------------------------------------------------------- re-arm pressure
+
+def test_rearm_flood_with_absent_deadlines():
+    """Many armed partials with staggered deadlines expiring across block
+    boundaries; every A re-arms (slot pressure + deadline bookkeeping)."""
+    rng = np.random.default_rng(5)
+    batches = []
+    t = 1000
+    for _ in range(6):
+        blk = []
+        for _ in range(4):
+            blk.append(A(t, float(21 + rng.integers(0, 40))))
+            t += rng.integers(100, 400)
+        batches.append(blk)
+        if rng.integers(0, 2):
+            batches.append([B(t, float(rng.integers(10, 70)))])
+            t += 150
+    assert_parity(ABSENT_APP, batches, until=t + 3000)
+
+
+def test_partitioned_absent_deadlines_per_key():
+    """Keyed lanes: each key's deadline fires independently; blocks mix
+    keys so TIMER rows fan out across lanes."""
+    app = "@app:playback " + """
+    define stream S (sym string, price float, kind int);
+    partition with (sym of S) begin
+    @info(name='q')
+    from every e1=S[kind == 0] -> not S[kind == 1 and price > e1.price] for 1 sec
+    select e1.price as p1 insert into Out;
+    end;
+    """
+
+    def run(engine):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(
+            f"@app:engine('{engine}') {app}" if engine else app)
+        out = []
+        rt.add_callback("Out", StreamCallback(
+            lambda evs: out.extend(tuple(e.data) for e in evs)))
+        rt.start()
+        h = rt.get_input_handler("S")
+        sends = [("a", 10.0, 0, 1000), ("b", 20.0, 0, 1300),
+                 ("a", 50.0, 1, 1600),          # kills a's partial
+                 ("c", 30.0, 0, 1900)]
+        for sym, price, kind, ts in sends:
+            h.send([sym, price, kind], timestamp=ts)
+        rt.app_ctx.timestamp_generator.observe_event_time(4000)
+        rt.app_ctx.scheduler.advance_to(4000)
+        dev = any(pr.device_mode for pr in rt.partition_runtimes)
+        rt.shutdown()
+        return dev, sorted(out)
+
+    dev_hit, dev = run(None)
+    _, host = run("host")
+    assert dev_hit and dev == host and len(host) == 2
+
+
+# ------------------------------------------------------- sequence mode
+
+def test_sequence_absent_stays_host_and_exact():
+    """SEQUENCE + absent is a recorded device fallback; the oracle still
+    owns the boundary semantics (deadline at the exact next-event ts)."""
+    app = "@app:playback " + STREAMS + """
+        @info(name='q')
+        from e1=A[v > 20.0], not B[w > e1.v] for 1 sec
+        select e1.v as v1 insert into Out;
+    """
+    b, reason, out = run_app(
+        app, [[A(1000, 25.0)], [("advance", 2000)][0:0] or
+              [A(2000, 5.0)]], until=3000)
+    assert b == "host" and "absent" in (reason or "")
+    assert out == [(25.0,)]
