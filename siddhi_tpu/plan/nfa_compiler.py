@@ -818,7 +818,10 @@ class CompiledPatternNFA:
 
     def _jit_step(self):
         if self.mesh is None:
-            return jax.jit(build_block_step(self.spec), donate_argnums=0)
+            # no donation: the engine path replays a chunk from the
+            # pre-chunk carry after a slot overflow (grow-and-replay), so
+            # the input carry must survive the step
+            return jax.jit(build_block_step(self.spec))
         from ..parallel.mesh import jit_engine_step
         return jit_engine_step(self.spec, self.mesh)
 
@@ -931,6 +934,90 @@ class CompiledPatternNFA:
                                                              block)
         return mask, caps, ts, enter, seq
 
+    def _compact_egress(self, mask, caps, ts, enter, seq):
+        """Device-side match compaction: ONE [cap+1, 4+R*C] int32 D2H
+        carrying only the MATCHED slots (flat index, ts, enter, seq,
+        bitcast capture row) plus a tail row with (true count, cumulative
+        dropped).  Shipping the dense [P, T, K] buffers cost ~P*T*K*(5+RC)
+        bytes per chunk — tens of MB through a remote tunnel; matches are
+        sparse, so egress should scale with THEM.  The compaction cap
+        doubles on overflow (one retrace, results exact).  Side effect:
+        sets self.last_dropped_total (drives grow-and-replay without an
+        extra sync)."""
+        P, T, K = mask.shape
+        R, C = max(self.spec.n_rows, 1), max(self.spec.n_caps, 1)
+        if not hasattr(self, "_egress_cap"):
+            self._egress_cap = 1024
+
+        def pack(mask, caps, ts, enter, seq, dropped, cap):
+            flat = mask.reshape(-1)
+            (idx,) = jnp.nonzero(flat, size=cap, fill_value=-1)
+            safe = jnp.maximum(idx, 0)
+            g = lambda a: a.reshape(-1)[safe][:, None]
+            caps_i = jax.lax.bitcast_convert_type(
+                caps, jnp.int32).reshape(-1, R * C)[safe]
+            rows = jnp.concatenate(
+                [idx[:, None], g(ts), g(enter), g(seq), caps_i], axis=1)
+            tail = jnp.zeros((1, 4 + R * C), jnp.int32)
+            tail = tail.at[0, 0].set(jnp.sum(flat.astype(jnp.int32)))
+            tail = tail.at[0, 1].set(jnp.sum(dropped))
+            return jnp.concatenate([rows, tail], axis=0)
+
+        if not hasattr(self, "_egress_jit"):
+            self._egress_jit = jax.jit(pack, static_argnums=6)
+        while True:
+            buf = np.asarray(self._egress_jit(
+                mask, caps, ts, enter, seq, self.carry["dropped"],
+                self._egress_cap))
+            count = int(buf[-1, 0])
+            self.last_dropped_total = int(buf[-1, 1])
+            if count <= self._egress_cap:
+                break
+            cap = self._egress_cap
+            while cap < count:
+                cap *= 2
+            self._egress_cap = cap
+        return buf[:count], (T, K)
+
+    def _decode_compact(self, rows: np.ndarray, tk) -> list:
+        """Compacted egress rows → the same match list decode_matches
+        yields (flat row-major order == np.nonzero order)."""
+        T, K = tk
+        R, C = max(self.spec.n_rows, 1), max(self.spec.n_caps, 1)
+        out = []
+        order = []
+        caps_f = rows[:, 4:].view(np.float32).reshape(-1, R, C)
+        for i in range(len(rows)):
+            idx = int(rows[i, 0])
+            p = idx // (T * K)
+            vals = self._decode_caps_row(caps_f[i])
+            out.append((p, int(rows[i, 1]) + (self.base_ts or 0), vals))
+            order.append((int(rows[i, 2]), int(rows[i, 3])))
+        out = [m for _o, m in sorted(
+            zip(order, out), key=lambda x: (x[1][1], x[0][0], x[0][1]))]
+        return out
+
+    def _decode_caps_row(self, caps_row: np.ndarray) -> dict:
+        """One [R, C] capture row → select-output values (shared by the
+        dense and compacted decoders)."""
+        vals = {}
+        for name, row, attr, which in self.select_outputs:
+            if row in self.nullable_rows:
+                vlane = self._n_lane[row] if self._n_lane[row] >= 0 \
+                    else self._matched_lane[row]
+                if caps_row[row, vlane] <= 0:
+                    vals[name] = None
+                    continue
+            lane = self.cap_lane[(row, attr, which)]
+            v = float(caps_row[row, lane])
+            at = self.attr_types.get(attr)
+            if at in (AttrType.INT, AttrType.LONG):
+                v = int(round(v))
+            if attr in self.encoded_attrs:
+                v = self.str_decoder[v - 1] if v >= 1 else None
+            vals[name] = v
+        return vals
+
     def process_timer(self, now_ms: int):
         """Inject one virtual TIMER row at absolute time now_ms (absent
         deadlines + within expiry between real events)."""
@@ -941,8 +1028,8 @@ class CompiledPatternNFA:
                                  self.attr_names)
         # numpy leaves: jit places them per its in_shardings (sharded under
         # a mesh) — pre-committing to one device would conflict
-        mask, caps, ts, enter, seq = self.process_block(block)
-        return self.decode_matches(mask, caps, ts, enter, seq)
+        outs = self.process_block(block)
+        return self._decode_compact(*self._compact_egress(*outs))
 
     def process_events(self, partition_ids: np.ndarray,
                        columns: Dict[str, np.ndarray],
@@ -975,8 +1062,8 @@ class CompiledPatternNFA:
                             np.asarray(timestamps), codes,
                             self.n_partitions, base_ts=self.base_ts,
                             pad_t_pow2=pad_t_pow2)
-        mask, caps, ts, enter, seq = self.process_block(block)
-        return self.decode_matches(mask, caps, ts, enter, seq)
+        outs = self.process_block(block)
+        return self._decode_compact(*self._compact_egress(*outs))
 
     def _ts_safe_max(self) -> int:
         # keep ts - slot_start inside int32 even for a slot clamped to
@@ -1015,6 +1102,9 @@ class CompiledPatternNFA:
         self.base_ts += delta
 
     def decode_matches(self, mask, caps, ts, enter=None, seq=None):
+        """Dense-buffer decode (host-side arrays) — the engine path uses
+        the compacted form (_compact_egress/_decode_compact); this remains
+        for direct kernel users/tests stepping build_block_step outputs."""
         mask = np.asarray(mask)          # [P, T, K]
         caps = np.asarray(caps)          # [P, T, K, R, C]
         ts = np.asarray(ts)
@@ -1025,23 +1115,7 @@ class CompiledPatternNFA:
         order = []
         ps, tts, ks = np.nonzero(mask)
         for p, t, k in zip(ps, tts, ks):
-            vals = {}
-            for name, row, attr, which in self.select_outputs:
-                if row in self.nullable_rows:
-                    vlane = self._n_lane[row] if self._n_lane[row] >= 0 \
-                        else self._matched_lane[row]
-                    if caps[p, t, k, row, vlane] <= 0:
-                        vals[name] = None
-                        continue
-                lane = self.cap_lane[(row, attr, which)]
-                v = float(caps[p, t, k, row, lane])
-                at = self.attr_types.get(attr)
-                if at in (AttrType.INT, AttrType.LONG):
-                    v = int(round(v))
-                if attr in self.encoded_attrs:
-                    # code → original string (0 = never-written lane)
-                    v = self.str_decoder[v - 1] if v >= 1 else None
-                vals[name] = v
+            vals = self._decode_caps_row(caps[p, t, k])
             out.append((int(p), int(ts[p, t, k]) + (self.base_ts or 0),
                         vals))
             order.append((int(enter[p, t, k]), int(seq[p, t, k])))

@@ -137,11 +137,15 @@ class DevicePatternRuntime:
         self.key_lanes: Dict[Any, int] = {}
         self.qr = qr
         self._dtype_for = dtype_for
-        # host-side upper bound on the fullest lane's live partials; when a
-        # chunk could overflow the slot ring, sync the true count and grow —
-        # the host oracle's pending lists are unbounded, drops would lose
-        # matches
+        # mesh path: host-side upper bound on the fullest lane's live
+        # partials; when a chunk could overflow the slot ring, sync the
+        # true count and grow.  Single-device path: sync-free
+        # grow-and-replay instead (the dropped counter rides the packed
+        # egress; a dropping chunk replays from the pre-chunk carry).
+        # Either way the host oracle's pending lists are unbounded, so
+        # drops must never lose matches.
         self._ub_active = 0
+        self._dropped_seen = 0
 
         # output definition straight from the capture-decode plan
         # (encoded string captures decode back to STRING)
@@ -195,14 +199,16 @@ class DevicePatternRuntime:
             pids = self._lanes_for_keys(keys)
         else:
             pids = np.zeros(n, np.int64)
-        t_max = int(np.bincount(pids, minlength=1).max())
-        if self._ub_active + t_max > self.nfa.spec.n_slots:
-            actual = self.nfa.max_active_slots()
-            need = actual + t_max
-            if need > self.nfa.spec.n_slots:
-                self.nfa.grow_slots(1 << (need - 1).bit_length())
-            self._ub_active = actual
-        self._ub_active = min(self._ub_active + t_max, self.nfa.spec.n_slots)
+        if self.nfa.mesh is not None:
+            t_max = int(np.bincount(pids, minlength=1).max())
+            if self._ub_active + t_max > self.nfa.spec.n_slots:
+                actual = self.nfa.max_active_slots()
+                need = actual + t_max
+                if need > self.nfa.spec.n_slots:
+                    self.nfa.grow_slots(1 << (need - 1).bit_length())
+                self._ub_active = actual
+            self._ub_active = min(self._ub_active + t_max,
+                                  self.nfa.spec.n_slots)
         cols = {}
         for a in self.nfa.attr_names:
             col = data.columns.get(a)
@@ -213,10 +219,25 @@ class DevicePatternRuntime:
             else:
                 cols[a] = (np.asarray(col, np.float32) if col is not None
                            else np.zeros(n, np.float32))
-        matches = self.nfa.process_events(
-            pids, cols, np.asarray(data.timestamps, np.int64),
-            stream_codes=np.full(n, stream_code, np.int32),
-            pad_t_pow2=True)
+        ts_arr = np.asarray(data.timestamps, np.int64)
+        codes = np.full(n, stream_code, np.int32)
+        while True:
+            pre_carry, pre_base = self.nfa.carry, self.nfa.base_ts
+            matches = self.nfa.process_events(pids, cols, ts_arr,
+                                              stream_codes=codes,
+                                              pad_t_pow2=True)
+            dropped = getattr(self.nfa, "last_dropped_total",
+                              self._dropped_seen)
+            if dropped <= self._dropped_seen or self.nfa.mesh is not None:
+                self._dropped_seen = max(dropped, self._dropped_seen)
+                break
+            # slot overflow would LOSE matches (the oracle's pending lists
+            # never drop): restore the pre-chunk carry, double the ring,
+            # replay — exact, and no per-chunk device sync in the common
+            # case (the counter rides the packed egress)
+            self.nfa.carry = pre_carry
+            self.nfa.base_ts = pre_base
+            self.nfa.grow_slots(self.nfa.spec.n_slots * 2)
         self._emit(matches)
         if self.nfa.has_absent:
             self._schedule_absent()
@@ -280,6 +301,8 @@ class DevicePatternRuntime:
         self.key_lanes = dict(state["key_lanes"])
         # force the overflow guard to re-sync against the restored carry
         self._ub_active = self.nfa.spec.n_slots
+        self._dropped_seen = int(
+            np.asarray(self.nfa.carry["dropped"]).sum())
         if self.nfa.has_absent:
             self._scheduled_deadline = -1
             self._schedule_absent()

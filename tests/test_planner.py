@@ -423,3 +423,110 @@ def test_window_agg_query_compiles_to_device():
     assert rt2.query_runtimes["q"].backend == "host"
     assert "lengthBatch" in (rt2.query_runtimes["q"].backend_reason or "")
     rt2.shutdown()
+
+
+def test_slot_overflow_grow_and_replay_exact():
+    """The single-device engine path must GROW-AND-REPLAY on slot
+    overflow, never lose matches (review: the replay loop had no
+    coverage).  Tiny initial ring + a burst that stacks many concurrent
+    partials per key forces the replay branch repeatedly."""
+    import numpy as np
+    from siddhi_tpu.plan import planner as planner_mod
+
+    app = """
+    define stream S (sym string, price float, kind int);
+    partition with (sym of S) begin
+    @info(name='q')
+    from every e1=S[kind == 0] -> e2=S[kind == 1 and price > e1.price]
+    select e1.price as p1, e2.price as p2 insert into Out;
+    end;
+    """
+    rng = np.random.default_rng(12)
+    n = 600
+    cols = {"sym": np.asarray([f"k{i}" for i in rng.integers(0, 3, n)],
+                              object),
+            "price": rng.uniform(0, 100, n).astype(np.float32),
+            "kind": rng.integers(0, 2, n).astype(np.int32)}
+    ts = 1_000_000 + np.arange(n, dtype=np.int64)
+
+    def run(engine, slots=None):
+        old = planner_mod.DEFAULT_SLOTS
+        if slots is not None:
+            planner_mod.DEFAULT_SLOTS = slots
+        try:
+            m = SiddhiManager()
+            rt = m.create_siddhi_app_runtime(
+                f"@app:playback @app:engine('{engine}') {app}"
+                if engine else f"@app:playback {app}")
+            got = []
+            rt.add_callback("Out", StreamCallback(
+                lambda evs: got.extend(
+                    (round(e.data[0], 3), round(e.data[1], 3))
+                    for e in evs)))
+            rt.start()
+            rt.get_input_handler("S").send_batch(cols, timestamps=ts)
+            k = None
+            for pr in rt.partition_runtimes:
+                for qr in pr.device_query_runtimes.values():
+                    k = qr.device_runtime.nfa.spec.n_slots
+            rt.shutdown()
+            return sorted(got), k
+        finally:
+            planner_mod.DEFAULT_SLOTS = old
+
+    dev, k_final = run(None, slots=2)
+    host, _ = run("host")
+    assert k_final is not None and k_final > 2, \
+        f"replay never grew the ring (K={k_final})"
+    assert len(host) > 100 and dev == host
+
+
+def test_compact_egress_cap_overflow_exact():
+    """The compacted match egress must retrace with a doubled cap when a
+    chunk yields more matches than the buffer (review: untested) — forced
+    by shrinking the initial cap to 2."""
+    import numpy as np
+
+    app = """
+    define stream S (sym string, price float, kind int);
+    partition with (sym of S) begin
+    @info(name='q')
+    from every e1=S[kind == 0] -> e2=S[kind == 1 and price > e1.price]
+    select e1.price as p1, e2.price as p2 insert into Out;
+    end;
+    """
+    rng = np.random.default_rng(3)
+    n = 400
+    cols = {"sym": np.asarray([f"k{i}" for i in rng.integers(0, 2, n)],
+                              object),
+            "price": rng.uniform(0, 100, n).astype(np.float32),
+            "kind": rng.integers(0, 2, n).astype(np.int32)}
+    ts = 1_000_000 + np.arange(n, dtype=np.int64)
+
+    def run(engine, tiny_cap=False):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(
+            f"@app:playback @app:engine('{engine}') {app}"
+            if engine else f"@app:playback {app}")
+        got = []
+        rt.add_callback("Out", StreamCallback(
+            lambda evs: got.extend(
+                (round(e.data[0], 3), round(e.data[1], 3))
+                for e in evs)))
+        rt.start()
+        if tiny_cap:
+            for pr in rt.partition_runtimes:
+                for qr in pr.device_query_runtimes.values():
+                    qr.device_runtime.nfa._egress_cap = 2
+        rt.get_input_handler("S").send_batch(cols, timestamps=ts)
+        caps = [qr.device_runtime.nfa._egress_cap
+                for pr in rt.partition_runtimes
+                for qr in pr.device_query_runtimes.values()] \
+            if tiny_cap else []
+        rt.shutdown()
+        return sorted(got), caps
+
+    dev, caps = run(None, tiny_cap=True)
+    host, _ = run("host")
+    assert caps and caps[0] > 2, "cap never grew"
+    assert len(host) > 100 and dev == host
