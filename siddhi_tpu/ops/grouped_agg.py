@@ -194,3 +194,119 @@ def reassemble_int_sums(sum_hi: np.ndarray, sum_lo: np.ndarray
                         ) -> np.ndarray:
     """hi/lo split partial sums → exact int64 totals (host egress side)."""
     return sum_hi.astype(np.int64) * _SPLIT + sum_lo.astype(np.int64)
+
+
+# ------------------------------------------------------------ time windows
+
+TS_EMPTY = np.iinfo(np.int32).min      # empty-slot timestamp marker
+
+
+class GroupedTimeCarry(NamedTuple):
+    ring_f: jnp.ndarray     # [P, W, VF] f32
+    ring_i: jnp.ndarray     # [P, W, VI] i32
+    ring_gid: jnp.ndarray   # [P, W] i32
+    ring_ts: jnp.ndarray    # [P, W] i32 offsets (TS_EMPTY = empty)
+    pos: jnp.ndarray        # [P] i32
+    cnt: jnp.ndarray        # [P] i32
+    overflow: jnp.ndarray   # [P] bool — sticky: a still-in-window entry
+    #                         was evicted; caller grows capacity + replays
+    fmin_f: jnp.ndarray     # [P, G, VF] add-only extrema (forever lanes)
+    fmax_f: jnp.ndarray
+    fmin_i: jnp.ndarray     # [P, G, VI]
+    fmax_i: jnp.ndarray
+
+
+def make_grouped_time_carry(n_lanes: int, capacity: int, n_groups: int,
+                            n_float: int, n_int: int) -> GroupedTimeCarry:
+    P, W, G, VF, VI = n_lanes, capacity, n_groups, n_float, n_int
+    return GroupedTimeCarry(
+        ring_f=jnp.zeros((P, W, VF), jnp.float32),
+        ring_i=jnp.zeros((P, W, VI), jnp.int32),
+        ring_gid=jnp.full((P, W), -1, jnp.int32),
+        ring_ts=jnp.full((P, W), TS_EMPTY, jnp.int32),
+        pos=jnp.zeros((P,), jnp.int32),
+        cnt=jnp.zeros((P,), jnp.int32),
+        overflow=jnp.zeros((P,), bool),
+        fmin_f=jnp.full((P, G, VF), jnp.inf, jnp.float32),
+        fmax_f=jnp.full((P, G, VF), -jnp.inf, jnp.float32),
+        fmin_i=jnp.full((P, G, VI), I32_MAX, jnp.int32),
+        fmax_i=jnp.full((P, G, VI), I32_MIN, jnp.int32))
+
+
+def _pair_tree_sum(vals, live):
+    """Masked two-float tree reduction over axis 0 (W must be pow2):
+    returns (hi, lo) whose f64 sum tracks the true sum to ~2^-45 —
+    a plain f32 tree reduce can sit an f32 ulp off the host's float64
+    accumulation, which conformance equality catches."""
+    hi = jnp.where(live, vals, 0.0)
+    lo = jnp.zeros_like(hi)
+    w = hi.shape[0]
+    while w > 1:
+        half = w // 2
+        a_hi, a_lo = hi[:half], lo[:half]
+        b_hi, b_lo = hi[half:w], lo[half:w]
+        s, e = _two_sum(a_hi, b_hi)
+        lo2 = a_lo + b_lo + e
+        hi = s + lo2
+        lo = lo2 - (hi - s)
+        w = half
+    return hi[0], lo[0]
+
+
+def build_grouped_time_step(window_ms: int, capacity: int,
+                            want_forever: bool):
+    """Grouped sliding time(t)/externalTime aggregation: the ring
+    materialises the window's (value, gid, ts) entries; each accepted
+    event's outputs are exact masked reductions over entries of ITS group
+    with `entry_ts > event_ts - window_ms` — the same expiry-in-the-mask
+    treatment as ops/windowed_agg.build_time_wagg_step, with a group-id
+    plane (per-group aggregator maps, QuerySelector.java:171).  Float
+    sums reduce via the two-float pairwise tree (_pair_tree_sum — host
+    float64 parity at f32 precision); INT sums reduce hi/lo split lanes
+    and stay EXACT.  Same output contract as build_grouped_step
+    (13-tuple)."""
+    W = capacity
+    iota = jnp.arange(W)
+
+    def lane_step(carry, xs):
+        (rf, ri, rgid, rts, pos, cnt, ovf, mnf, mxf, mni, mxi) = carry
+        xf, xi, g, t, ok = xs
+        oh = iota == pos
+        old_ts = jnp.sum(jnp.where(oh, rts, 0))
+        evicting_live = (cnt == W) & (old_ts > t - window_ms)
+        ovf = ovf | (ok & evicting_live)
+        rf = jnp.where((ok & oh)[:, None], xf[None, :], rf)
+        ri = jnp.where((ok & oh)[:, None], xi[None, :], ri)
+        rgid = jnp.where(ok & oh, g, rgid)
+        rts = jnp.where(ok & oh, t, rts)
+        pos = jnp.where(ok, (pos + 1) % W, pos)
+        cnt = jnp.where(ok, jnp.minimum(cnt + 1, W), cnt)
+        if want_forever:
+            mnf = mnf.at[g].min(jnp.where(ok, xf, mnf[g]))
+            mxf = mxf.at[g].max(jnp.where(ok, xf, mxf[g]))
+            mni = mni.at[g].min(jnp.where(ok, xi, mni[g]))
+            mxi = mxi.at[g].max(jnp.where(ok, xi, mxi[g]))
+        live = ((iota < cnt) & (rts > t - window_ms) & (rgid == g))[:, None]
+        s_f, s_f_lo = _pair_tree_sum(rf, live)
+        s_ihi = jnp.sum(jnp.where(live, ri >> 16, 0), axis=0)
+        s_ilo = jnp.sum(jnp.where(live, ri & (_SPLIT - 1), 0), axis=0)
+        c = jnp.sum(live[:, 0].astype(jnp.int32))
+        w_mnf = jnp.min(jnp.where(live, rf, jnp.inf), axis=0)
+        w_mxf = jnp.max(jnp.where(live, rf, -jnp.inf), axis=0)
+        w_mni = jnp.min(jnp.where(live, ri, I32_MAX), axis=0)
+        w_mxi = jnp.max(jnp.where(live, ri, I32_MIN), axis=0)
+        out = (s_f, s_f_lo, s_ihi, s_ilo, c,
+               w_mnf, w_mxf, w_mni, w_mxi,
+               mnf[g], mxf[g], mni[g], mxi[g])
+        return (rf, ri, rgid, rts, pos, cnt, ovf, mnf, mxf, mni, mxi), out
+
+    def per_lane(carry_l, f_l, i_l, g_l, ts_l, ok_l):
+        return jax.lax.scan(lane_step, carry_l, (f_l, i_l, g_l, ts_l,
+                                                 ok_l))
+
+    def step(carry: GroupedTimeCarry, vals_f, vals_i, gids, ts, accepted):
+        new_c, outs = jax.vmap(per_lane)(tuple(carry), vals_f, vals_i,
+                                         gids, ts, accepted)
+        return GroupedTimeCarry(*new_c), outs
+
+    return step

@@ -26,3 +26,49 @@ def shift_clamped(v, delta: int, lo: int) -> jnp.ndarray:
     the clamp floor is expired at every future ts)."""
     s = np.asarray(v, np.int64) - delta
     return jnp.asarray(np.maximum(s, lo).astype(np.int32))
+
+
+def rebase_offsets(src: np.ndarray, valid: np.ndarray, base,
+                   window_ms: int, ring_ts, empty_marker: int):
+    """Shared i64→i32 offset rebase for time-window device rings (used by
+    plan/wagg_compiler AND plan/gagg_compiler — one protocol, one place).
+
+    src: absolute i64 timestamps for the chunk (all rows); ONLY rows with
+    `valid` participate in the base/range decisions — rejected rows may
+    carry junk timestamps that must not pin or blow the base.  ring_ts:
+    the carry's current i32 ts plane (empty slots == empty_marker), or
+    None.  Returns (offsets i32 [n] — invalid rows zeroed, new_base,
+    shifted_ring_ts or None).  Raises SiddhiAppRuntimeException on
+    chunks that cannot be represented (data errors for the @OnError
+    boundary)."""
+    from ..utils.errors import SiddhiAppRuntimeException
+    src = np.asarray(src, np.int64)
+    valid = np.asarray(valid, bool)
+    if not valid.any():
+        return np.zeros(len(src), np.int32), base, ring_ts
+    vsrc = src[valid]
+    if base is None:
+        base = int(vsrc.min())
+    offs = src - base
+    mx = int(offs[valid].max())
+    safe = safe_max(window_ms)
+    if mx <= safe and int(offs[valid].min()) < -safe:
+        raise SiddhiAppRuntimeException(
+            "time-window device path: an event timestamp is more than "
+            "~24 days older than the stream's time base")
+    new_ring = ring_ts
+    if mx > safe:
+        delta = int(offs[valid].min())
+        base += delta
+        offs = offs - delta
+        if int(offs[valid].max()) > safe:
+            raise SiddhiAppRuntimeException(
+                "time-window device path: a single chunk spans more than "
+                "~24 days of stream time; split the replay into smaller "
+                "chunks or use @app:engine('host')")
+        if ring_ts is not None:
+            rts = np.asarray(ring_ts, np.int64)
+            shifted = shift_clamped(rts, delta, empty_marker + 1)
+            new_ring = jnp.where(jnp.asarray(rts == empty_marker),
+                                 jnp.int32(empty_marker), shifted)
+    return np.where(valid, offs, 0).astype(np.int32), base, new_ring

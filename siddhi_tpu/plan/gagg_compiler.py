@@ -39,8 +39,10 @@ from ..query_api.definition import AttrType
 from ..query_api.expression import AttributeFunction, Constant, Variable
 from ..utils.errors import (SiddhiAppCreationError,
                             SiddhiAppRuntimeException)
-from ..ops.grouped_agg import (INT_EXACT_MAX, INT_GROUP_MAX,
-                               build_grouped_step, make_grouped_carry,
+from ..ops.grouped_agg import (INT_EXACT_MAX, INT_GROUP_MAX, TS_EMPTY,
+                               GroupedTimeCarry, build_grouped_step,
+                               build_grouped_time_step, make_grouped_carry,
+                               make_grouped_time_carry,
                                reassemble_int_sums)
 from .expr_compiler import EvalCtx, ExprCompiler, Scope
 
@@ -50,6 +52,7 @@ _NUM_TYPES = _INT_TYPES + (AttrType.FLOAT, AttrType.DOUBLE)
 
 G_START = 8          # initial per-lane group capacity (doubles on demand)
 MAX_WINDOW = (1 << 15) - 1   # hi/lo int sums stay exact below this
+TIME_CAPACITY_START = 64     # time-window ring start (grow-and-replay)
 
 
 def _reject(msg: str):
@@ -76,16 +79,36 @@ class CompiledGroupedAgg:
         s = query.input_stream
         assert isinstance(s, SingleInputStream)
         wh = s.window_handler
+        self.window_kind = "length"      # length | time (no-window: W=0)
+        self.ts_attr: Optional[str] = None
+        kind = wh.name.lower() if wh is not None and \
+            not (wh.namespace or "") else ("" if wh is None else "?")
         if wh is None:
             self.window = 0
-        elif wh.name.lower() == "length" and not (wh.namespace or ""):
+        elif kind == "length":
             if not wh.params or not isinstance(wh.params[0], Constant):
                 _reject("window.length needs a constant length")
             self.window = int(wh.params[0].value)
             if not 0 < self.window <= MAX_WINDOW:
                 _reject(f"window length {self.window} out of device range")
+        elif kind in ("time", "externaltime"):
+            self.window_kind = "time"
+            if kind == "externaltime":
+                if len(wh.params) != 2 or \
+                        not isinstance(wh.params[0], Variable):
+                    _reject("externalTime needs (tsAttr, window)")
+                self.ts_attr = wh.params[0].attribute
+                span = wh.params[1]
+            else:
+                span = wh.params[0] if wh.params else None
+            if not isinstance(span, Constant):
+                _reject(f"{wh.name} needs a constant window length")
+            self.window_ms = int(span.value)
+            self.window = TIME_CAPACITY_START
+            self._ts_base: Optional[int] = None
         else:
-            _reject(f"only #window.length / no window compile "
+            _reject(f"only #window.length / #window.time / "
+                    f"#window.externalTime / no window compile "
                     f"(got #{wh.name})")
         definition = app.stream_definitions.get(s.stream_id)
         if definition is None:
@@ -93,6 +116,11 @@ class CompiledGroupedAgg:
         self.stream_id = s.stream_id
         self.input_definition = definition
         attr_types = {a.name: a.type for a in definition.attributes}
+        if self.ts_attr is not None:
+            at = attr_types.get(self.ts_attr)
+            if at not in (AttrType.INT, AttrType.LONG):
+                _reject(f"externalTime: '{self.ts_attr}' must be an "
+                        f"INT/LONG attribute")
 
         scope = Scope()
         scope.add_primary(s.stream_id, s.stream_ref, definition)
@@ -180,19 +208,31 @@ class CompiledGroupedAgg:
         self.n_groups = G_START
         self.gid_map: Dict[Tuple, int] = {}      # (lane, key tuple) → gid
         self._lane_gids: Dict[int, int] = {}     # lane → next local gid
-        self._step = jax.jit(build_grouped_step(
-            self.window, want_minmax, want_forever))
-        self.carry = make_grouped_carry(n_lanes, self.window, self.n_groups,
-                                        self._n_float, self._n_int)
+        self._build_step()
+        self.carry = self._make_carry(n_lanes)
 
     # ------------------------------------------------------------ shapes
+
+    def _build_step(self):
+        if self.window_kind == "time":
+            self._step = jax.jit(build_grouped_time_step(
+                self.window_ms, self.window, self.want_forever))
+        else:
+            self._step = jax.jit(build_grouped_step(
+                self.window, self.want_minmax, self.want_forever))
+
+    def _make_carry(self, n_lanes: int, n_groups: Optional[int] = None):
+        g = self.n_groups if n_groups is None else n_groups
+        if self.window_kind == "time":
+            return make_grouped_time_carry(n_lanes, self.window, g,
+                                           self._n_float, self._n_int)
+        return make_grouped_carry(n_lanes, self.window, g,
+                                  self._n_float, self._n_int)
 
     def grow_lanes(self, n_lanes: int) -> None:
         if n_lanes <= self.n_lanes:
             return
-        fresh = make_grouped_carry(n_lanes - self.n_lanes, self.window,
-                                   self.n_groups, self._n_float,
-                                   self._n_int)
+        fresh = self._make_carry(n_lanes - self.n_lanes)
         self.carry = type(self.carry)(
             *[jnp.concatenate([a, b], axis=0)
               for a, b in zip(self.carry, fresh)])
@@ -201,16 +241,56 @@ class CompiledGroupedAgg:
     def _grow_groups(self, n_groups: int) -> None:
         if n_groups <= self.n_groups:
             return
-        pad = make_grouped_carry(self.n_lanes, self.window,
-                                 n_groups - self.n_groups,
-                                 self._n_float, self._n_int)
+        pad = self._make_carry(self.n_lanes,
+                               n_groups=n_groups - self.n_groups)
         c, p = self.carry, pad
-        gfields = ("fsum_hi", "fsum_lo", "isum_hi", "isum_lo", "gcnt",
-                   "fmin_f", "fmax_f", "fmin_i", "fmax_i")
+        gfields = ("fmin_f", "fmax_f", "fmin_i", "fmax_i")
+        if self.window_kind != "time":
+            gfields += ("fsum_hi", "fsum_lo", "isum_hi", "isum_lo", "gcnt")
         self.carry = c._replace(**{
             f: jnp.concatenate([getattr(c, f), getattr(p, f)], axis=1)
             for f in gfields})
         self.n_groups = n_groups
+
+    def _grow_time_capacity(self, new_capacity: int) -> None:
+        """Double the time ring (chronological compaction so the
+        slot-fill invariant `valid slots = [0, cnt)` holds), keeping the
+        value/gid planes aligned with their timestamps."""
+        assert self.window_kind == "time"
+        if new_capacity <= self.window:
+            return
+        old = self.carry
+        P = self.n_lanes
+        rts = np.asarray(old.ring_ts)
+        rf = np.asarray(old.ring_f)
+        ri = np.asarray(old.ring_i)
+        rg = np.asarray(old.ring_gid)
+        W2 = new_capacity
+        nf = np.zeros((P, W2) + rf.shape[2:], np.float32)
+        ni = np.zeros((P, W2) + ri.shape[2:], np.int32)
+        ng = np.full((P, W2), -1, np.int32)
+        nts = np.full((P, W2), TS_EMPTY, np.int32)
+        cnt = np.zeros(P, np.int32)
+        order = np.argsort(rts, axis=1, kind="stable")
+        keep = np.take_along_axis(rts, order, 1) != TS_EMPTY
+        for p in range(P):                  # host-side, grow-time only
+            sel = order[p][keep[p]]
+            k = len(sel)
+            nf[p, :k] = rf[p, sel]
+            ni[p, :k] = ri[p, sel]
+            ng[p, :k] = rg[p, sel]
+            nts[p, :k] = rts[p, sel]
+            cnt[p] = k
+        self.window = W2
+        self.carry = GroupedTimeCarry(
+            ring_f=jnp.asarray(nf), ring_i=jnp.asarray(ni),
+            ring_gid=jnp.asarray(ng), ring_ts=jnp.asarray(nts),
+            pos=jnp.asarray(cnt % W2, jnp.int32),
+            cnt=jnp.asarray(cnt, jnp.int32),
+            overflow=jnp.zeros((P,), bool),
+            fmin_f=old.fmin_f, fmax_f=old.fmax_f,
+            fmin_i=old.fmin_i, fmax_i=old.fmax_i)
+        self._build_step()
 
     def _gids_for(self, lanes: np.ndarray, key_cols: List[np.ndarray]
                   ) -> np.ndarray:
@@ -235,6 +315,24 @@ class CompiledGroupedAgg:
                 cap *= 2
             self._grow_groups(cap)
         return out
+
+    def _ts_offsets(self, data, lanes32, row, ok, shape) -> np.ndarray:
+        """[P, T] i32 ts offsets for the time kernel (shared rebase
+        protocol: ops/ts32.rebase_offsets — only ACCEPTED rows decide the
+        base; filter-rejected rows may carry junk timestamps).
+        externalTime reads the event's own ts attribute."""
+        from ..ops.ts32 import rebase_offsets
+        src = (np.asarray(data.columns[self.ts_attr], np.int64)
+               if self.ts_attr else
+               np.asarray(data.timestamps, np.int64))
+        offs, self._ts_base, new_ring = rebase_offsets(
+            src, ok, self._ts_base, self.window_ms,
+            self.carry.ring_ts, TS_EMPTY)
+        if new_ring is not self.carry.ring_ts:
+            self.carry = self.carry._replace(ring_ts=new_ring)
+        plane = np.zeros(shape, np.int32)
+        plane[lanes32, row] = offs
+        return plane
 
     # ------------------------------------------------------------ execute
 
@@ -289,8 +387,30 @@ class CompiledGroupedAgg:
         i_plane[lanes32, row] = vals_i
         g_plane[lanes32, row] = gids
         ok_plane[lanes32, row] = ok
-        self.carry, outs = self._step(self.carry, f_plane, i_plane,
-                                      g_plane, ok_plane)
+        if self.window_kind == "time":
+            ts_plane = self._ts_offsets(data, lanes32, row, ok,
+                                        (P, T))
+            while True:
+                prev = self.carry
+                self.carry, outs = self._step(prev, f_plane, i_plane,
+                                              g_plane, ts_plane, ok_plane)
+                if not bool(np.asarray(self.carry.overflow).any()):
+                    break
+                # a still-in-window entry was evicted: results would
+                # undercount — grow the ring and replay from the
+                # pre-block carry (exact, like ops/windowed_agg)
+                self.carry = prev
+                if self.window * 2 > MAX_WINDOW + 1:
+                    # check BEFORE growing: the compaction + fresh kernel
+                    # build would be wasted work right before the raise
+                    raise SiddhiAppRuntimeException(
+                        "device grouped-agg path: time window needs more "
+                        "than 2^15 live entries (exact int-sum bound) — "
+                        "re-plan with @app:engine('host')")
+                self._grow_time_capacity(self.window * 2)
+        else:
+            self.carry, outs = self._step(self.carry, f_plane, i_plane,
+                                          g_plane, ok_plane)
         (fhi, flo, ihi, ilo, cnt, w_mnf, w_mxf, w_mni, w_mxi,
          a_mnf, a_mxf, a_mni, a_mxi) = [np.asarray(o) for o in outs]
         sel_l, sel_r = lanes32[ok], row[ok]
@@ -367,12 +487,19 @@ class CompiledGroupedAgg:
     def current_state(self) -> dict:
         return {"carry": [np.asarray(a) for a in self.carry],
                 "n_lanes": self.n_lanes, "n_groups": self.n_groups,
+                "window": self.window,
+                "ts_base": getattr(self, "_ts_base", None),
                 "gid_map": {repr(k): v for k, v in self.gid_map.items()},
                 "lane_gids": dict(self._lane_gids)}
 
     def restore_state(self, state: dict) -> None:
         self.n_lanes = state["n_lanes"]
         self.n_groups = state["n_groups"]
+        if self.window_kind == "time":
+            self._ts_base = state.get("ts_base")
+            if state.get("window", self.window) != self.window:
+                self.window = state["window"]
+                self._build_step()
         self.carry = type(self.carry)(
             *[jnp.asarray(a) for a in state["carry"]])
         import ast

@@ -283,55 +283,24 @@ class CompiledWindowedAgg:
 
     def _with_ts_offsets(self, block) -> Dict[str, jnp.ndarray]:
         """Derive the kernel's i32 `__ts32` lanes from the block's absolute
-        i64 `__ts64` lanes, rebasing the carry when offsets approach i32
-        range (x64 is disabled under jit; ~24.8 days of stream time per
-        base — same treatment as the NFA path's ts rebase)."""
-        from ..ops.ts32 import safe_max, shift_clamped
+        i64 `__ts64` lanes via the SHARED rebase protocol
+        (ops/ts32.rebase_offsets — x64 is disabled under jit; ~24.8 days
+        of stream time per base)."""
+        from ..ops.ts32 import rebase_offsets, shift_clamped
         from ..ops.windowed_agg import TS_EMPTY
         ts_abs = np.asarray(block["__ts64"], np.int64)
         valid = np.asarray(block["__valid"])
-        if not valid.any():
-            # all-padding block (planner warm trace): don't pin the base
-            out = {k: v for k, v in block.items() if k != "__ts64"}
-            out["__ts32"] = jnp.zeros(ts_abs.shape, jnp.int32)
-            return out
-        if self._ts_base is None:
-            self._ts_base = int(ts_abs[valid].min())
-        offs = ts_abs - self._ts_base
-        mx = int(offs[valid].max())
-        safe = safe_max(self.window_ms)
-        if mx <= safe and int(offs[valid].min()) < -safe:
-            # event-supplied (externalTime) timestamps arbitrarily older
-            # than the base would wrap i32 into the far future — a runtime
-            # data error: the junction's @OnError boundary LOG-drops or
-            # fault-routes the chunk
-            from ..utils.errors import SiddhiAppRuntimeException
-            raise SiddhiAppRuntimeException(
-                "time-window device path: an event timestamp is more than "
-                "~24 days older than the stream's time base")
-        if mx > safe:
-            delta = int(offs[valid].min())
-            self._ts_base += delta
-            offs = offs - delta
-            if int(offs[valid].max()) > safe:
-                # one chunk spanning ≥ ~24.8 days of stream time cannot be
-                # rebased — fail loudly rather than wrap i32 silently
-                from ..utils.errors import SiddhiAppRuntimeException
-                raise SiddhiAppRuntimeException(
-                    "time-window device path: a single chunk spans more "
-                    "than ~24 days of stream time; split the replay into "
-                    "smaller chunks or use @app:engine('host')")
-            # empty slots stay TS_EMPTY; live entries clamp just above it
-            # (the clamp floor is expired at every future ts)
-            rts = np.asarray(self.carry.ring_ts, np.int64)
-            shifted = shift_clamped(rts, delta, TS_EMPTY + 1)
-            rts32 = jnp.where(jnp.asarray(rts == TS_EMPTY),
-                              jnp.int32(TS_EMPTY), shifted)
+        base_before = self._ts_base
+        offs, self._ts_base, new_ring = rebase_offsets(
+            ts_abs.reshape(-1), valid.reshape(-1), self._ts_base,
+            self.window_ms, self.carry.ring_ts, TS_EMPTY)
+        if new_ring is not self.carry.ring_ts:
+            # the ring only shifts when a prior base moved by delta
+            delta = self._ts_base - (base_before or 0)
             last = shift_clamped(self.carry.last_ts, delta, TS_EMPTY + 1)
-            self.carry = self.carry._replace(ring_ts=rts32, last_ts=last)
+            self.carry = self.carry._replace(ring_ts=new_ring, last_ts=last)
         out = {k: v for k, v in block.items() if k != "__ts64"}
-        out["__ts32"] = jnp.asarray(
-            np.where(valid, offs, 0).astype(np.int32))
+        out["__ts32"] = jnp.asarray(offs.reshape(ts_abs.shape))
         return out
 
     def current_aggregates(self) -> Dict[str, np.ndarray]:

@@ -291,3 +291,79 @@ def test_filtered_out_keys_allocate_no_groups():
     assert len(cga.gid_map) == 0 and cga.n_groups == 8, \
         (len(cga.gid_map), cga.n_groups)
     rt.shutdown()
+
+
+def test_time_window_groupby_device():
+    """Grouped sliding time windows on device: masked-expiry ring with a
+    gid plane (ops/grouped_agg.build_grouped_time_step)."""
+    app = STREAM + """
+        @info(name='q') from S#window.time(1 sec)
+        select sym, sum(price) as t, count() as c, min(price) as mn
+        group by sym insert into Out;"""
+    sends = []
+    rng = np.random.default_rng(8)
+    for i in range(40):
+        sends.append(([f"s{rng.integers(0, 3)}", "u",
+                       float(np.float32(rng.uniform(1, 100))), 1],
+                      1_000_000 + i * 150))   # expiries interleave
+    assert_parity(app, sends)
+
+
+def test_external_time_window_groupby_int_sum_device():
+    app = """
+    define stream S (sym string, ets long, volume long);
+    @info(name='q') from S#window.externalTime(ets, 1 sec)
+    select sym, sum(volume) as tv, count() as c group by sym
+    insert into Out;"""
+    sends = []
+    rng = np.random.default_rng(9)
+    ets = 5_000_000
+    for i in range(40):
+        ets += int(rng.integers(50, 400))
+        sends.append(([f"s{rng.integers(0, 3)}", ets,
+                       int(rng.integers(-1_000_000_000, 1_000_000_000))],
+                      1_000_000 + i * 100))
+    assert_parity(app, sends)
+
+
+def test_time_window_ring_growth_replay():
+    """More in-window entries than the initial ring capacity (64): the
+    grouped time ring must grow-and-replay, exactly."""
+    app = STREAM + """
+        @info(name='q') from S#window.time(10 sec)
+        select sym, sum(price) as t, count() as c group by sym
+        insert into Out;"""
+    sends = []
+    rng = np.random.default_rng(10)
+    for i in range(200):                 # all within 10s of each other
+        sends.append(([f"s{rng.integers(0, 2)}", "u",
+                       float(np.float32(rng.uniform(1, 100))), 1],
+                      1_000_000 + i * 40))
+    host = assert_parity(app, sends)
+    assert len(host) == 200
+
+
+def test_partitioned_time_window_finer_groupby():
+    app = """
+    define stream S (sym string, user string, price float, volume long);
+    partition with (sym of S) begin
+    @info(name='q') from S#window.time(1 sec)
+    select sym, user, sum(volume) as tv group by user insert into Out;
+    end;"""
+    assert_parity(app, _rows(n=50, vol_max=1_000_000_000))
+
+
+def test_external_time_junk_ts_on_rejected_rows():
+    """Filter-rejected rows carrying junk timestamps (ets=0 beside
+    epoch-ms values) must not pin or blow the i32 time base (review:
+    rebase must consider ACCEPTED rows only)."""
+    app = """
+    define stream S (sym string, ets long, volume long, kind int);
+    @info(name='q') from S[kind == 1]#window.externalTime(ets, 1 sec)
+    select sym, sum(volume) as tv group by sym insert into Out;"""
+    epoch = 1_700_000_000_000
+    sends = [(["a", epoch, 7, 1], 1_000_000),
+             (["a", 0, 999, 0], 1_000_100),        # rejected, junk ets
+             (["a", epoch + 500, 9, 1], 1_000_200)]
+    out = assert_parity(app, sends)
+    assert out == [("a", 7), ("a", 16)]
