@@ -693,23 +693,32 @@ class DeviceFilterRuntime:
             sel_attrs = [OutputAttribute(a.name, _V(a.name))
                          for a in definition.attributes]
 
-        all_exprs = [oa.expr for oa in sel_attrs] + \
-            [h.expr for h in sis.handlers]
         if any(_scan_fns(oa.expr, is_agg) for oa in sel_attrs):
             raise SiddhiAppCreationError(
                 "device filter path: aggregates are stateful (host windows)")
-        if any(_scan_fns(e, _is_time_fn) for e in all_exprs):
+        if any(_scan_fns(h.expr, _is_time_fn) for h in sis.handlers):
+            # the device FILTER must be exact; output expressions with
+            # time functions evaluate host-side below instead
             raise SiddhiAppCreationError(
-                "device filter path: timestamp functions need int64 host "
-                "evaluation")
+                "device filter path: timestamp functions in filters need "
+                "int64 host evaluation")
 
         # outputs: plain attribute passthroughs gather host-side by mask
         # (exact dtypes — INT/LONG would corrupt on float32 device lanes);
-        # computed outputs evaluate on device and must be FLOAT/DOUBLE/BOOL
-        self.outputs = []      # (name, 'host_col', attr) | (name, 'dev', i)
+        # computed FLOAT/DOUBLE/BOOL outputs evaluate on device; computed
+        # outputs the device cannot express exactly (STRING/OBJECT,
+        # INT/LONG, timestamp functions) evaluate HOST-SIDE on the
+        # device-masked rows — the hot per-event work (the filter) stays
+        # on device, projection of the survivors is host gather work the
+        # passthrough columns already do
+        self.outputs = []      # (name, 'host_col'|'dev'|'host_expr', ref)
         dev_exprs = []
+        host_exprs = []
         attrs = []
         from ..query_api.expression import Variable
+        host_compiler = ExprCompiler(scope, np,
+                                     app.app_ctx.script_functions,
+                                     app.extension_registry)
         attr_types = {a.name: a.type for a in definition.attributes}
         for oa in sel_attrs:
             e = oa.expr
@@ -717,16 +726,29 @@ class DeviceFilterRuntime:
                     e.stream_index is None:
                 self.outputs.append((oa.rename, "host_col", e.attribute))
                 attrs.append(Attribute(oa.rename, attr_types[e.attribute]))
+                continue
+            ce = None
+            if not _scan_fns(e, _is_time_fn):
+                try:
+                    ce = compiler.compile(e)
+                except Exception:       # noqa: BLE001 — host expr instead
+                    ce = None
+            if ce is None or dtype_for(ce.type) is object or \
+                    ce.type in (AttrType.INT, AttrType.LONG):
+                che = host_compiler.compile(e)
+                self.outputs.append((oa.rename, "host_expr",
+                                     len(host_exprs)))
+                host_exprs.append(che)
+                attrs.append(Attribute(oa.rename, che.type))
             else:
-                ce = compiler.compile(e)
-                if dtype_for(ce.type) is object or \
-                        ce.type in (AttrType.INT, AttrType.LONG):
-                    raise SiddhiAppCreationError(
-                        f"device filter path: computed output '{oa.rename}' "
-                        f"of type {ce.type} cannot ride float32 lanes")
                 self.outputs.append((oa.rename, "dev", len(dev_exprs)))
                 dev_exprs.append(ce)
                 attrs.append(Attribute(oa.rename, ce.type))
+        if host_exprs and not filters:
+            raise SiddhiAppCreationError(
+                "device filter path: no filters and host-only computed "
+                "outputs — nothing to run on the device")
+        self._host_exprs = host_exprs
         target = getattr(q.output_stream, "target_id", "") or qr.name
         out_def = StreamDefinition(target, attrs)
         self.head = qr._finish_device_chain(out_def, factory)
@@ -799,10 +821,20 @@ class DeviceFilterRuntime:
         ok = ok | (chunk.types == TIMER) | (chunk.types == RESET)
         if not ok.any():
             return
+        hctx = None
+        if self._host_exprs:
+            from .expr_compiler import EvalCtx
+            masked = chunk.mask(ok)
+            hctx = EvalCtx(masked.columns, masked.timestamps, len(masked))
         out_cols: Dict[str, np.ndarray] = {}
         for (name, kind, ref) in self.outputs:
             if kind == "host_col":
                 out_cols[name] = np.asarray(chunk.columns[ref])[ok]
+            elif kind == "host_expr":
+                v = np.asarray(self._host_exprs[ref].fn(hctx))
+                if v.ndim == 0:
+                    v = np.broadcast_to(v, (hctx.n,))
+                out_cols[name] = v
             else:
                 arr = np.asarray(outs[ref])[:n][ok]
                 out_cols[name] = arr.astype(self._dev_dtypes[ref])
