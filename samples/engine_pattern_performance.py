@@ -6,6 +6,18 @@ This measures what a user actually gets (VERDICT r2 weak #5): the full
 ingest/egress path including key→lane mapping, packing, device step,
 payload decode and callback delivery — unlike samples/
 tpu_pattern_performance.py, which benchmarks the raw compiled bank.
+
+Configurations measured:
+  - device+@Async: the production shape — the async junction pipelines
+    chunks (plan/planner.py DevicePatternRuntime keeps several egress
+    reads in flight, ≙ the ingest/compute overlap of the reference's
+    @Async disruptor junction, stream/StreamJunction.java:280-316);
+    rt.flush() bounds the clock at full match delivery.
+  - device sync: matches delivered before send_batch returns.
+  - host: the host oracle on the same workload.
+Each is reported twice: with the classic Event[] callback (per-match
+python objects, reference StreamCallback semantics) and with a columnar
+callback (receive_chunk override — the TPU-native zero-copy delivery).
 """
 import sys
 import time
@@ -14,7 +26,7 @@ import numpy as np
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-APP = """
+APP_BODY = """
 define stream S (sym string, price float, kind int);
 partition with (sym of S) begin
 @info(name='q')
@@ -26,18 +38,30 @@ end;
 
 N_KEYS = 1024
 CHUNK = 65_536
-CHUNKS = 4
+CHUNKS = 8
 TS_STEP = 2          # ms between events: per-key gap ~2s << within 40s
 
 
-def run(engine):
+def run(engine, use_async, columnar=False):
     from siddhi_tpu import SiddhiManager, StreamCallback
     m = SiddhiManager()
+    app = APP_BODY
+    if use_async:
+        app = app.replace(
+            "define stream S",
+            f"@Async(buffer.size='64', batch.size.max='{CHUNK}')\n"
+            "define stream S", 1)
     prefix = f"@app:engine('{engine}') " if engine else ""
-    rt = m.create_siddhi_app_runtime("@app:playback " + prefix + APP)
+    rt = m.create_siddhi_app_runtime("@app:playback " + prefix + app)
     matched = [0]
-    rt.add_callback("Out", StreamCallback(
-        lambda evs: matched.__setitem__(0, matched[0] + len(evs))))
+    if columnar:
+        cb = StreamCallback()
+        cb.receive_chunk = lambda chunk: matched.__setitem__(
+            0, matched[0] + len(chunk))
+    else:
+        cb = StreamCallback(
+            lambda evs: matched.__setitem__(0, matched[0] + len(evs)))
+    rt.add_callback("Out", cb)
     rt.start()
     h = rt.get_input_handler("S")
     rng = np.random.default_rng(0)
@@ -52,6 +76,7 @@ def run(engine):
 
     cols, ts = chunk(1_000_000)
     h.send_batch(cols, timestamps=ts)            # warmup / compile
+    rt.flush()
     dev = any(pr.device_mode for pr in rt.partition_runtimes)
     t0 = time.perf_counter()
     total = 0
@@ -60,22 +85,31 @@ def run(engine):
         cols, ts = chunk(base + ci * CHUNK * TS_STEP)
         h.send_batch(cols, timestamps=ts)
         total += CHUNK
+    rt.flush()                                    # all matches delivered
     dt = time.perf_counter() - t0
     rt.shutdown()
     return dev, total / dt, matched[0]
 
 
 def main():
-    dev, rate_dev, m_dev = run(None)
-    host, rate_host, m_host = run("host")
-    assert dev and not host
-    print(f"keys (lanes):    {N_KEYS}")
-    print(f"engine (device): {rate_dev:,.0f} events/s, "
-          f"{m_dev:,} matches delivered")
-    print(f"engine (host):   {rate_host:,.0f} events/s, "
-          f"{m_host:,} matches delivered")
-    print(f"speedup:         {rate_dev / rate_host:.1f}x "
-          f"(match parity: {m_dev == m_host})")
+    dev, rate_pipe, m_pipe = run(None, use_async=True)
+    _, rate_pipe_col, m_col = run(None, use_async=True, columnar=True)
+    dev_s, rate_sync, m_sync = run(None, use_async=False)
+    host, rate_host, m_host = run("host", use_async=False)
+    assert dev and dev_s and not host
+    print(f"keys (lanes):              {N_KEYS}")
+    print(f"engine device @Async:      {rate_pipe:,.0f} events/s, "
+          f"{m_pipe:,} matches (Event[] callbacks)")
+    print(f"engine device @Async col.: {rate_pipe_col:,.0f} events/s, "
+          f"{m_col:,} matches (columnar callbacks)")
+    print(f"engine device sync:        {rate_sync:,.0f} events/s, "
+          f"{m_sync:,} matches")
+    print(f"engine host:               {rate_host:,.0f} events/s, "
+          f"{m_host:,} matches")
+    parity = m_pipe == m_col == m_sync == m_host
+    print(f"speedup vs host:           {rate_pipe / rate_host:.1f}x "
+          f"(match parity: {parity})")
+    assert parity, "device/host match counts diverge"
 
 
 if __name__ == "__main__":

@@ -146,11 +146,16 @@ class EventChunk:
                                          for n in self.names]
 
     def to_events(self) -> List[Event]:
-        out = []
-        for i in range(len(self)):
-            ts, data = self.row(i)
-            out.append(Event(ts, data))
-        return out
+        # vectorized row materialization: ndarray.tolist() converts each
+        # column to python scalars in C (vs a _to_py call per cell) — the
+        # user-facing Event[] decode rides the callback hot path
+        n = len(self)
+        if n == 0:
+            return []
+        ts_list = self.timestamps.tolist()
+        col_lists = [self.columns[name].tolist() for name in self.names]
+        return [Event(ts, list(row))
+                for ts, row in zip(ts_list, zip(*col_lists))]
 
     # ------------------------------------------------------------ transforms
 

@@ -71,6 +71,14 @@ class ProcessStreamReceiver:
         self.query_name = query_name
         self.app_ctx = app_ctx
 
+    def flush(self):
+        """Retire pipelined device work held by the head (if any) under
+        the query lock — junction idle/drain hook."""
+        f = getattr(self.first, "flush", None)
+        if f is not None:
+            with self.lock:
+                f()
+
     def receive_chunk(self, chunk: EventChunk):
         dbg = getattr(self.app_ctx, "debugger", None) if self.app_ctx else None
         if dbg is not None:

@@ -363,6 +363,14 @@ class SiddhiAppRuntime:
         for t in self.triggers:
             t.start()
 
+    def flush(self):
+        """Drain async junction queues and retire pipelined device work:
+        when this returns, every match for events already sent has been
+        delivered to callbacks.  The columnar analogue of waiting out the
+        reference's @Async disruptor backlog."""
+        for j in self.junctions.values():
+            j.flush()
+
     def shutdown(self):
         dbg = getattr(self.app_ctx, "debugger", None)
         if dbg is not None:

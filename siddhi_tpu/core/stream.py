@@ -74,6 +74,17 @@ class QueryCallback:
         self.receive(ts, cur or None, exp or None)
 
 
+class _FlushBarrier:
+    """Queue sentinel: the async worker flushes receivers and signals when
+    it reaches this item (StreamJunction.flush)."""
+
+    def __init__(self):
+        self.done = threading.Event()
+
+    def __len__(self):          # rides the chunk queue
+        return 0
+
+
 class StreamJunction:
     """Pub/sub hub for one stream."""
 
@@ -129,32 +140,82 @@ class StreamJunction:
         if self._queue is not None:
             self._drain.set()
             for t in self._worker_threads:
-                t.join(timeout=30.0)
+                # generous: a queued first delivery can hide a remote AOT
+                # compile; abandoning a live worker leaks it holding the
+                # query lock
+                t.join(timeout=600.0)
             self._worker_threads.clear()
             self._queue = None
         self._stop.set()
 
     def _worker_loop(self):
         """Re-batches queued chunks up to batch_size_max before delivery
-        (reference util/event/handler/StreamHandler.java re-batching)."""
+        (reference util/event/handler/StreamHandler.java re-batching).
+        When the queue goes idle (or on drain), flushes receivers that
+        pipeline device work (plan/planner.py DevicePatternRuntime) so
+        deferred matches never hang waiting for the next event."""
+        delivered = False
         while not self._stop.is_set():
             try:
                 item = self._queue.get(timeout=0.1)
             except queue.Empty:
+                if delivered:
+                    self._flush_receivers()
+                    delivered = False
                 if self._drain.is_set():
                     break       # drained: queue empty after drain request
                 continue
+            if isinstance(item, _FlushBarrier):
+                self._flush_receivers()
+                delivered = False
+                item.done.set()
+                continue
             batch = [item]
             n = len(item)
+            barrier = None
             while n < self.batch_size_max:
                 try:
                     nxt = self._queue.get_nowait()
                 except queue.Empty:
                     break
+                if isinstance(nxt, _FlushBarrier):
+                    barrier = nxt
+                    break
                 batch.append(nxt)
                 n += len(nxt)
             merged = EventChunk.concat(batch) if len(batch) > 1 else batch[0]
             self._deliver(merged)
+            delivered = True
+            if barrier is not None:
+                self._flush_receivers()
+                delivered = False
+                barrier.done.set()
+        if delivered:
+            self._flush_receivers()
+
+    def _flush_receivers(self):
+        for r in list(self.receivers):
+            f = getattr(r, "flush", None)
+            if f is not None:
+                try:
+                    f()
+                except Exception as e:  # noqa: BLE001 — @OnError boundary
+                    self._handle_error(
+                        EventChunk.empty(self.definition.attribute_names), e)
+
+    def flush(self):
+        """Synchronous flush: when this returns, every chunk already sent
+        has been delivered and any pipelined device work retired (matches
+        handed to callbacks).  Async mode rides a queue barrier through
+        the worker (exact with the default single worker); the barrier
+        timeout is generous because a first delivery can hide a remote
+        AOT compile."""
+        if self.is_async and self._queue is not None:
+            b = _FlushBarrier()
+            self._queue.put(b)
+            b.done.wait(timeout=600.0)
+        else:
+            self._flush_receivers()
 
     # ------------------------------------------------------------ sending
 

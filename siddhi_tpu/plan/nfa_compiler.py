@@ -934,18 +934,19 @@ class CompiledPatternNFA:
                                                              block)
         return mask, caps, ts, enter, seq
 
-    def _compact_egress(self, mask, caps, ts, enter, seq):
-        """Device-side match compaction: ONE [cap+1, 4+R*C] int32 D2H
-        carrying only the MATCHED slots (flat index, ts, enter, seq,
-        bitcast capture row) plus a tail row with (true count, cumulative
-        dropped).  Shipping the dense [P, T, K] buffers cost ~P*T*K*(5+RC)
-        bytes per chunk — tens of MB through a remote tunnel; matches are
-        sparse, so egress should scale with THEM.  The compaction cap
-        doubles on overflow (one retrace, results exact).  Side effect:
-        sets self.last_dropped_total (drives grow-and-replay without an
-        extra sync)."""
+    def egress_dispatch(self, outs):
+        """Phase 1 of the compacted egress: dispatch the device-side match
+        compaction for one block's raw outputs and start the D2H transfer
+        (copy_to_host_async), WITHOUT blocking.  Returns an opaque handle
+        for egress_retire.  Splitting dispatch from retire lets the engine
+        pipeline chunks: the ~100-300 ms tunnel round-trip of chunk N's
+        read overlaps chunk N+1's dispatch + host work (≙ the ingest/
+        compute overlap the reference gets from its @Async disruptor
+        junction, stream/StreamJunction.java:280-316)."""
+        mask, caps, ts, enter, seq = outs
         P, T, K = mask.shape
-        R, C = max(self.spec.n_rows, 1), max(self.spec.n_caps, 1)
+        R = max(int(caps.shape[-2]), 1)
+        C = max(int(caps.shape[-1]), 1)
         if not hasattr(self, "_egress_cap"):
             self._egress_cap = 1024
 
@@ -965,19 +966,46 @@ class CompiledPatternNFA:
 
         if not hasattr(self, "_egress_jit"):
             self._egress_jit = jax.jit(pack, static_argnums=6)
-        while True:
-            buf = np.asarray(self._egress_jit(
-                mask, caps, ts, enter, seq, self.carry["dropped"],
-                self._egress_cap))
-            count = int(buf[-1, 0])
-            self.last_dropped_total = int(buf[-1, 1])
-            if count <= self._egress_cap:
-                break
-            cap = self._egress_cap
+        dropped = self.carry["dropped"]
+        buf = self._egress_jit(mask, caps, ts, enter, seq, dropped,
+                               self._egress_cap)
+        try:
+            buf.copy_to_host_async()
+        except Exception:       # backends without async copy: retire blocks
+            pass
+        return {"buf": buf, "cap": self._egress_cap, "outs": outs,
+                "dropped": dropped, "tk": (T, K)}
+
+    def egress_retire(self, handle):
+        """Phase 2: block on the transfer, re-pack at a doubled cap if the
+        match count overflowed (one retrace, results exact).  Side effect:
+        sets self.last_dropped_total (drives grow-and-replay without an
+        extra sync)."""
+        buf = np.asarray(handle["buf"])
+        count = int(buf[-1, 0])
+        self.last_dropped_total = int(buf[-1, 1])
+        while count > handle["cap"]:
+            cap = handle["cap"]
             while cap < count:
                 cap *= 2
-            self._egress_cap = cap
-        return buf[:count], (T, K)
+            handle["cap"] = cap
+            self._egress_cap = max(self._egress_cap, cap)
+            mask, caps, ts, enter, seq = handle["outs"]
+            buf = np.asarray(self._egress_jit(
+                mask, caps, ts, enter, seq, handle["dropped"], cap))
+            count = int(buf[-1, 0])
+            self.last_dropped_total = int(buf[-1, 1])
+        return buf[:count], handle["tk"]
+
+    def _compact_egress(self, mask, caps, ts, enter, seq):
+        """Device-side match compaction: ONE [cap+1, 4+R*C] int32 D2H
+        carrying only the MATCHED slots (flat index, ts, enter, seq,
+        bitcast capture row) plus a tail row with (true count, cumulative
+        dropped).  Shipping the dense [P, T, K] buffers cost ~P*T*K*(5+RC)
+        bytes per chunk — tens of MB through a remote tunnel; matches are
+        sparse, so egress should scale with THEM."""
+        return self.egress_retire(
+            self.egress_dispatch((mask, caps, ts, enter, seq)))
 
     def _decode_compact(self, rows: np.ndarray, tk) -> list:
         """Compacted egress rows → the same match list decode_matches
@@ -1018,6 +1046,60 @@ class CompiledPatternNFA:
             vals[name] = v
         return vals
 
+    def decode_compact_columns(self, rows: np.ndarray, tk,
+                               base_ts: Optional[int] = None):
+        """Vectorized compacted-egress decode → (pids, ts, {name: column})
+        in the oracle emission order (completion ts, then final-unit entry
+        order, then arm sequence) — same contract as _decode_compact but
+        columnar: no per-match Python loop, so the engine's egress decode
+        scales with numpy throughput instead of interpreter speed.
+        base_ts pins the timestamp origin the block was packed against
+        (pipelined retires can happen after a later chunk rebased)."""
+        from ..core.event import dtype_for
+        T, K = tk
+        R, C = max(self.spec.n_rows, 1), max(self.spec.n_caps, 1)
+        n = len(rows)
+        if base_ts is None:
+            base_ts = self.base_ts
+        pids = rows[:, 0].astype(np.int64) // (T * K)
+        ts = rows[:, 1].astype(np.int64) + (base_ts or 0)
+        if n:
+            order = np.lexsort((rows[:, 3], rows[:, 2], ts))
+            pids, ts = pids[order], ts[order]
+            caps_f = rows[:, 4:].view(np.float32).reshape(-1, R, C)[order]
+        else:
+            caps_f = np.zeros((0, R, C), np.float32)
+        cols: Dict[str, np.ndarray] = {}
+        for name, row, attr, which in self.select_outputs:
+            lane = self.cap_lane[(row, attr, which)]
+            v = caps_f[:, row, lane]
+            at = self.attr_types.get(attr)
+            null_mask = None
+            if row in self.nullable_rows:
+                vlane = self._n_lane[row] if self._n_lane[row] >= 0 \
+                    else self._matched_lane[row]
+                null_mask = caps_f[:, row, vlane] <= 0
+            if attr in self.encoded_attrs:
+                codes = np.rint(v).astype(np.int64)
+                out = np.full(n, None, object)
+                valid = codes >= 1
+                if null_mask is not None:
+                    valid &= ~null_mask
+                if valid.any():
+                    dec = np.asarray(self.str_decoder, object)
+                    out[valid] = dec[codes[valid] - 1]
+                cols[name] = out
+                continue
+            if at in (AttrType.INT, AttrType.LONG):
+                v = np.rint(v).astype(np.int64)
+            col = v.astype(dtype_for(self.output_type(attr)))
+            if null_mask is not None:
+                out = col.astype(object)
+                out[null_mask] = None
+                col = out
+            cols[name] = col
+        return pids, ts, cols
+
     def process_timer(self, now_ms: int):
         """Inject one virtual TIMER row at absolute time now_ms (absent
         deadlines + within expiry between real events)."""
@@ -1031,20 +1113,24 @@ class CompiledPatternNFA:
         outs = self.process_block(block)
         return self._decode_compact(*self._compact_egress(*outs))
 
-    def process_events(self, partition_ids: np.ndarray,
-                       columns: Dict[str, np.ndarray],
-                       timestamps: np.ndarray,
-                       stream_names: Optional[np.ndarray] = None,
-                       stream_codes: Optional[np.ndarray] = None,
-                       pad_t_pow2: bool = False):
-        """Flat event batch → packed lanes → device step → decoded matches.
-
-        Returns a list of (partition, match_ts, {out_name: value})."""
+    def dispatch_events(self, partition_ids: np.ndarray,
+                        columns: Dict[str, np.ndarray],
+                        timestamps: np.ndarray,
+                        stream_names: Optional[np.ndarray] = None,
+                        stream_codes: Optional[np.ndarray] = None,
+                        pad_t_pow2: bool = False) -> dict:
+        """Pack + dispatch one flat event batch and start its egress D2H
+        transfer without blocking; returns a handle for retire_events.
+        The pipelined engine path (plan/planner.py) keeps a few handles in
+        flight so the tunnel read round-trip of chunk N overlaps chunk
+        N+1's dispatch; the handle carries everything needed to replay the
+        block after a slot-ring growth (grow-and-replay)."""
         if self.base_ts is None:
             self.base_ts = int(timestamps[0]) if len(timestamps) else 0
+        ts_range = None
         if len(timestamps):
-            self._maybe_rebase(int(np.min(timestamps)),
-                               int(np.max(timestamps)))
+            ts_range = (int(np.min(timestamps)), int(np.max(timestamps)))
+            self._maybe_rebase(*ts_range)
         if stream_codes is not None:
             codes = np.asarray(stream_codes, np.int32)
         elif stream_names is None:
@@ -1062,8 +1148,45 @@ class CompiledPatternNFA:
                             np.asarray(timestamps), codes,
                             self.n_partitions, base_ts=self.base_ts,
                             pad_t_pow2=pad_t_pow2)
+        pre_carry, pre_base = self.carry, self.base_ts
         outs = self.process_block(block)
-        return self._decode_compact(*self._compact_egress(*outs))
+        h = self.egress_dispatch(outs)
+        h.update(block=block, ts_range=ts_range, pre_carry=pre_carry,
+                 pre_base=pre_base, base_ts=self.base_ts)
+        return h
+
+    def replay_block(self, h: dict) -> dict:
+        """Re-dispatch a handle's block against the current carry (after a
+        grow_slots); re-applies the rebase its original dispatch did."""
+        if h["ts_range"] is not None:
+            self._maybe_rebase(*h["ts_range"])
+        outs = self.process_block(h["block"])
+        nh = self.egress_dispatch(outs)
+        nh.update(block=h["block"], ts_range=h["ts_range"],
+                  pre_carry=None, pre_base=None, base_ts=self.base_ts)
+        return nh
+
+    def retire_events(self, h: dict):
+        """Block on a dispatched handle → (pids, ts, columns) in emission
+        order (columnar decode).  Sets self.last_dropped_total."""
+        rows, tk = self.egress_retire(h)
+        return self.decode_compact_columns(rows, tk,
+                                           base_ts=h["base_ts"])
+
+    def process_events(self, partition_ids: np.ndarray,
+                       columns: Dict[str, np.ndarray],
+                       timestamps: np.ndarray,
+                       stream_names: Optional[np.ndarray] = None,
+                       stream_codes: Optional[np.ndarray] = None,
+                       pad_t_pow2: bool = False):
+        """Flat event batch → packed lanes → device step → decoded matches.
+
+        Returns a list of (partition, match_ts, {out_name: value})."""
+        h = self.dispatch_events(partition_ids, columns, timestamps,
+                                 stream_names=stream_names,
+                                 stream_codes=stream_codes,
+                                 pad_t_pow2=pad_t_pow2)
+        return self._decode_compact(*self.egress_retire(h))
 
     def _ts_safe_max(self) -> int:
         # keep ts - slot_start inside int32 even for a slot clamped to

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 import threading
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -37,14 +38,28 @@ GROW_START = 8          # initial keyed-lane capacity (doubles on demand)
 def map_keys_to_lanes(key_lanes: Dict[Any, int], keys: List[Any],
                       capacity: int, grow_fn) -> np.ndarray:
     """Assign each key a stable lane index, growing the device slab (via
-    grow_fn(new_capacity)) when the key population exceeds capacity."""
-    lanes = np.empty(len(keys), np.int64)
-    for i, k in enumerate(keys):
-        lane = key_lanes.get(k)
-        if lane is None:
-            lane = len(key_lanes)
-            key_lanes[k] = lane
-        lanes[i] = lane
+    grow_fn(new_capacity)) when the key population exceeds capacity.
+    String keys take a vectorized path: one dict probe per DISTINCT key in
+    the batch (np.unique in C) instead of one per event."""
+    arr = np.asarray(keys)
+    if arr.dtype.kind in "US" and len(keys) > 256:
+        uniq, inv = np.unique(arr, return_inverse=True)
+        lane_of = np.empty(len(uniq), np.int64)
+        for i, k in enumerate(uniq.tolist()):
+            lane = key_lanes.get(k)
+            if lane is None:
+                lane = len(key_lanes)
+                key_lanes[k] = lane
+            lane_of[i] = lane
+        lanes = lane_of[inv]
+    else:
+        lanes = np.empty(len(keys), np.int64)
+        for i, k in enumerate(keys):
+            lane = key_lanes.get(k)
+            if lane is None:
+                lane = len(key_lanes)
+                key_lanes[k] = lane
+            lanes[i] = lane
     if key_lanes and len(key_lanes) > capacity:
         cap = capacity
         while cap < len(key_lanes):
@@ -101,6 +116,9 @@ class _DeviceIngress:
 
     def process(self, chunk):
         self.runtime.ingest(self.stream_code, self.stream_id, chunk)
+
+    def flush(self):
+        self.runtime.flush()
 
 
 class DevicePatternRuntime:
@@ -170,6 +188,28 @@ class DevicePatternRuntime:
             app.junction_of(stream_id).subscribe(recv)
             qr.receivers[stream_id] = recv
 
+        # ingest pipelining: keep up to `depth` chunks in flight so the
+        # egress read round-trip overlaps later dispatches.  Deferred
+        # delivery is only transparent when the sender is already
+        # decoupled, so it auto-enables iff every input junction is @Async
+        # (flushes ride the worker's idle/drain hooks); @app:pipeline('D')
+        # forces a depth either way.  Absent patterns stay synchronous:
+        # their timer scheduling reads NFA state after every chunk.
+        self._inflight: "deque" = deque()
+        ann = find_annotation(app.app.annotations, "app:pipeline") or \
+            find_annotation(app.app.annotations, "pipeline")
+        if ann is not None:
+            pos = ann.positional()
+            self.pipeline_depth = int(pos[0] if pos
+                                      else ann.get("depth", "4"))
+        elif all(app.junction_of(sid).is_async
+                 for sid in self.nfa.stream_codes):
+            self.pipeline_depth = 4
+        else:
+            self.pipeline_depth = 0
+        if self.nfa.has_absent:
+            self.pipeline_depth = 0
+
     # ------------------------------------------------------------ ingest
 
     def _lanes_for_keys(self, keys: List[Any]) -> np.ndarray:
@@ -221,26 +261,64 @@ class DevicePatternRuntime:
                            else np.zeros(n, np.float32))
         ts_arr = np.asarray(data.timestamps, np.int64)
         codes = np.full(n, stream_code, np.int32)
-        while True:
-            pre_carry, pre_base = self.nfa.carry, self.nfa.base_ts
-            matches = self.nfa.process_events(pids, cols, ts_arr,
-                                              stream_codes=codes,
-                                              pad_t_pow2=True)
-            dropped = getattr(self.nfa, "last_dropped_total",
-                              self._dropped_seen)
-            if dropped <= self._dropped_seen or self.nfa.mesh is not None:
-                self._dropped_seen = max(dropped, self._dropped_seen)
-                break
-            # slot overflow would LOSE matches (the oracle's pending lists
-            # never drop): restore the pre-chunk carry, double the ring,
-            # replay — exact, and no per-chunk device sync in the common
-            # case (the counter rides the packed egress)
-            self.nfa.carry = pre_carry
-            self.nfa.base_ts = pre_base
-            self.nfa.grow_slots(self.nfa.spec.n_slots * 2)
-        self._emit(matches)
+        h = self.nfa.dispatch_events(pids, cols, ts_arr,
+                                     stream_codes=codes, pad_t_pow2=True)
+        self._inflight.append(h)
+        # retire down to the pipeline depth: with depth 0 this is the old
+        # synchronous behavior (matches delivered before ingest returns);
+        # with depth D the tunnel's egress read round-trip for chunk N
+        # overlaps chunks N+1..N+D's dispatch (≙ the ingest/compute
+        # overlap of the reference's @Async disruptor junction,
+        # stream/StreamJunction.java:280-316)
+        while len(self._inflight) > self.pipeline_depth:
+            self._retire_one()
         if self.nfa.has_absent:
             self._schedule_absent()
+
+    def _retire_one(self) -> None:
+        """Block on the oldest in-flight chunk, handle slot-ring overflow
+        (grow-and-replay: restore that chunk's pre-carry, double the ring,
+        replay it and every later in-flight chunk), decode columnar,
+        emit."""
+        h = self._inflight.popleft()
+        pids, ts, cols = self.nfa.retire_events(h)
+        dropped = self.nfa.last_dropped_total
+        if dropped > self._dropped_seen and self.nfa.mesh is None:
+            # slot overflow would LOSE matches (the oracle's pending lists
+            # never drop): every chunk from this one on ran on a dropping
+            # ring — rewind to this chunk's pre-carry, grow, replay all
+            pending = [h] + list(self._inflight)
+            self._inflight.clear()
+            self.nfa.carry = h["pre_carry"]
+            self.nfa.base_ts = h["pre_base"]
+            self.nfa.grow_slots(self.nfa.spec.n_slots * 2)
+            for e in pending:
+                while True:
+                    pre_carry, pre_base = self.nfa.carry, self.nfa.base_ts
+                    r = self.nfa.replay_block(e)
+                    pids, ts, cols = self.nfa.retire_events(r)
+                    if self.nfa.last_dropped_total <= self._dropped_seen:
+                        break
+                    self.nfa.carry = pre_carry
+                    self.nfa.base_ts = pre_base
+                    self.nfa.grow_slots(self.nfa.spec.n_slots * 2)
+                self._emit_columns(pids, ts, cols)
+            return
+        self._dropped_seen = max(dropped, self._dropped_seen)
+        self._emit_columns(pids, ts, cols)
+
+    def flush(self) -> None:
+        """Retire every in-flight chunk (pipelined mode): called on idle/
+        drain by the async junction, and before any state read."""
+        while self._inflight:
+            self._retire_one()
+
+    def _emit_columns(self, pids, ts, cols) -> None:
+        from ..core.event import EventChunk
+        if not len(ts):
+            return
+        names = [o[0] for o in self.nfa.select_outputs]
+        self.head.process(EventChunk.from_columns(names, ts, cols))
 
     def _emit(self, matches) -> None:
         from ..core.event import EventChunk
@@ -276,6 +354,7 @@ class DevicePatternRuntime:
             if self._shutdown:
                 return
             with self.qr.lock:
+                self.flush()
                 matches = self.nfa.process_timer(max(now, _dl))
                 self._emit(matches)
                 self._scheduled_deadline = -1
@@ -288,15 +367,18 @@ class DevicePatternRuntime:
         pass
 
     def shutdown(self) -> None:
+        self.flush()
         self._shutdown = True
 
     # ------------------------------------------------------------ snapshot
 
     def current_state(self) -> dict:
+        self.flush()
         return {"nfa": self.nfa.current_state(),
                 "key_lanes": dict(self.key_lanes)}
 
     def restore_state(self, state: dict) -> None:
+        self.flush()
         self.nfa.restore_state(state["nfa"])
         self.key_lanes = dict(state["key_lanes"])
         # force the overflow guard to re-sync against the restored carry
