@@ -119,6 +119,7 @@ class SiddhiAppRuntime:
 
         self.snapshot_service = SnapshotService(self.app_ctx)
         self.app_ctx.snapshot_service = self.snapshot_service
+        self.snapshot_service.pre_snapshot = self.flush
         self._parse_app_annotations()
         self._build()
 
@@ -367,9 +368,14 @@ class SiddhiAppRuntime:
         """Drain async junction queues and retire pipelined device work:
         when this returns, every match for events already sent has been
         delivered to callbacks.  The columnar analogue of waiting out the
-        reference's @Async disruptor backlog."""
-        for j in self.junctions.values():
-            j.flush()
+        reference's @Async disruptor backlog.  One pass per junction:
+        flushing stream S can enqueue matches into a downstream @Async
+        junction that was flushed earlier in the pass, so iterate once
+        per junction (an event can traverse at most every junction once
+        per hop)."""
+        for _ in range(max(len(self.junctions), 1)):
+            for j in self.junctions.values():
+                j.flush()
 
     def shutdown(self):
         dbg = getattr(self.app_ctx, "debugger", None)

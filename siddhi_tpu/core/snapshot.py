@@ -95,6 +95,10 @@ class SnapshotService:
         self.app_ctx = app_ctx
         self._elements: Dict[str, object] = {}
         self._lock = threading.Lock()
+        # set by SiddhiAppRuntime: drains async junction queues + retires
+        # pipelined device work so a snapshot deterministically includes
+        # every event sent before persist() was called
+        self.pre_snapshot = None
         # incremental bookkeeping: per-element digest of the last persisted
         # state (reference separates incrementalSnapshotable op-logs from
         # periodic base state, SnapshotService.java:159-205; a content
@@ -112,6 +116,8 @@ class SnapshotService:
     def full_snapshot(self) -> bytes:
         """ThreadBarrier-locked capture of every element's state
         (reference SnapshotService.fullSnapshot:97-158)."""
+        if self.pre_snapshot is not None:
+            self.pre_snapshot()
         barrier = self.app_ctx.thread_barrier
         barrier.lock()
         try:
@@ -140,6 +146,8 @@ class SnapshotService:
         """Only elements whose state changed since the last persisted
         snapshot (full or incremental)."""
         import hashlib
+        if self.pre_snapshot is not None:
+            self.pre_snapshot()
         barrier = self.app_ctx.thread_barrier
         barrier.lock()
         try:

@@ -75,14 +75,28 @@ class QueryCallback:
 
 
 class _FlushBarrier:
-    """Queue sentinel: the async worker flushes receivers and signals when
-    it reaches this item (StreamJunction.flush)."""
+    """Queue sentinel for StreamJunction.flush: one copy is enqueued per
+    worker; workers rendezvous at an internal barrier (so every in-hand
+    delivery has finished), then exactly one flushes the receivers and
+    signals done.  Exact for any worker count."""
 
-    def __init__(self):
+    def __init__(self, n_workers: int):
+        self.sync = threading.Barrier(max(n_workers, 1))
         self.done = threading.Event()
 
     def __len__(self):          # rides the chunk queue
         return 0
+
+    def arrive(self, flush_fn):
+        try:
+            i = self.sync.wait(timeout=600.0)
+        except threading.BrokenBarrierError:
+            i = 0               # a peer died (drain race): flush anyway
+        if i == 0:
+            try:
+                flush_fn()
+            finally:
+                self.done.set()
 
 
 class StreamJunction:
@@ -166,9 +180,8 @@ class StreamJunction:
                     break       # drained: queue empty after drain request
                 continue
             if isinstance(item, _FlushBarrier):
-                self._flush_receivers()
                 delivered = False
-                item.done.set()
+                item.arrive(self._flush_receivers)
                 continue
             batch = [item]
             n = len(item)
@@ -187,9 +200,8 @@ class StreamJunction:
             self._deliver(merged)
             delivered = True
             if barrier is not None:
-                self._flush_receivers()
                 delivered = False
-                barrier.done.set()
+                barrier.arrive(self._flush_receivers)
         if delivered:
             self._flush_receivers()
 
@@ -206,14 +218,23 @@ class StreamJunction:
     def flush(self):
         """Synchronous flush: when this returns, every chunk already sent
         has been delivered and any pipelined device work retired (matches
-        handed to callbacks).  Async mode rides a queue barrier through
-        the worker (exact with the default single worker); the barrier
-        timeout is generous because a first delivery can hide a remote
-        AOT compile."""
-        if self.is_async and self._queue is not None:
-            b = _FlushBarrier()
-            self._queue.put(b)
-            b.done.wait(timeout=600.0)
+        handed to callbacks).  Async mode rides one barrier copy per
+        worker through the queue (exact for any worker count — workers
+        rendezvous before one flushes); falls back to a direct receiver
+        flush when the workers are gone (racing stop()/shutdown).  The
+        wait is generous because a first delivery can hide a remote AOT
+        compile."""
+        q = self._queue
+        workers = list(self._worker_threads)
+        if self.is_async and q is not None and workers and \
+                not self._drain.is_set():
+            b = _FlushBarrier(len(workers))
+            for _ in workers:
+                q.put(b)
+            while not b.done.wait(timeout=1.0):
+                if not any(t.is_alive() for t in workers):
+                    self._flush_receivers()   # stop() won the race
+                    return
         else:
             self._flush_receivers()
 

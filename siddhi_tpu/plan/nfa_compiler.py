@@ -1008,22 +1008,15 @@ class CompiledPatternNFA:
             self.egress_dispatch((mask, caps, ts, enter, seq)))
 
     def _decode_compact(self, rows: np.ndarray, tk) -> list:
-        """Compacted egress rows → the same match list decode_matches
-        yields (flat row-major order == np.nonzero order)."""
-        T, K = tk
-        R, C = max(self.spec.n_rows, 1), max(self.spec.n_caps, 1)
-        out = []
-        order = []
-        caps_f = rows[:, 4:].view(np.float32).reshape(-1, R, C)
-        for i in range(len(rows)):
-            idx = int(rows[i, 0])
-            p = idx // (T * K)
-            vals = self._decode_caps_row(caps_f[i])
-            out.append((p, int(rows[i, 1]) + (self.base_ts or 0), vals))
-            order.append((int(rows[i, 2]), int(rows[i, 3])))
-        out = [m for _o, m in sorted(
-            zip(order, out), key=lambda x: (x[1][1], x[0][0], x[0][1]))]
-        return out
+        """Compacted egress rows → match list [(partition, ts, {name:
+        value})] in emission order — scalar view over the columnar decode
+        (decode_compact_columns) so the two cannot diverge."""
+        pids, ts, cols = self.decode_compact_columns(rows, tk)
+        names = list(cols)
+        col_lists = [cols[n].tolist() for n in names]
+        return [(int(p), int(t), dict(zip(names, vals)))
+                for p, t, *vals in zip(pids.tolist(), ts.tolist(),
+                                       *col_lists)]
 
     def _decode_caps_row(self, caps_row: np.ndarray) -> dict:
         """One [R, C] capture row → select-output values (shared by the

@@ -213,8 +213,14 @@ class DevicePatternRuntime:
     # ------------------------------------------------------------ ingest
 
     def _lanes_for_keys(self, keys: List[Any]) -> np.ndarray:
+        def grow(cap):
+            # partition-axis growth invalidates the pre-carries held by
+            # in-flight chunks (their P is the old width): retire them
+            # first so grow-and-replay never mixes carry widths
+            self.flush()
+            self.nfa.grow(cap)
         return map_keys_to_lanes(self.key_lanes, keys,
-                                 self.nfa.n_partitions, self.nfa.grow)
+                                 self.nfa.n_partitions, grow)
 
     def ingest(self, stream_code: int, stream_id: str, chunk) -> None:
         from ..core.event import CURRENT, EventChunk
@@ -309,9 +315,12 @@ class DevicePatternRuntime:
 
     def flush(self) -> None:
         """Retire every in-flight chunk (pipelined mode): called on idle/
-        drain by the async junction, and before any state read."""
-        while self._inflight:
-            self._retire_one()
+        drain by the async junction, and before any state read.  Takes the
+        query lock (re-entrant) — state reads can race the junction
+        worker's ingest."""
+        with self.qr.lock:
+            while self._inflight:
+                self._retire_one()
 
     def _emit_columns(self, pids, ts, cols) -> None:
         from ..core.event import EventChunk
@@ -373,13 +382,19 @@ class DevicePatternRuntime:
     # ------------------------------------------------------------ snapshot
 
     def current_state(self) -> dict:
-        self.flush()
-        return {"nfa": self.nfa.current_state(),
-                "key_lanes": dict(self.key_lanes)}
+        with self.qr.lock:
+            self.flush()
+            return {"nfa": self.nfa.current_state(),
+                    "key_lanes": dict(self.key_lanes)}
 
     def restore_state(self, state: dict) -> None:
-        self.flush()
-        self.nfa.restore_state(state["nfa"])
+        with self.qr.lock:
+            self.flush()
+            self.nfa.restore_state(state["nfa"])
+            # the restored carry's lanes are only meaningful with the
+            # snapshot's key→lane map; dropping it would hand restored
+            # lanes of one key to fresh keys
+            self.key_lanes = dict(state.get("key_lanes") or {})
         self.key_lanes = dict(state["key_lanes"])
         # force the overflow guard to re-sync against the restored carry
         self._ub_active = self.nfa.spec.n_slots
