@@ -209,11 +209,14 @@ class QueryRuntime:
             if isinstance(h, Filter):
                 chain.append(FilterProcessor(compiler.compile(h.expr)))
             elif isinstance(h, WindowHandler):
-                wp = create_window_processor(
-                    h.name, h.params, app.app_ctx, definition.attribute_names,
-                    lambda e: compiler.compile(e),
-                    namespace=h.namespace or "",
-                    extension_registry=app.extension_registry)
+                wp = self._try_device_window(h, definition, compiler)
+                if wp is None:
+                    wp = create_window_processor(
+                        h.name, h.params, app.app_ctx,
+                        definition.attribute_names,
+                        lambda e: compiler.compile(e),
+                        namespace=h.namespace or "",
+                        extension_registry=app.extension_registry)
                 wp.lock = self.lock
                 self.windows.append(wp)
                 chain.append(wp)
@@ -230,6 +233,39 @@ class QueryRuntime:
                                        self.partition_key)
             junction.subscribe(receiver)
         self.receivers[s.stream_id] = receiver
+
+    def _try_device_window(self, h, definition, compiler):
+        """Device window state (plan/dwin_compiler) in place of the host
+        window processor when the kind/payload types have device lanes —
+        the buffer of record and all eviction/flush math move to the
+        device kernel; the selector stays host (hybrid recorded in
+        docs/device_coverage.md).  Host partition clones keep host
+        windows (one tiny device state per key would serialize)."""
+        app = self.app_runtime
+        if self.partition_key is not None or \
+                getattr(app, "app", None) is None or h.namespace:
+            return None
+        from ..plan.dwin_compiler import (DEVICE_KINDS,
+                                          DeviceWindowProcessor)
+        from ..plan.planner import engine_mode
+        mode = engine_mode(app.app)
+        if mode == "host":
+            return None
+        kind = next((k for k in DEVICE_KINDS
+                     if k.lower() == h.name.lower()), None)
+        if kind is None:
+            return None
+        try:
+            wp = DeviceWindowProcessor(app.app_ctx, definition, kind,
+                                       h.params, compiler.compile)
+        except SiddhiAppCreationError:
+            if mode == "device":
+                raise
+            return None
+        self.backend = "device"
+        self.backend_reason = ("hybrid: window state/evictions on device "
+                               "(dwin kernel), selector host")
+        return wp
 
     def _make_stream_function(self, h: StreamFunctionHandler, compiler):
         app = self.app_runtime

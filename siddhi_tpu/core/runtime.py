@@ -376,6 +376,10 @@ class SiddhiAppRuntime:
         for _ in range(max(len(self.junctions), 1)):
             for j in self.junctions.values():
                 j.flush()
+            if not any(j.is_async and j._queue is not None and
+                       not j._queue.empty()
+                       for j in self.junctions.values()):
+                break       # quiescent: nothing cascaded into a queue
 
     def shutdown(self):
         dbg = getattr(self.app_ctx, "debugger", None)

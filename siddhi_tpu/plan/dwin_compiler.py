@@ -1,0 +1,617 @@
+"""Device window processor: window state as device ring slabs (ops/dwin).
+
+Drops into the host query chain in place of a host WindowProcessor
+(core/window.py) — same Processor interface, same emission algebra — but
+the buffer of record is a device ring slab and every eviction / batch
+flush is computed by the jitted kernel (closed-form vectorized index
+math, single compacted egress transfer).  Downstream (QuerySelector,
+rate limiters, callbacks) is unchanged host code, so the reference's
+CURRENT/EXPIRED/RESET semantics (siddhi-architecture.md:253-268) hold by
+construction; the hybrid split (device window state + host selector) is
+recorded in docs/device_coverage.md.
+
+Payload lanes: FLOAT→f32, INT/BOOL→i32, LONG→i32 hi/lo pair (exact),
+STRING→dictionary code.  DOUBLE and OBJECT payloads reject at plan time
+(f32 lanes would round-trip lossily).
+
+Reference: query/processor/stream/window/{Length,LengthBatch,Time,
+TimeBatch,ExternalTime,ExternalTimeBatch,TimeLength,Delay,Batch}
+WindowProcessor.java.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.event import CURRENT, EXPIRED, RESET, EventChunk, dtype_for
+from ..core.window import WindowProcessor, _interleave, _reset_row
+from ..ops.dwin import (C_BATCH, C_DELAY, C_EXPBATCH, C_LEN, C_TIME,
+                        TS_NONE, DwinSpec, build_dwin_step, make_dwin_carry)
+from ..query_api.definition import AttrType
+from ..query_api.expression import Constant, TimeConstant, Variable
+from ..utils.errors import (SiddhiAppCreationError,
+                            SiddhiAppRuntimeException)
+
+DEVICE_KINDS = ("length", "lengthBatch", "time", "timeBatch",
+                "externalTime", "externalTimeBatch", "timeLength",
+                "delay", "batch")
+_BATCH_KINDS = ("lengthBatch", "timeBatch", "externalTimeBatch", "batch")
+W_START = 16
+LONG_BASE = np.int64(1) << 31
+INT_NONE = np.int32(-(2 ** 31))       # null sentinel on INT lanes
+
+
+def _reject(msg: str):
+    raise SiddhiAppCreationError("device window path: " + msg)
+
+
+def _const_ms(p) -> int:
+    if isinstance(p, (TimeConstant, Constant)):
+        return int(p.value)
+    _reject("window parameters must be constants")
+
+
+class DeviceWindowProcessor(WindowProcessor):
+    """One window's state on device (see module docstring)."""
+
+    backend = "device"
+    requires_scheduler = True            # per-kind below
+
+    def __init__(self, app_ctx, definition, kind: str, params: List,
+                 compile_expr):
+        super().__init__(app_ctx, definition.attribute_names)
+        self.kind = kind
+        self.definition = definition
+        if kind not in DEVICE_KINDS:
+            _reject(f"#window.{kind} has no device kernel")
+
+        # ---- window parameters (mirror core/window.create_window_processor)
+        self.window_ms = 0
+        self.length = 0
+        self.ts_expr = None
+        need = {"length": 1, "lengthBatch": 1, "time": 1, "timeBatch": 1,
+                "delay": 1, "externalTime": 2, "externalTimeBatch": 2,
+                "timeLength": 2, "batch": 0}[kind]
+        if len(params) < need:
+            _reject(f"#window.{kind} needs {need} parameter(s)")
+        if kind == "length" or kind == "lengthBatch":
+            self.length = _const_ms(params[0])
+            if self.length <= 0:
+                _reject("length must be positive")
+        elif kind in ("time", "timeBatch", "delay"):
+            self.window_ms = _const_ms(params[0])
+            if kind == "timeBatch" and len(params) > 1:
+                self.start_time = _const_ms(params[1])
+            else:
+                self.start_time = None
+        elif kind in ("externalTime", "externalTimeBatch"):
+            if not isinstance(params[0], Variable):
+                _reject(f"{kind} needs a timestamp attribute")
+            self.ts_expr = compile_expr(params[0])
+            self.window_ms = _const_ms(params[1])
+            self.start_time = _const_ms(params[2]) \
+                if kind == "externalTimeBatch" and len(params) > 2 else None
+        elif kind == "timeLength":
+            self.window_ms = _const_ms(params[0])
+            self.length = _const_ms(params[1])
+        # batch(): no params
+
+        # ---- payload lane assignment
+        self.f_lanes: Dict[str, int] = {}
+        self.i_lanes: Dict[str, Tuple[int, ...]] = {}
+        self.str_attrs: Dict[str, Tuple[Dict, List]] = {}
+        self.attr_types = {a.name: a.type for a in definition.attributes}
+        nf = ni = 0
+        for a in definition.attributes:
+            t = a.type
+            if t == AttrType.FLOAT:
+                self.f_lanes[a.name] = nf
+                nf += 1
+            elif t in (AttrType.INT, AttrType.BOOL):
+                self.i_lanes[a.name] = (ni,)
+                ni += 1
+            elif t == AttrType.LONG:
+                self.i_lanes[a.name] = (ni, ni + 1)
+                ni += 2
+            elif t == AttrType.STRING:
+                self.i_lanes[a.name] = (ni,)
+                self.str_attrs[a.name] = ({}, [])
+                ni += 1
+            else:
+                _reject(f"{t.name} payload attributes ride no exact device "
+                        f"lane (f32 round-trip would break host parity)")
+        if kind == "externalTimeBatch":
+            # batch CURRENT rows keep their ORIGINAL arrival timestamps
+            # while the ring is keyed by event time — carry arrival ts on
+            # two extra i32 lanes
+            self._arr_lanes = (ni, ni + 1)
+            ni += 2
+        self.n_f, self.n_i = nf, ni
+
+        self.capacity = max(W_START, 2 * self.length or 0)
+        self._base: Optional[int] = None
+        self.carry = None                 # device dict (lazy at first use)
+        self._steps: Dict[Tuple[int, int], callable] = {}
+        # control state (host-side, mirrors the host processors)
+        self.next_emit: Optional[int] = None
+        self.window_end: Optional[int] = None
+        self._fill_host = 0               # pre-step fill (interleave c0)
+        self._exp_fill_host = 0
+
+    # ------------------------------------------------------------ encode
+
+    def _ensure_carry(self):
+        if self.carry is None:
+            spec = DwinSpec(self.kind, self.capacity, self.n_f, self.n_i,
+                            self.window_ms, self.length)
+            self.carry = {k: jnp.asarray(v) for k, v in
+                          make_dwin_carry(spec, 1).items()}
+
+    def _step_for(self, T: int):
+        key = (self.capacity, T)
+        fn = self._steps.get(key)
+        if fn is None:
+            spec = DwinSpec(self.kind, self.capacity, self.n_f, self.n_i,
+                            self.window_ms, self.length)
+            fn = jax.jit(build_dwin_step(spec), static_argnums=7)
+            self._steps[key] = fn
+        return fn
+
+    def _code(self, attr: str, v) -> int:
+        enc, dec = self.str_attrs[attr]
+        if v is None:
+            return 0
+        c = enc.get(v)
+        if c is None:
+            c = len(dec) + 1
+            enc[v] = c
+            dec.append(v)
+        return c
+
+    def _offsets(self, ts64: np.ndarray) -> np.ndarray:
+        if self._base is None:
+            self._base = int(ts64[0]) if len(ts64) else 0
+        off = ts64 - self._base
+        lim = int(TS_NONE) - max(self.window_ms, 1) - 1
+        if len(off) and int(off.max()) > lim:
+            delta = int(off.min())
+            ring = np.asarray(self.carry["ring_ts"])
+            ring = np.where(ring == int(TS_NONE), ring,
+                            np.maximum(ring - delta,
+                                       -(self.window_ms + 1)))
+            self.carry["ring_ts"] = jnp.asarray(ring.astype(np.int32))
+            if "exp_ts" in self.carry:
+                ring = np.asarray(self.carry["exp_ts"])
+                ring = np.where(ring == int(TS_NONE), ring,
+                                np.maximum(ring - delta,
+                                           -(self.window_ms + 1)))
+                self.carry["exp_ts"] = jnp.asarray(ring.astype(np.int32))
+            self._base += delta
+            off = ts64 - self._base
+            if len(off) and int(off.max()) > lim:
+                raise SiddhiAppRuntimeException(
+                    "device window path: one batch spans more stream time "
+                    "than int32 ms offsets can represent")
+        return off.astype(np.int32)
+
+    def _encode_chunk(self, chunk: EventChunk, ring_ts64: np.ndarray):
+        T = len(chunk)
+        F, I = max(self.n_f, 1), max(self.n_i, 1)
+        ev_f = np.zeros((1, T, F), np.float32)
+        ev_i = np.zeros((1, T, I), np.int32)
+        for name, lane in self.f_lanes.items():
+            col = chunk.columns[name]
+            if col.dtype == object:
+                if any(v is None for v in col):
+                    raise SiddhiAppRuntimeException(
+                        "device window path: null FLOAT payloads have no "
+                        "exact lane encoding")
+                col = col.astype(np.float64)
+            ev_f[0, :, lane] = np.asarray(col, np.float32)
+        for name, lanes in self.i_lanes.items():
+            col = chunk.columns[name]
+            if name in self.str_attrs:
+                ev_i[0, :, lanes[0]] = [self._code(name, v) for v in col]
+            elif len(lanes) == 2:
+                v = np.asarray([0 if x is None else int(x) for x in col],
+                               np.int64)
+                none = np.asarray([x is None for x in col], bool)
+                hi = np.floor_divide(v, LONG_BASE)
+                lo = (v - hi * LONG_BASE).astype(np.int64)
+                hi = np.where(none, np.int64(INT_NONE), hi)
+                ev_i[0, :, lanes[0]] = hi.astype(np.int32)
+                ev_i[0, :, lanes[1]] = lo.astype(np.int32)
+            else:
+                vals = [INT_NONE if x is None else np.int32(x)
+                        for x in col]
+                if any(x is not None and np.int32(x) == INT_NONE
+                       for x in col):
+                    raise SiddhiAppRuntimeException(
+                        "device window path: INT value -2^31 collides "
+                        "with the null sentinel lane encoding")
+                ev_i[0, :, lanes[0]] = vals
+        if self.kind == "externalTimeBatch":
+            # batch CURRENT rows keep their ORIGINAL arrival timestamps
+            arr = np.asarray(chunk.timestamps, np.int64)
+            hi = np.floor_divide(arr, LONG_BASE)
+            lo = arr - hi * LONG_BASE
+            ev_i[0, :, self._arr_lanes[0]] = hi.astype(np.int32)
+            ev_i[0, :, self._arr_lanes[1]] = lo.astype(np.int32)
+        ts_off = self._offsets(ring_ts64)
+        return ev_f, ev_i, ts_off.reshape(1, T)
+
+    # ------------------------------------------------------------ decode
+
+    def _rows_to_chunk(self, rows_f: np.ndarray, rows_i: np.ndarray,
+                      ts: np.ndarray, types_val: int) -> EventChunk:
+        n = len(ts)
+        cols: Dict[str, np.ndarray] = {}
+        for name in self.names:
+            t = self.attr_types[name]
+            if name in self.f_lanes:
+                cols[name] = rows_f[:, self.f_lanes[name]].astype(
+                    dtype_for(t))
+            elif name in self.str_attrs:
+                _enc, dec = self.str_attrs[name]
+                codes = rows_i[:, self.i_lanes[name][0]]
+                out = np.full(n, None, object)
+                ok = codes >= 1
+                if ok.any():
+                    d = np.asarray(dec, object)
+                    out[ok] = d[codes[ok] - 1]
+                cols[name] = out
+            else:
+                lanes = self.i_lanes[name]
+                if len(lanes) == 2:
+                    hi = rows_i[:, lanes[0]].astype(np.int64)
+                    lo = rows_i[:, lanes[1]].astype(np.int64)
+                    v = hi * LONG_BASE + lo
+                    none = rows_i[:, lanes[0]] == INT_NONE
+                else:
+                    v = rows_i[:, lanes[0]].astype(np.int64)
+                    none = rows_i[:, lanes[0]] == INT_NONE
+                if none.any():
+                    out = np.full(n, None, object)
+                    if t == AttrType.BOOL:
+                        out[~none] = v[~none].astype(bool)
+                    else:
+                        out[~none] = v[~none].astype(dtype_for(t))
+                    cols[name] = out
+                elif t == AttrType.BOOL:
+                    cols[name] = v.astype(bool)
+                else:
+                    cols[name] = v.astype(dtype_for(t))
+        return EventChunk(self.names, np.asarray(ts, np.int64),
+                          np.full(n, types_val, np.int8), cols)
+
+    # ------------------------------------------------------------ step
+
+    def _run_step(self, chunk: Optional[EventChunk], now_val: int,
+                  directive: Optional[np.ndarray], n_done: int = 0):
+        """Dispatch one kernel step (chunk may be None for timer steps);
+        returns decoded egress (rows split into parts) after handling ring
+        growth (grow-and-replay)."""
+        self._ensure_carry()
+        if chunk is not None and not chunk.is_empty:
+            if self.ts_expr is not None:
+                from .expr_compiler import EvalCtx
+                ctx = EvalCtx(chunk.columns, chunk.timestamps, len(chunk))
+                ring_ts = np.asarray(self.ts_expr.fn(ctx), np.int64)
+            else:
+                ring_ts = np.asarray(chunk.timestamps, np.int64)
+            T = len(chunk)
+            ev_f, ev_i, ts_off = self._encode_chunk(chunk, ring_ts)
+            valid = np.ones((1, T), bool)
+        else:
+            T = 1
+            F, I = max(self.n_f, 1), max(self.n_i, 1)
+            ev_f = np.zeros((1, 1, F), np.float32)
+            ev_i = np.zeros((1, 1, I), np.int32)
+            ts_off = np.zeros((1, 1), np.int32)
+            valid = np.zeros((1, 1), bool)
+            ring_ts = np.zeros(0, np.int64)
+        if self.kind in _BATCH_KINDS:
+            now_arr = np.asarray([n_done], np.int32)
+        else:
+            now_arr = np.asarray(
+                [self._offsets(np.asarray([now_val], np.int64))[0]
+                 if self._base is not None or chunk is not None
+                 else 0], np.int32)
+        if directive is None:
+            directive = np.zeros((1, T), np.int32)
+        # grow the ring pre-emptively when the chunk alone could overflow
+        while self._fill_host + T > self.capacity:
+            self._grow(self.capacity * 2)
+        while True:
+            pre = dict(self.carry)
+            cap = 2 * self.capacity + T
+            step = self._step_for(T)
+            self.carry, buf = step(self.carry, jnp.asarray(ev_f),
+                                   jnp.asarray(ev_i), jnp.asarray(ts_off),
+                                   jnp.asarray(valid),
+                                   jnp.asarray(now_arr),
+                                   jnp.asarray(directive), cap)
+            buf = np.asarray(buf)
+            tail = buf[-1]
+            if int(tail[4]) == 0:         # no overflow
+                break
+            self.carry = pre
+            self._grow(self.capacity * 2)
+        count = int(tail[0])
+        self._fill_host = int(tail[1])
+        self._exp_fill_host = int(tail[2])
+        rows = buf[:count]
+        F = max(self.n_f, 1)
+        rows_f = rows[:, 4:4 + F].view(np.float32)
+        rows_i = rows[:, 4 + F:]
+        return (rows[:, 0], rows[:, 1], rows[:, 2], rows[:, 3],
+                rows_f, rows_i, int(tail[3]))
+
+    def _grow(self, new_cap: int):
+        c = {k: np.asarray(v) for k, v in self.carry.items()}
+        pad = new_cap - self.capacity
+        for k in ("ring_f", "ring_i", "exp_f", "exp_i"):
+            if k in c:
+                c[k] = np.concatenate(
+                    [c[k], np.zeros((1, pad) + c[k].shape[2:],
+                                    c[k].dtype)], axis=1)
+        for k in ("ring_ts", "exp_ts"):
+            if k in c:
+                c[k] = np.concatenate(
+                    [c[k], np.full((1, pad), TS_NONE, np.int32)], axis=1)
+        self.carry = {k: jnp.asarray(v) for k, v in c.items()}
+        self.capacity = new_cap
+
+    # ------------------------------------------------------------ emission
+
+    def on_data(self, chunk: EventChunk):
+        now = int(chunk.timestamps[-1])
+        fill_pre = self._fill_host
+        if self.kind in ("time", "delay", "timeLength"):
+            self.app_ctx.scheduler.notify_at(now + self.window_ms,
+                                             self._on_timer)
+        if self.kind in _BATCH_KINDS:
+            self._batch_step(chunk, now)
+            return
+        (_idx, evt, cause, ts_off, rf, ri, _mn) = self._run_step(
+            chunk, now, None)
+        base = self._base or 0
+        if self.kind == "length":
+            exp_ts = chunk.timestamps[np.minimum(evt, len(chunk) - 1)]
+            expired = self._rows_to_chunk(rf, ri, exp_ts, EXPIRED)
+            c0 = max(0, self.length - fill_pre)
+            self.send_next(_interleave(expired, chunk.with_types(CURRENT),
+                                       c0))
+        elif self.kind == "time":
+            expired = self._rows_to_chunk(
+                rf, ri, ts_off.astype(np.int64) + base + self.window_ms,
+                EXPIRED)
+            out = chunk.with_types(CURRENT)
+            if len(expired):
+                out = EventChunk.concat([expired, out])
+            self.send_next(out)
+        elif self.kind == "delay":
+            if len(rf):
+                self.send_next(self._rows_to_chunk(
+                    rf, ri, ts_off.astype(np.int64) + base, CURRENT))
+        elif self.kind == "externalTime":
+            from .expr_compiler import EvalCtx
+            ctx = EvalCtx(chunk.columns, chunk.timestamps, len(chunk))
+            etimes = np.asarray(self.ts_expr.fn(ctx), np.int64)
+            cur = chunk.with_timestamps(etimes).with_types(CURRENT)
+            outs = []
+            for i in range(len(chunk)):
+                sel = evt == i
+                if sel.any():
+                    outs.append(self._rows_to_chunk(
+                        rf[sel], ri[sel],
+                        np.full(int(sel.sum()), etimes[i], np.int64),
+                        EXPIRED))
+                outs.append(cur.slice(i, i + 1))
+            self.send_next(EventChunk.concat(outs))
+        else:                            # timeLength
+            outs = []
+            nv = len(chunk)
+            for i in range(nv):
+                sel = evt == i
+                if sel.any():
+                    out_ts = np.where(
+                        cause[sel] == C_TIME,
+                        ts_off[sel].astype(np.int64) + base +
+                        self.window_ms,
+                        int(chunk.timestamps[i]))
+                    outs.append(self._rows_to_chunk(rf[sel], ri[sel],
+                                                    out_ts, EXPIRED))
+                outs.append(chunk.slice(i, i + 1).with_types(CURRENT))
+            self.send_next(EventChunk.concat(outs))
+
+    def _batch_step(self, chunk: EventChunk, now: int):
+        T = len(chunk)
+        flush_ts: List[int] = []
+        directive = None
+        n_done = 0
+        if self.kind == "lengthBatch":
+            total = self._fill_host + T
+            n_done = total // self.length
+        elif self.kind == "timeBatch":
+            if self.next_emit is None:
+                base = self.start_time if self.start_time is not None \
+                    else int(chunk.timestamps[0])
+                self.next_emit = base + self.window_ms
+                self.app_ctx.scheduler.notify_at(self.next_emit,
+                                                 self._on_timer)
+            while now >= self.next_emit:
+                flush_ts.append(self.next_emit)
+                self.next_emit += self.window_ms
+            n_done = len(flush_ts)
+            directive = np.full((1, T), n_done, np.int32)
+        elif self.kind == "externalTimeBatch":
+            from .expr_compiler import EvalCtx
+            ctx = EvalCtx(chunk.columns, chunk.timestamps, len(chunk))
+            etimes = np.asarray(self.ts_expr.fn(ctx), np.int64)
+            directive = np.zeros((1, T), np.int32)
+            for i in range(T):
+                t = int(etimes[i])
+                if self.window_end is None:
+                    b = self.start_time if self.start_time is not None \
+                        else t
+                    self.window_end = b + self.window_ms
+                while t >= self.window_end:
+                    flush_ts.append(self.window_end)
+                    self.window_end += self.window_ms
+                directive[0, i] = len(flush_ts)
+            n_done = len(flush_ts)
+        else:                            # batch()
+            n_done = 1
+            flush_ts = [now]
+
+        exp_fill_pre = self._exp_fill_host
+        (_idx, evt, cause, ts_off, rf, ri, _mn) = self._run_step(
+            chunk, now, directive, n_done=n_done)
+        base = self._base or 0
+
+        if self.kind == "lengthBatch":
+            # flush ts = each batch's last member arrival ts
+            for f in range(n_done):
+                sel = (cause == C_BATCH) & (evt == f)
+                flush_ts.append(int(ts_off[sel][-1]) + base)
+        self._emit_flushes(n_done, flush_ts, evt, cause, ts_off, rf, ri,
+                           exp_fill_pre)
+
+    def _emit_flushes(self, n_done, flush_ts, evt, cause, ts_off, rf, ri,
+                      exp_fill_pre):
+        base = self._base or 0
+        exp_sel = cause == C_EXPBATCH
+        state = None                   # (rf, ri) of the pending expired set
+        if exp_fill_pre or exp_sel.any():
+            state = (rf[exp_sel], ri[exp_sel])
+        for f in range(n_done):
+            sel = (cause == C_BATCH) & (evt == f)
+            members = (rf[sel], ri[sel]) if sel.any() else None
+            outs = []
+            ts_f = flush_ts[f]
+            if state is not None and len(state[0]):
+                outs.append(self._rows_to_chunk(
+                    state[0], state[1],
+                    np.full(len(state[0]), ts_f, np.int64), EXPIRED))
+            if members is not None:
+                if self.kind == "externalTimeBatch":
+                    hi = members[1][:, self._arr_lanes[0]].astype(np.int64)
+                    lo = members[1][:, self._arr_lanes[1]].astype(np.int64)
+                    mts = hi * LONG_BASE + lo
+                else:
+                    mts = ts_off[sel].astype(np.int64) + base
+                cur = self._rows_to_chunk(members[0], members[1], mts,
+                                          CURRENT)
+                outs.append(_reset_row(cur, ts_f))
+                outs.append(cur)
+            if self.kind == "timeBatch":
+                state = members            # even when empty
+            elif members is not None:
+                state = members            # lengthBatch / extTimeBatch /
+                #                            batch: only non-empty batches
+            if len(outs) > 1 or (outs and len(outs[0])):
+                out = EventChunk.concat(
+                    [o for o in outs if len(o)]) if len(outs) > 1 \
+                    else outs[0]
+                out.is_batch = True
+                self.send_next(out)
+
+    # ------------------------------------------------------------ timers
+
+    def _on_timer(self, now: int):
+        def run():
+            self.on_timer_event(now)
+            if self.kind == "timeBatch":
+                if self.next_emit is not None:
+                    self.app_ctx.scheduler.notify_at(self.next_emit,
+                                                     self._on_timer)
+            elif self._fill_host:
+                mn = self._last_min_live
+                if mn is not None:
+                    self.app_ctx.scheduler.notify_at(
+                        mn + self.window_ms, self._on_timer)
+        self._locked(run)
+
+    _last_min_live: Optional[int] = None
+
+    def on_timer_event(self, ts: int):
+        if self.kind in ("length", "lengthBatch", "batch",
+                         "externalTime", "externalTimeBatch"):
+            return
+        if self.kind == "timeBatch":
+            if self.next_emit is None:
+                return
+            flush_ts = []
+            while ts >= self.next_emit:
+                flush_ts.append(self.next_emit)
+                self.next_emit += self.window_ms
+            n_done = len(flush_ts)
+            if n_done == 0:
+                return
+            exp_fill_pre = self._exp_fill_host
+            (_i, evt, cause, ts_off, rf, ri, _mn) = self._run_step(
+                None, ts, None, n_done=n_done)
+            self._emit_flushes(n_done, flush_ts, evt, cause, ts_off,
+                               rf, ri, exp_fill_pre)
+            return
+        if self._fill_host == 0:
+            return
+        (_i, evt, cause, ts_off, rf, ri, mn) = self._run_step(None, ts,
+                                                              None)
+        base = self._base or 0
+        self._last_min_live = mn + base if mn != int(TS_NONE) else None
+        if not len(rf):
+            return
+        if self.kind == "delay":
+            self.send_next(self._rows_to_chunk(
+                rf, ri, ts_off.astype(np.int64) + base, CURRENT))
+        else:                            # time / timeLength
+            self.send_next(self._rows_to_chunk(
+                rf, ri, ts_off.astype(np.int64) + base + self.window_ms,
+                EXPIRED))
+
+    # ------------------------------------------------------------ find/state
+
+    def find_chunk(self) -> Optional[EventChunk]:
+        """Materialize the device ring for join probes / store queries —
+        rare control-plane reads, so a full D2H here is fine."""
+        self._ensure_carry()
+        fill = self._fill_host
+        if fill == 0:
+            return None
+        rf = np.asarray(self.carry["ring_f"])[0, :fill]
+        ri = np.asarray(self.carry["ring_i"])[0, :fill]
+        ts = np.asarray(self.carry["ring_ts"])[0, :fill].astype(np.int64) \
+            + (self._base or 0)
+        return self._rows_to_chunk(rf, ri, ts, CURRENT)
+
+    def current_state(self):
+        self._ensure_carry()
+        return {"dwin": {k: np.asarray(v) for k, v in self.carry.items()},
+                "base": self._base, "capacity": self.capacity,
+                "fill": self._fill_host, "exp_fill": self._exp_fill_host,
+                "next_emit": self.next_emit,
+                "window_end": self.window_end,
+                "strs": {a: list(dec) for a, (_e, dec)
+                         in self.str_attrs.items()}}
+
+    def restore_state(self, state):
+        if "dwin" not in state:           # snapshot from a host window
+            raise SiddhiAppRuntimeException(
+                "device window path: snapshot was taken by the host "
+                "window processor")
+        self.capacity = state["capacity"]
+        self._steps = {}
+        self.carry = {k: jnp.asarray(v) for k, v in state["dwin"].items()}
+        self._base = state["base"]
+        self._fill_host = state["fill"]
+        self._exp_fill_host = state["exp_fill"]
+        self.next_emit = state["next_emit"]
+        self.window_end = state["window_end"]
+        for a, dec in state["strs"].items():
+            self.str_attrs[a] = ({v: i + 1 for i, v in enumerate(dec)},
+                                 list(dec))

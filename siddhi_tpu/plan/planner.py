@@ -395,9 +395,9 @@ class DevicePatternRuntime:
             # snapshot's key→lane map; dropping it would hand restored
             # lanes of one key to fresh keys
             self.key_lanes = dict(state.get("key_lanes") or {})
-        self.key_lanes = dict(state["key_lanes"])
-        # force the overflow guard to re-sync against the restored carry
-        self._ub_active = self.nfa.spec.n_slots
+            # force the overflow guard to re-sync against the restored
+            # carry
+            self._ub_active = self.nfa.spec.n_slots
         self._dropped_seen = int(
             np.asarray(self.nfa.carry["dropped"]).sum())
         if self.nfa.has_absent:

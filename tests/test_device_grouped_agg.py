@@ -221,15 +221,20 @@ def test_oversized_int_value_is_data_error():
 
 
 def test_device_rejects_unsupported_to_host():
-    """stdDev / having / lengthBatch fall back with recorded reasons."""
+    """stdDev / having fall back from the grouped-agg kernel with
+    recorded reasons.  (lengthBatch used to be in this list; batch
+    windows now run on the device window path, plan/dwin_compiler.)"""
     for frag in ("select sym, stdDev(price) as s group by sym",
-                 "select sym, sum(price) as t group by sym having t > 10.0",
-                 "#window.lengthBatch(3) select sum(price) as t"):
+                 "select sym, sum(price) as t group by sym having t > 10.0"):
         app = STREAM + f"@info(name='q') from S{'' if frag.startswith('s') else ''}" \
             + ("" if frag.startswith("#") else " ") + frag + \
             " insert into Out;"
         dev_hit, _ = run_app(app, _rows(n=10))
         assert not dev_hit, frag
+    app = STREAM + "@info(name='q') from S#window.lengthBatch(3) " \
+        "select sum(price) as t insert into Out;"
+    dev_hit, _ = run_app(app, _rows(n=10))
+    assert dev_hit, "lengthBatch should ride the device window path"
 
 
 def test_int_minmax_only_has_no_count_bound():

@@ -416,13 +416,20 @@ def test_window_agg_query_compiles_to_device():
     rt = m.create_siddhi_app_runtime(app)
     assert rt.query_runtimes["q"].backend == "device"
     rt.shutdown()
-    # unsupported window kinds still fall back with a reason
+    # batch window kinds route to the device window path (round 4:
+    # plan/dwin_compiler — window state on device, selector host)
     m2 = SiddhiManager()
     rt2 = m2.create_siddhi_app_runtime(app.replace("window.length(3)",
                                                    "window.lengthBatch(3)"))
-    assert rt2.query_runtimes["q"].backend == "host"
-    assert "lengthBatch" in (rt2.query_runtimes["q"].backend_reason or "")
+    assert rt2.query_runtimes["q"].backend == "device"
+    assert "dwin" in (rt2.query_runtimes["q"].backend_reason or "")
     rt2.shutdown()
+    # genuinely unsupported window kinds still fall back with a reason
+    m3 = SiddhiManager()
+    rt3 = m3.create_siddhi_app_runtime(app.replace(
+        "window.length(3)", "window.sort(3, v)"))
+    assert rt3.query_runtimes["q"].backend == "host"
+    rt3.shutdown()
 
 
 def test_slot_overflow_grow_and_replay_exact():
