@@ -376,10 +376,8 @@ class SiddhiAppRuntime:
         for _ in range(max(len(self.junctions), 1)):
             for j in self.junctions.values():
                 j.flush()
-            if not any(j.is_async and j._queue is not None and
-                       not j._queue.empty()
-                       for j in self.junctions.values()):
-                break       # quiescent: nothing cascaded into a queue
+            if all(j.quiescent for j in self.junctions.values()):
+                break       # nothing queued, no delivery in flight
 
     def shutdown(self):
         dbg = getattr(self.app_ctx, "debugger", None)

@@ -119,7 +119,15 @@ class StreamJunction:
         self._worker_threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self._drain = threading.Event()
+        self._in_flight = 0          # chunks popped but not yet delivered
         self._configure_from_annotations()
+
+    @property
+    def quiescent(self) -> bool:
+        """No queued chunks and no delivery in flight (async mode)."""
+        if not self.is_async or self._queue is None:
+            return True
+        return self._queue.empty() and self._in_flight == 0
 
     def _configure_from_annotations(self):
         ann = find_annotation(self.definition.annotations, "async")
@@ -179,9 +187,13 @@ class StreamJunction:
                 if self._drain.is_set():
                     break       # drained: queue empty after drain request
                 continue
+            self._in_flight += 1
             if isinstance(item, _FlushBarrier):
                 delivered = False
-                item.arrive(self._flush_receivers)
+                try:
+                    item.arrive(self._flush_receivers)
+                finally:
+                    self._in_flight -= 1
                 continue
             batch = [item]
             n = len(item)
@@ -197,11 +209,14 @@ class StreamJunction:
                 batch.append(nxt)
                 n += len(nxt)
             merged = EventChunk.concat(batch) if len(batch) > 1 else batch[0]
-            self._deliver(merged)
-            delivered = True
-            if barrier is not None:
-                delivered = False
-                barrier.arrive(self._flush_receivers)
+            try:
+                self._deliver(merged)
+                delivered = True
+                if barrier is not None:
+                    delivered = False
+                    barrier.arrive(self._flush_receivers)
+            finally:
+                self._in_flight -= 1
         if delivered:
             self._flush_receivers()
 

@@ -90,6 +90,17 @@ class NfaSpec(NamedTuple):
     #                                   there (captures intact) instead of
     #                                   dying — `A -> every B` semantics
     #                                   (StateInputStreamParser.java:272-273)
+    mid_every: Tuple[Tuple[int, int], ...] = ()
+    #                                   mid-chain `every` groups (g0, g1):
+    #                                   a partial advancing OUT of g1 forks
+    #                                   a clone that re-arms at g0 with its
+    #                                   pre-group captures while the
+    #                                   original advances (the reference's
+    #                                   addEveryState clone,
+    #                                   StreamPostStateProcessor.java:66-68)
+    eps_start: bool = False           # leading min-0 kleene: unit 1 is an
+    #                                   alternate start state (empty-kleene
+    #                                   path), see _one_partition_step
 
     @property
     def n_states(self) -> int:
@@ -185,6 +196,36 @@ class _StepState:
         # clear group rows in the live slot after the match is recorded
         R, C = self.caps.shape[1], self.caps.shape[2]
         self.m_caps = jnp.zeros((K, R, C), jnp.float32)
+        # mid-chain `every` clone requests collected during land():
+        # group start → (source mask, source rank by pre-land (enter, seq))
+        self.spawn: Dict[int, Tuple[jnp.ndarray, jnp.ndarray]] = {}
+
+    def _pending_rank(self, pred):
+        """Rank `pred` slots by their pending-list order (enter, seq) —
+        the oracle's append order for re-arm clones and fork clones."""
+        e, sq = self.enter, self.seq
+        less = (e[None, :] < e[:, None]) | \
+            ((e[None, :] == e[:, None]) & (sq[None, :] < sq[:, None]))
+        return jnp.sum(pred[None, :] & less, axis=1)
+
+    def _clear_group_logical_rows(self, caps, sel_or_range, g0, g1):
+        """Zero the logical-side capture rows of units[g0..g1] — the
+        oracle's re-arm/fork clone clears LOGICAL sides (addEveryState);
+        simple rows are overwritten on the next match and stay.
+        sel_or_range: [K] bool (applied per-slot) or None (whole array)."""
+        spec = self.spec
+        log_rows = [r for u in spec.units[g0:g1 + 1]
+                    for r in (u.row_a, u.row_b)
+                    if u.kind == "logical" and r >= 0]
+        if not log_rows:
+            return caps
+        R = caps.shape[-2]
+        rm = np.zeros((R,), bool)
+        rm[log_rows] = True
+        mask = jnp.asarray(rm)[None, :, None]
+        if sel_or_range is not None:
+            mask = sel_or_range[:, None, None] & mask
+        return jnp.where(mask, jnp.float32(0), caps)
 
     def land(self, pred, j_from: int, base_ts, fwd_cnt=None, fwd_dead=None):
         """Advance `pred` slots out of unit j_from at time base_ts.
@@ -193,6 +234,19 @@ class _StepState:
         fwd_dead).  base_ts may be scalar (event ts) or [K] (deadlines)."""
         spec = self.spec
         t, live0, completed = _land_static(spec, j_from)
+        for g0, g1 in spec.mid_every:
+            if j_from == g1:
+                # fork request: rank sources by pre-land pending order so
+                # the clones append in oracle order (see alloc_clones)
+                rank = self._pending_rank(pred)
+                old_m, old_r = self.spawn.get(g0, (None, None))
+                if old_m is not None:       # a second land on the same g1
+                    rank = rank + jnp.sum(old_m.astype(jnp.int32))
+                    pred_all = old_m | pred
+                    rank = jnp.where(pred, rank, old_r)
+                    self.spawn[g0] = (pred_all, rank)
+                else:
+                    self.spawn[g0] = (pred, rank)
         if completed:
             self.m_mask = self.m_mask | pred
             self.m_ts = jnp.where(pred, base_ts, self.m_ts)
@@ -214,30 +268,15 @@ class _StepState:
                 # emission order, so future same-ts ties must rank them
                 # after older entries and in their prior pending order:
                 # fresh seq = counter + rank by prior (enter, seq)
-                e, sq = self.enter, self.seq
-                less = (e[None, :] < e[:, None]) | \
-                    ((e[None, :] == e[:, None]) & (sq[None, :] < sq[:, None]))
-                rank = jnp.sum(pred[None, :] & less, axis=1)
+                rank = self._pending_rank(pred)
                 self.seq = jnp.where(pred, self.arm_seq + rank, self.seq)
                 self.arm_seq = self.arm_seq + \
                     jnp.sum(pred.astype(jnp.int32))
                 self.enter = jnp.where(pred, base_ts, self.enter)
                 if self.lmask is not None:
                     self.lmask = jnp.where(pred, 0, self.lmask)
-                # the oracle's re-arm clone clears LOGICAL side captures
-                # (StateUnit.add_every_state; reference
-                # LogicalPreStateProcessor.addEveryState) — simple rows are
-                # overwritten on the next match and stay
-                group_log_rows = [r for u in spec.units[te:]
-                                  for r in (u.row_a, u.row_b)
-                                  if u.kind == "logical" and r >= 0]
-                if group_log_rows:
-                    R = self.caps.shape[1]
-                    rm = np.zeros((R,), bool)
-                    rm[group_log_rows] = True
-                    sel = pred[:, None, None] & \
-                        jnp.asarray(rm)[None, :, None]
-                    self.caps = jnp.where(sel, jnp.float32(0), self.caps)
+                self.caps = self._clear_group_logical_rows(
+                    self.caps, pred, te, len(spec.units) - 1)
                 # count units are compile-rejected alongside trailing
                 # every; pre-group absent deadlines are never revisited
             else:
@@ -304,6 +343,41 @@ class _StepState:
         self.caps = jnp.where(pred[:, None, None],
                               jnp.float32(0), self.caps)
 
+    def alloc_clones(self, g0: int, spawn, rank, ts):
+        """Fork mid-chain `every` clones: for each source slot in `spawn`,
+        place a new partial at unit g0 carrying the source's captures
+        (group-side logical rows cleared — the oracle's addEveryState
+        clone) and chain-start timestamp (within runs from the original
+        first event).  Sources ranked by pre-land pending order fill free
+        slots in that order; unplaceable clones count as drops (the
+        engine's grow-and-replay reruns the chunk on a bigger ring)."""
+        spec = self.spec
+        K = spawn.shape[0]
+        n_spawn = jnp.sum(spawn.astype(jnp.int32))
+        free = (self.st < 0) & ~self.m_mask
+        free_rank = jnp.cumsum(free.astype(jnp.int32)) - 1
+        by_rank = jnp.zeros((K,), jnp.int32).at[
+            jnp.where(spawn, rank, K)].set(jnp.arange(K, dtype=jnp.int32),
+                                           mode="drop")
+        src = by_rank[jnp.clip(free_rank, 0, K - 1)]
+        fill = free & (free_rank < n_spawn)
+        self.st = jnp.where(fill, g0, self.st)
+        self.start = jnp.where(fill, self.start[src], self.start)
+        caps_src = self.caps[src]
+        g1 = next(g1 for (s0, g1) in spec.mid_every if s0 == g0)
+        caps_src = self._clear_group_logical_rows(caps_src, None, g0, g1)
+        self.caps = jnp.where(fill[:, None, None], caps_src, self.caps)
+        self.enter = jnp.where(fill, ts, self.enter)
+        self.seq = jnp.where(fill, self.arm_seq + free_rank, self.seq)
+        self.arm_seq = self.arm_seq + n_spawn
+        self.dropped = self.dropped + \
+            jnp.maximum(n_spawn - jnp.sum(free.astype(jnp.int32)), 0)
+        if self.lmask is not None:
+            self.lmask = jnp.where(fill, 0, self.lmask)
+        if self.cnt_cur is not None:
+            self.cnt_cur = jnp.where(fill, 0, self.cnt_cur)
+            self.cnt_prev = jnp.where(fill, -1, self.cnt_prev)
+
 
 def _one_partition_step(spec: NfaSpec, carry: Dict, event):
     """Step one partition's slot ring over one event.
@@ -325,7 +399,71 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
     # kleene start never expires, only later units enforce `within`)
     if spec.within_ms is not None:
         expired = (s.st >= 1) & (ts - s.start > spec.within_ms)
+        if spec.eps_start:
+            # the empty-kleene start partial (leading min-0) sits at unit
+            # 1 but IS a start-state partial — exempt
+            expired = expired & ~((s.st == 1) & (s.cnt_prev == 0))
         s.st = jnp.where(expired, -1, s.st)
+
+    # ---- SEQUENCE early deadline pass: the playback scheduler fires a
+    # deadline that coincides with (or precedes) an event's timestamp
+    # BEFORE that event stabilizes the sequence — a due `not … for t`
+    # confirms the absence even though the arriving event would clear the
+    # pending list (see the stabilize barrier below); fired slots advance
+    # and may consume THIS event at their new unit
+    if spec.is_sequence and _has(spec, "absent"):
+        for j, u in enumerate(spec.units):
+            if u.kind != "absent":
+                continue
+            fire = valid & (s.st == j) & (s.deadline <= ts)
+            s.land(fire, j, s.deadline)
+
+    # ---- SEQUENCE stabilize barrier for absent units: the oracle clears
+    # every unit's pending list BEFORE each real event (stabilizeStates →
+    # resetState), so a partial waiting at a `not … for t` unit survives
+    # only an event-free gap — any arriving event (even a non-matching
+    # one) breaks the sequence before the deadline could fire.  Timer
+    # rows (stream -2) do not stabilize.
+    if spec.is_sequence and _has(spec, "absent"):
+        absent_u = np.asarray([u.kind == "absent" for u in spec.units] +
+                              [False], bool)
+        at_absent = jnp.asarray(absent_u)[jnp.clip(s.st, 0, S)]
+        kill0 = valid & (stream != -2) & (s.st >= 0) & at_absent
+        s.st = jnp.where(kill0, -1, s.st)
+
+    # ---- leading min-0 kleene: the start partial lives at unit 1 with an
+    # empty, live-appending kleene chain (the reference parks the shared
+    # StateEvent in BOTH the count's and the successor's pending lists —
+    # epsilon closure at arm time).  Ensure exactly one such virgin
+    # (cnt_prev == 0) exists; re-created here after the previous one
+    # advanced (every mode) — eligible from this event on
+    if spec.eps_start:
+        # exactly one start chain: unit 1 is only ever occupied by the
+        # shared start StateEvent (virgin, accumulating, or frozen at
+        # max) — the reference start partial sits in BOTH the count's and
+        # the successor's pending lists, never duplicated; re-init only
+        # after it advances out
+        have = jnp.any(s.st == 1)
+        want = valid & ~have
+        if spec.arm_once:
+            want = want & (s.armed_total == 0)
+        freev = (s.st < 0) & ~s.m_mask
+        armed_v = (want & jnp.any(freev)) & \
+            (jnp.arange(K) == jnp.argmax(freev))
+        s.clear_slot(armed_v)
+        s.st = jnp.where(armed_v, 1, s.st)
+        s.cnt_cur = jnp.where(armed_v, 0, s.cnt_cur)
+        s.cnt_prev = jnp.where(armed_v, 0, s.cnt_prev)
+        s.start = jnp.where(armed_v, ts, s.start)
+        s.enter = jnp.where(armed_v, ts, s.enter)
+        s.seq = jnp.where(armed_v, s.arm_seq, s.seq)
+        s.arm_seq = s.arm_seq + jnp.where(jnp.any(armed_v), 1, 0)
+        if s.lmask is not None:
+            s.lmask = jnp.where(armed_v, 0, s.lmask)
+        if spec.arm_once:
+            s.armed_total = s.armed_total + \
+                jnp.where(want & jnp.any(freev), 1, 0)
+        s.dropped = s.dropped + jnp.where(want & ~jnp.any(freev), 1, 0)
 
     st_pre = s.st
 
@@ -341,6 +479,11 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
         at = valid & (st_pre == j)
         if u.kind == "simple":
             ok = at & (stream == u.stream_a) & conds[u.cond_a]
+            if spec.eps_start and j == 1:
+                # empty-kleene start partial advancing directly: its
+                # chain-start timestamp is THIS event (a normal arm would
+                # have set start = ts)
+                s.start = jnp.where(ok & (s.cnt_prev == 0), ts, s.start)
             s.write_all(ok, u.row_a, ev_rows)
             s.land(ok, j, ts)
             advanced = advanced | ok
@@ -400,6 +543,10 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
             live = valid & (st_pre == t) & (s.cnt_prev >= 0) & ~advanced
             ok = live & (stream == u.stream_a) & conds[u.cond_a] & \
                 (s.cnt_prev < u.max_count)
+            if spec.eps_start and j == 0:
+                # first append into the leading kleene: the chain starts
+                # here (within runs from the first captured event)
+                s.start = jnp.where(ok & (s.cnt_prev == 0), ts, s.start)
             c2 = s.cnt_prev + 1
             s.write_count(ok & (s.cnt_prev == 0), ok, u.row_a, ev_rows, c2)
             s.cnt_prev = jnp.where(ok, c2, s.cnt_prev)
@@ -460,6 +607,8 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
         else:
             arm_state = jnp.int32(t)
             arm_cnt_prev = jnp.int32(0 if _live0 else -1)
+    elif u0.kind == "count" and spec.eps_start:
+        pass        # leading min-0: arming is the ensure-virgin block above
     elif u0.kind == "count":
         c0 = valid & (stream == u0.stream_a) & conds[u0.cond_a][0]
         arm = c0
@@ -552,6 +701,13 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
         if t0 < S and units[t0].kind == "absent":
             s.deadline = jnp.where(live_arm & (s.st == t0),
                                    ts + units[t0].waiting_ms, s.deadline)
+
+    # ---- mid-chain `every` clone allocation (requests collected by
+    # land() during the unit loop; placed after arming so pending-list
+    # append order matches the oracle: armed partial first, clones after)
+    for g0 in sorted(s.spawn):
+        spm, rk = s.spawn[g0]
+        s.alloc_clones(g0, spm, rk, ts)
 
     # ---- absent deadline pass: virtual time has reached ts, so every due
     # `not … for t` deadline fires now — AFTER the event was processed (the

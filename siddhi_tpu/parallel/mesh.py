@@ -58,7 +58,7 @@ def round_up_partitions(n_partitions: int, mesh: Optional[Mesh]) -> int:
 
 
 def jit_engine_step(spec: NfaSpec, mesh: Mesh, axis: str = "p",
-                    stats: bool = False):
+                    stats: bool = False, donate: bool = True):
     """jit of the raw NFA block step (ops/nfa.build_block_step) with the
     partition axis of carry, event block and match outputs sharded over
     `mesh` — the engine-integrated sharded hot path.  Partition lanes are
@@ -90,7 +90,7 @@ def jit_engine_step(spec: NfaSpec, mesh: Mesh, axis: str = "p",
     if not stats:
         return jax.jit(step, in_shardings=(carry_sh, block_sh),
                        out_shardings=(carry_sh, matches_sh),
-                       donate_argnums=0)
+                       donate_argnums=(0,) if donate else ())
     replicated = NamedSharding(mesh, P())
     stats_sh = {"matches": replicated, "dropped": replicated}
     return jax.jit(stepped, in_shardings=(carry_sh, block_sh),

@@ -315,6 +315,11 @@ class DeviceWindowProcessor(WindowProcessor):
             ring_ts = np.zeros(0, np.int64)
         if self.kind in _BATCH_KINDS:
             now_arr = np.asarray([n_done], np.int32)
+        elif self.kind == "externalTime":
+            # driven purely by event time — the kernel never reads `now`,
+            # and routing the ARRIVAL clock through _offsets would rebase
+            # the external-time base (different scale → ring corruption)
+            now_arr = np.zeros(1, np.int32)
         else:
             now_arr = np.asarray(
                 [self._offsets(np.asarray([now_val], np.int64))[0]

@@ -121,11 +121,28 @@ class _Lowering:
         if elements and isinstance(elements[-1], EveryStateElement):
             tail = elements[-1]
             elements = elements[:-1]
+        self.mid_every: List[Tuple[int, int]] = []
         for el in elements:
             if isinstance(el, EveryStateElement):
-                _reject("mid-chain `every` forks partials and is host-only "
-                        "(leading and trailing `every` compile)")
-            self._lower_element(el)
+                # mid-chain `every`: a partial leaving the group forks a
+                # clone back to the group start (kernel alloc_clones)
+                if el.within_ms is not None:
+                    _reject("`within` on a mid-chain `every` group is "
+                            "host-only")
+                g0 = len(self.units)
+                for sub in _flatten_next(el.state):
+                    if isinstance(sub, EveryStateElement):
+                        _reject("nested `every` is host-only")
+                    self._lower_element(sub)
+                g1 = len(self.units) - 1
+                for u in self.units[g0:g1 + 1]:
+                    if u.kind not in ("simple", "logical"):
+                        _reject(f"a mid-chain `every` group supports "
+                                f"simple/logical conditions only "
+                                f"(got {u.kind})")
+                self.mid_every.append((g0, g1))
+            else:
+                self._lower_element(el)
         if tail is not None:
             if not self.units:
                 _reject("internal: trailing every with empty prefix")
@@ -212,8 +229,19 @@ class _Lowering:
             _reject("empty pattern")
         if units[0].kind == "absent":
             _reject("leading absent states are host-only")
+        self.eps_start = False
         if units[0].kind == "count" and units[0].min_count == 0:
-            _reject("leading kleene with min 0 is host-only")
+            # leading min-0 kleene: the start partial lives at unit 1 with
+            # an empty live-appending chain (kernel eps_start machinery)
+            if len(units) < 2 or units[1].kind != "simple":
+                _reject("leading min-0 kleene must be followed by a "
+                        "plain condition")
+            if self.tail_every_start in (0, 1) or \
+                    any(g0 <= 1 for g0, _g1 in self.mid_every) or \
+                    (self.is_every and self.every_group_end >= 1):
+                _reject("leading min-0 kleene inside an `every` re-arm "
+                        "group is host-only")
+            self.eps_start = True
         for j in range(len(units) - 1):
             if units[j].kind == "count" and units[j + 1].kind == "count":
                 _reject("consecutive kleene counts are host-only")
@@ -300,19 +328,18 @@ class CompiledPatternNFA:
         low = _Lowering(sis, app)
         self.units = low.units
         self.is_sequence = sis.state_type == StateType.SEQUENCE
+        if low.eps_start and self.is_sequence and low.is_every:
+            # the oracle's shared start partial can sit in the count's
+            # pending list while BLOCKED from the successor's (another
+            # chain occupies it) — a membership split the one-state slot
+            # encoding cannot represent; only reachable with every+SEQ
+            _reject("leading min-0 kleene in an `every` sequence is "
+                    "host-only")
         is_every = low.is_every
         within_ms = sis.within_ms
         if low.group_within is not None:
             within_ms = (low.group_within if within_ms is None
                          else min(within_ms, low.group_within))
-        if self.is_sequence and self.units[0].kind == "count" and \
-                self.units[0].min_count == 0:
-            _reject("leading min-0 kleene in a sequence is host-only")
-        if self.is_sequence and any(u.kind == "absent" for u in self.units):
-            # the oracle's sequence-absent init/reset guards
-            # (AbsentStreamPreStateProcessor + SEQUENCE barriers) are not
-            # yet mirrored on the device — verified divergence
-            _reject("absent states in a sequence are host-only")
 
         # stream codes: order of first appearance
         self.stream_codes: Dict[str, int] = {}
@@ -532,7 +559,9 @@ class CompiledPatternNFA:
             attr_names=tuple(self.attr_names), is_every=is_every,
             is_sequence=self.is_sequence, arm_once=arm_once,
             every_group_end=low.every_group_end,
-            tail_every_start=low.tail_every_start)
+            tail_every_start=low.tail_every_start,
+            mid_every=tuple(low.mid_every),
+            eps_start=low.eps_start)
         self.has_absent = any(u.kind == "absent" for u in self.units)
         from ..parallel.mesh import auto_mesh, round_up_partitions
         self.mesh = auto_mesh() if isinstance(mesh, str) and mesh == "auto" \
@@ -816,6 +845,15 @@ class CompiledPatternNFA:
         from ..parallel.mesh import shard_carry
         return shard_carry(carry, self.mesh)
 
+    @property
+    def replayable(self) -> bool:
+        """True when grow-and-replay is available (the input carry
+        survives the step).  Mid-chain `every` forks clones, so the live
+        partial population has no static per-chunk bound — the mesh
+        path's proactive slot growth cannot guarantee no drops, and the
+        step must stay undonated so overflowing chunks can replay."""
+        return self.mesh is None or bool(self.spec.mid_every)
+
     def _jit_step(self):
         if self.mesh is None:
             # no donation: the engine path replays a chunk from the
@@ -823,7 +861,8 @@ class CompiledPatternNFA:
             # the input carry must survive the step
             return jax.jit(build_block_step(self.spec))
         from ..parallel.mesh import jit_engine_step
-        return jit_engine_step(self.spec, self.mesh)
+        return jit_engine_step(self.spec, self.mesh,
+                               donate=not self.spec.mid_every)
 
     def grow(self, n_partitions: int) -> None:
         """Widen the partition axis (slab growth for keyed partitioning);

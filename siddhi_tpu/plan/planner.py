@@ -289,7 +289,7 @@ class DevicePatternRuntime:
         h = self._inflight.popleft()
         pids, ts, cols = self.nfa.retire_events(h)
         dropped = self.nfa.last_dropped_total
-        if dropped > self._dropped_seen and self.nfa.mesh is None:
+        if dropped > self._dropped_seen and self.nfa.replayable:
             # slot overflow would LOSE matches (the oracle's pending lists
             # never drop): every chunk from this one on ran on a dropping
             # ring — rewind to this chunk's pre-carry, grow, replay all

@@ -232,16 +232,17 @@ def test_partitioned_absent_deadlines_per_key():
 
 # ------------------------------------------------------- sequence mode
 
-def test_sequence_absent_stays_host_and_exact():
-    """SEQUENCE + absent is a recorded device fallback; the oracle still
-    owns the boundary semantics (deadline at the exact next-event ts)."""
+def test_sequence_absent_compiles_to_device_and_exact():
+    """SEQUENCE + absent compiles to the device since round 4 (the
+    stabilize barrier clears absent pendings before every real event);
+    the deadline fires in the event-free gap — device == host."""
     app = "@app:playback " + STREAMS + """
         @info(name='q')
         from e1=A[v > 20.0], not B[w > e1.v] for 1 sec
         select e1.v as v1 insert into Out;
     """
-    b, reason, out = run_app(
+    b, _reason, out = run_app(
         app, [[A(1000, 25.0)], [("advance", 2000)][0:0] or
               [A(2000, 5.0)]], until=3000)
-    assert b == "host" and "absent" in (reason or "")
+    assert b == "device"
     assert out == [(25.0,)]

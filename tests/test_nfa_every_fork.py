@@ -1,0 +1,174 @@
+"""Round-4 NFA algebra: mid-chain `every` (clone forking), leading min-0
+kleene (epsilon start), absent-in-sequence — randomized conformance vs
+the host oracle, including fork floods that stress slot allocation and
+grow-and-replay.  (Reference semantics: StateInputStreamParser.java:272-
+273 every-state clones; CountPreStateProcessor min-0 epsilon;
+AbsentStreamPreStateProcessor in SEQUENCE chains.)"""
+import numpy as np
+import pytest
+
+from siddhi_tpu import QueryCallback, SiddhiManager
+
+
+def run(app, rows, engine=None, expect_backend=None):
+    m = SiddhiManager()
+    pre = "@app:playback " + (f"@app:engine('{engine}') " if engine else "")
+    rt = m.create_siddhi_app_runtime(pre + app)
+    got = []
+    rt.add_callback("q", QueryCallback(
+        lambda ts, cur, exp: got.extend(
+            (ts, tuple(e.data)) for e in (cur or []))))
+    rt.start()
+    h = rt.get_input_handler("A")
+    for row, ts in rows:
+        h.send(row, timestamp=ts)
+    backend = rt.query_runtimes["q"].backend
+    if expect_backend:
+        assert backend == expect_backend, rt.query_runtimes["q"].backend_reason
+    rt.shutdown()
+    return got
+
+
+def parity(app, rows):
+    dev = run(app, rows, expect_backend="device")
+    host = run(app, rows, engine="host", expect_backend="host")
+    assert dev == host, f"device {dev[:6]}... vs host {host[:6]}..."
+    return dev
+
+
+A = "define stream A (v float, w float);\n"
+
+
+def gen(seed, n=80, vmax=10.0, step=200):
+    rng = np.random.default_rng(seed)
+    ts = 1_000_000
+    rows = []
+    for _ in range(n):
+        ts += int(rng.integers(1, step))
+        rows.append(([float(np.float32(rng.uniform(0, vmax))),
+                      float(np.float32(rng.uniform(0, vmax)))], ts))
+    return rows
+
+
+# ------------------------------------------------------------ mid-chain every
+
+def test_mid_every_basic_fork():
+    app = A + """@info(name='q')
+    from e1=A[v < 1.0] -> every e2=A[v > 5.0] -> e3=A[v > 8.0]
+    select e1.v as a, e2.v as b, e3.v as c insert into Out;"""
+    out = parity(app, gen(1, n=60))
+    assert out        # the shape must actually produce matches
+
+
+@pytest.mark.parametrize("seed", [2, 3, 4])
+def test_mid_every_fork_flood(seed):
+    """Every qualifying event forks a clone: dozens of live partials per
+    lane force slot-ring growth through grow-and-replay."""
+    app = A + """@info(name='q')
+    from e1=A[v < 2.0] -> every e2=A[v > 2.0] -> e3=A[v > 9.0]
+    select e1.v as a, e2.v as b, e3.v as c insert into Out;"""
+    out = parity(app, gen(seed, n=120))
+    assert out
+
+
+def test_mid_every_with_within():
+    app = A + """@info(name='q')
+    from e1=A[v < 2.0] -> every e2=A[v > 4.0] -> e3=A[v > 8.0]
+    within 3 sec
+    select e1.v as a, e2.v as b, e3.v as c insert into Out;"""
+    parity(app, gen(5, n=100, step=800))
+
+
+def test_mid_every_logical_group():
+    app = A + """@info(name='q')
+    from e1=A[v < 2.0] -> every (e2=A[v > 4.0] and e3=A[w > 4.0])
+        -> e4=A[v > 8.0]
+    select e1.v as a, e2.v as b, e4.v as c insert into Out;"""
+    parity(app, gen(6, n=100))
+
+
+def test_mid_every_group_of_two():
+    app = A + """@info(name='q')
+    from e1=A[v < 2.0] -> every (e2=A[v > 3.0] -> e3=A[w > 3.0])
+        -> e4=A[v > 9.0]
+    select e1.v as a, e2.v as b, e3.w as c, e4.v as d insert into Out;"""
+    parity(app, gen(7, n=100))
+
+
+def test_leading_and_mid_every():
+    app = A + """@info(name='q')
+    from every e1=A[v < 2.0] -> every e2=A[v > 6.0] -> e3=A[v > 9.0]
+    select e1.v as a, e2.v as b, e3.v as c insert into Out;"""
+    parity(app, gen(8, n=90))
+
+
+# ------------------------------------------------------------ leading min-0
+
+def test_leading_min0_pattern_every():
+    # every-leading-count shares one accumulator chain (arm_once — the
+    # reference's shared StateEvent), so matches are sparse; parity with
+    # the oracle is the contract
+    app = A + """@info(name='q')
+    from every e1=A[v < 3.0]<0:3> -> e2=A[v > 7.0]
+    select e1[0].v as a, e2.v as b insert into Out;"""
+    assert parity(app, gen(10, n=80))
+
+
+def test_leading_min0_single_shot():
+    app = A + """@info(name='q')
+    from e1=A[v < 3.0]<0:2> -> e2=A[v > 7.0]
+    select e1[last].v as a, e2.v as b insert into Out;"""
+    parity(app, gen(11, n=40))
+
+
+def test_leading_min0_empty_match():
+    """The empty-kleene (epsilon) path: the successor can match with zero
+    kleene occurrences and the capture decodes as None."""
+    app = A + """@info(name='q')
+    from e1=A[v < 3.0]<0:2> -> e2=A[v > 7.0]
+    select e1[0].v as a, e2.v as b insert into Out;"""
+    out = parity(app, [([8.1, 0.0], 1000), ([2.0, 0.0], 1400)])
+    assert out == [(1000, (None, pytest.approx(8.1)))]
+
+
+def test_leading_min0_sequence_nonevery():
+    app = A + """@info(name='q')
+    from e1=A[v < 3.0]<0:2>, e2=A[v > 5.0]
+    select e1[0].v as a, e2.v as b insert into Out;"""
+    parity(app, gen(12, n=40))
+
+
+def test_leading_min0_every_sequence_falls_back():
+    """every + SEQUENCE + leading min-0: the oracle's shared start
+    partial can be blocked from the successor's pending list while live
+    in the count's — host-only (recorded reason); parity still holds."""
+    app = A + """@info(name='q')
+    from every e1=A[v < 3.0]<0:2>, e2=A[v > 5.0]
+    select e1[0].v as a, e2.v as b insert into Out;"""
+    rows = gen(12, n=60)
+    host = run(app, rows, engine="host", expect_backend="host")
+    auto = run(app, rows, expect_backend="host")
+    assert auto == host
+
+
+def test_leading_min0_within():
+    app = A + """@info(name='q')
+    from every e1=A[v < 3.0]<0:3> -> e2=A[v > 8.0] within 2 sec
+    select e1[0].v as a, e2.v as b insert into Out;"""
+    parity(app, gen(13, n=100, step=900))
+
+
+# ------------------------------------------------------------ absent in seq
+
+def test_absent_in_sequence():
+    app = A + """@info(name='q')
+    from every e1=A[v > 7.0], not A[v < 1.0] for 1 sec
+    select e1.v as a insert into Out;"""
+    parity(app, gen(20, n=70, step=600))
+
+
+def test_absent_mid_sequence():
+    app = A + """@info(name='q')
+    from every e1=A[v > 7.0], not A[v < 1.0] for 1 sec, e3=A[v > 5.0]
+    select e1.v as a, e3.v as b insert into Out;"""
+    parity(app, gen(21, n=70, step=600))

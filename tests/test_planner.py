@@ -86,11 +86,11 @@ def test_unsupported_shapes_fall_back_with_reason():
             from every e1=A[s > 'A'] -> e2=A[v > e1.v]
             select e1.v as v1, e2.v as v2 insert into Out;
         """,
-        "mid_chain_every": """
+        "nested_every": """
             define stream A (v float);
             @info(name='q')
-            from e1=A[v > 0.0] -> every e2=A[v > e1.v] -> e3=A[v > 9.0]
-            select e1.v as v1, e2.v as v2, e3.v as v3 insert into Out;
+            from e1=A[v > 0.0] -> every (every e2=A[v > e1.v])
+            select e1.v as v1, e2.v as v2 insert into Out;
         """,
         "leading_absent": """
             define stream A (v float);

@@ -433,16 +433,16 @@ def test_logical_unnamed_sides_plan_onto_device():
     assert_parity(app, sends)
 
 
-def test_sequence_absent_falls_back_to_host():
-    # sequence-absent init/reset guards are not mirrored on the device
+def test_sequence_absent_compiles_to_device():
+    # round 4: sequence-absent stabilize semantics are mirrored on the
+    # device (the kill-at-step-start barrier in ops/nfa.py)
     app = "@app:playback " + STREAMS + """
         @info(name='q')
         from e1=A[v > 10.0], not B[w > 0.0] for 1 sec, e3=A[v > 50.0]
         select e1.v as v1, e3.v as v3 insert into Out;
     """
-    backend, reason, _ = run_app(app, [A(1000, 1, 20.0)], until=2500)
-    assert backend == "host"
-    assert "host-only" in reason
+    backend, _reason, _ = run_app(app, [A(1000, 1, 20.0)], until=2500)
+    assert backend == "device"
 
 
 # ------------------------------------------------------------------- fuzz

@@ -166,15 +166,18 @@ def test_tail_every_rearm_flood_grows_slots():
     assert len(host) > 100 and host == dev
 
 
-def test_mid_chain_every_still_host_only():
+def test_mid_chain_every_compiles_to_device():
+    # round 4: mid-chain `every` forks clones via the kernel's
+    # alloc_clones; nested every remains host-only
     app = STREAMS + """
         @info(name='q')
         from e1=A[v > 10.0] -> every e2=B[w > 5.0] -> e3=A[v > 50.0]
         select e1.v as v1, e2.w as w2, e3.v as v3 insert into Out;
     """
-    b, reason, _ = run_app(app, [A(1000, 1, 20.0), B(1100, 1, 8.0),
-                                 A(1200, 1, 60.0)])
-    assert b == "host" and "every" in (reason or "")
+    b, _reason, out = run_app(app, [A(1000, 1, 20.0), B(1100, 1, 8.0),
+                                    A(1200, 1, 60.0)])
+    assert b == "device"
+    assert out == [(20.0, 8.0, 60.0)]
 
 
 def test_tail_every_group_within_expiry_parity():
