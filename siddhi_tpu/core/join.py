@@ -125,6 +125,19 @@ class _JoinReceiver:
         self.runtime.on_arrival(self.side, chunk)
 
 
+
+def _expr_children(e):
+    """Dataclass-field children of an expression node (lists AND tuples —
+    AttributeFunction.args is a Tuple; a list-only walk would skip
+    constants/variables nested in function arguments)."""
+    for f in getattr(e, "__dataclass_fields__", {}):
+        v = getattr(e, f)
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        for x in vs:
+            if hasattr(x, "__dataclass_fields__"):
+                yield x
+
+
 class JoinRuntime:
     def __init__(self, qr, jis: JoinInputStream, factory):
         self.qr = qr
@@ -309,12 +322,8 @@ class JoinRuntime:
                     self._str_join_attrs.add(e.left.attribute)
                     self._str_join_attrs.add(e.right.attribute)
                     return
-            for f in getattr(e, "__dataclass_fields__", {}):
-                v = getattr(e, f)
-                vs = v if isinstance(v, list) else [v]
-                for x in vs:
-                    if hasattr(x, "__dataclass_fields__"):
-                        scan(x)
+            for x in _expr_children(e):
+                scan(x)
             if is_str_var(e):
                 raise ValueError(
                     f"string attribute '{e.attribute}' outside an ==/!= "
@@ -334,17 +343,25 @@ class JoinRuntime:
                     (AttrType.INT, AttrType.LONG):
                 return True
             inside = inside or isinstance(e, MathExpr)
-            for f in getattr(e, "__dataclass_fields__", {}):
-                v = getattr(e, f)
-                vs = v if isinstance(v, list) else [v]
-                for x in vs:
-                    if hasattr(x, "__dataclass_fields__") and \
-                            int_in_math(x, inside):
-                        return True
-            return False
+            return any(int_in_math(x, inside) for x in _expr_children(e))
         if int_in_math(jis.on):
             return _fail("arithmetic on INT/LONG attributes can leave the "
                          "f32 exact-integer range")
+
+        def f32_unsafe_const(e) -> bool:
+            # a float constant that is not exactly representable in f32
+            # rounds on the device lanes, so borderline compares (notably
+            # FLOAT-attr equality vs a double literal like 50.1) could
+            # match where the host's float64 promotion never does —
+            # mirror of the DOUBLE-attribute guard below
+            from ..query_api.expression import Constant as _C
+            if isinstance(e, _C) and isinstance(e.value, float) and \
+                    float(np.float32(e.value)) != e.value:
+                return True
+            return any(f32_unsafe_const(x) for x in _expr_children(e))
+        if f32_unsafe_const(jis.on):
+            return _fail("a float constant in the on-condition is not "
+                         "exactly representable in float32")
         for v in variables_of(jis.on):
             t = types.get((v.stream_id, v.attribute))
             if t is None:

@@ -131,9 +131,12 @@ class QueryRuntime:
         if isinstance(q.input_stream, SingleInputStream):
             if self._device_key_executors is not None:
                 # keyed (partition) mode: device or raise, as below.
-                # The Pallas ring path (group == partition key) first;
-                # the grouped-agg kernel covers finer group-bys, running
-                # aggregates and INT/LONG values
+                # The specialized window-ring path (group == partition
+                # key) is tried first — MEASURED 4.6x faster than the
+                # grouped-agg slabs on the shape both support (keyed
+                # length-window f32 sum, 10k lanes x W=64, r4 benchmark
+                # in docs/perf_notes.md); the grouped-agg kernel covers
+                # finer group-bys, running aggregates and INT/LONG values
                 from ..plan.planner import (DeviceGroupedAggRuntime,
                                             DeviceWindowedAggRuntime)
                 try:

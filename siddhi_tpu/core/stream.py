@@ -119,15 +119,18 @@ class StreamJunction:
         self._worker_threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self._drain = threading.Event()
-        self._in_flight = 0          # chunks popped but not yet delivered
         self._configure_from_annotations()
 
     @property
     def quiescent(self) -> bool:
-        """No queued chunks and no delivery in flight (async mode)."""
-        if not self.is_async or self._queue is None:
+        """No queued chunks and no delivery in flight (async mode).
+        Queue.unfinished_tasks is atomic under the queue's own lock: a
+        put increments it and the worker's task_done() (after delivery
+        completes) decrements — no popped-but-undelivered window."""
+        q = self._queue
+        if not self.is_async or q is None:
             return True
-        return self._queue.empty() and self._in_flight == 0
+        return q.unfinished_tasks == 0
 
     def _configure_from_annotations(self):
         ann = find_annotation(self.definition.annotations, "async")
@@ -187,13 +190,12 @@ class StreamJunction:
                 if self._drain.is_set():
                     break       # drained: queue empty after drain request
                 continue
-            self._in_flight += 1
             if isinstance(item, _FlushBarrier):
                 delivered = False
                 try:
                     item.arrive(self._flush_receivers)
                 finally:
-                    self._in_flight -= 1
+                    self._queue.task_done()
                 continue
             batch = [item]
             n = len(item)
@@ -216,7 +218,11 @@ class StreamJunction:
                     delivered = False
                     barrier.arrive(self._flush_receivers)
             finally:
-                self._in_flight -= 1
+                # one task_done per popped item: the batch's extra pops
+                # and a trailing barrier pop all complete here
+                for _ in range(len(batch) + (1 if barrier is not None
+                                             else 0)):
+                    self._queue.task_done()
         if delivered:
             self._flush_receivers()
 

@@ -42,6 +42,11 @@ _BATCH_KINDS = ("lengthBatch", "timeBatch", "externalTimeBatch", "batch")
 W_START = 16
 LONG_BASE = np.int64(1) << 31
 INT_NONE = np.int32(-(2 ** 31))       # null sentinel on INT lanes
+# null sentinel for DOUBLE lanes: a reserved quiet-NaN bit pattern (a
+# real NaN payload of exactly this pattern would decode as None — the
+# standard float64 NaN is 0x7ff8000000000000, so this never collides
+# with arithmetic-produced NaNs)
+DBL_NONE_BITS = 0x7FF8_DEAD_BEEF_0000
 
 
 def _reject(msg: str):
@@ -105,6 +110,7 @@ class DeviceWindowProcessor(WindowProcessor):
         self.str_attrs: Dict[str, Tuple[Dict, List]] = {}
         self.attr_types = {a.name: a.type for a in definition.attributes}
         nf = ni = 0
+        self.dbl_attrs: set = set()
         for a in definition.attributes:
             t = a.type
             if t == AttrType.FLOAT:
@@ -116,13 +122,19 @@ class DeviceWindowProcessor(WindowProcessor):
             elif t == AttrType.LONG:
                 self.i_lanes[a.name] = (ni, ni + 1)
                 ni += 2
+            elif t == AttrType.DOUBLE:
+                # exact: the float64 bit pattern rides two i32 lanes
+                # (bitcast hi/lo) — no f32 rounding anywhere
+                self.dbl_attrs.add(a.name)
+                self.i_lanes[a.name] = (ni, ni + 1)
+                ni += 2
             elif t == AttrType.STRING:
                 self.i_lanes[a.name] = (ni,)
                 self.str_attrs[a.name] = ({}, [])
                 ni += 1
             else:
                 _reject(f"{t.name} payload attributes ride no exact device "
-                        f"lane (f32 round-trip would break host parity)")
+                        f"lane")
         if kind == "externalTimeBatch":
             # batch CURRENT rows keep their ORIGINAL arrival timestamps
             # while the ring is keyed by event time — carry arrival ts on
@@ -215,6 +227,16 @@ class DeviceWindowProcessor(WindowProcessor):
             col = chunk.columns[name]
             if name in self.str_attrs:
                 ev_i[0, :, lanes[0]] = [self._code(name, v) for v in col]
+            elif name in self.dbl_attrs:
+                none = np.asarray([x is None for x in col], bool) \
+                    if col.dtype == object else np.zeros(T, bool)
+                vals = np.asarray(
+                    [0.0 if x is None else float(x) for x in col]
+                    if col.dtype == object else col, np.float64)
+                bits = vals.view(np.int64)
+                bits = np.where(none, np.int64(DBL_NONE_BITS), bits)
+                ev_i[0, :, lanes[0]] = (bits >> 32).astype(np.int32)
+                ev_i[0, :, lanes[1]] = bits.astype(np.int32)
             elif len(lanes) == 2:
                 v = np.asarray([0 if x is None else int(x) for x in col],
                                np.int64)
@@ -263,6 +285,19 @@ class DeviceWindowProcessor(WindowProcessor):
                     d = np.asarray(dec, object)
                     out[ok] = d[codes[ok] - 1]
                 cols[name] = out
+            elif name in self.dbl_attrs:
+                lanes = self.i_lanes[name]
+                bits = (rows_i[:, lanes[0]].astype(np.int64) << 32) | \
+                    (rows_i[:, lanes[1]].astype(np.int64) &
+                     np.int64(0xFFFFFFFF))
+                vals = bits.view(np.float64)
+                none = bits == np.int64(DBL_NONE_BITS)
+                if none.any():
+                    out = np.full(n, None, object)
+                    out[~none] = vals[~none]
+                    cols[name] = out
+                else:
+                    cols[name] = vals.copy()
             else:
                 lanes = self.i_lanes[name]
                 if len(lanes) == 2:

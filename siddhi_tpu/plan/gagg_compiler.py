@@ -46,7 +46,8 @@ from ..ops.grouped_agg import (INT_EXACT_MAX, INT_GROUP_MAX, TS_EMPTY,
                                reassemble_int_sums)
 from .expr_compiler import EvalCtx, ExprCompiler, Scope
 
-_AGGS = {"sum", "count", "avg", "min", "max", "minforever", "maxforever"}
+_AGGS = {"sum", "count", "avg", "min", "max", "minforever", "maxforever",
+         "stddev"}
 _INT_TYPES = (AttrType.INT, AttrType.LONG)
 _NUM_TYPES = _INT_TYPES + (AttrType.FLOAT, AttrType.DOUBLE)
 
@@ -70,6 +71,23 @@ class _Value:
         self.vidx = vidx                 # index within its bank
         self.attr = attr                 # plain-Variable name (int check)
         self.type = compiled.type
+
+
+class _SplitSquare:
+    """x² split across two exactly-representable f32 parts (stdDev lanes):
+    hi = f32(x²), lo = x² − hi (the rounding remainder, ≤ ulp(hi)/2 —
+    f32-representable).  x² itself is exact in float64 for f32 inputs."""
+
+    def __init__(self, base, part: str):
+        self._base = base
+        self._part = part
+        self.type = AttrType.DOUBLE
+
+    def fn(self, ctx):
+        x = np.asarray(self._base.fn(ctx), np.float64)
+        sq = x * x
+        hi = sq.astype(np.float32).astype(np.float64)
+        return hi if self._part == "hi" else sq - hi
 
 
 class CompiledGroupedAgg:
@@ -183,6 +201,33 @@ class CompiledGroupedAgg:
                     continue
                 if not e.args:
                     _reject(f"{kind}() needs an argument")
+                if kind == "stddev":
+                    # stdDev(x) = sqrt(E[x²] − E[x]²) — the reference's
+                    # own mean/meanSq formula (StdDevAttributeAggregator
+                    # Executor.java), so the cancellation behavior
+                    # matches.  x² does not fit one f32 lane (a 24-bit
+                    # mantissa squared needs 48), so each square rides
+                    # TWO lanes — hi = f32(x²), lo = x² − hi, both exact
+                    # — and Σhi + Σlo reconstructs Σx² in float64.
+                    arg = e.args[0]
+                    vx = value_of(arg)
+                    if vx.int_mode:
+                        _reject("stdDev over INT/LONG arguments would "
+                                "square outside the exact i32 range")
+                    parts = []
+                    for part in ("hi", "lo"):
+                        key = ("__stddev_sq", part, arg)
+                        v = by_ast.get(key)
+                        if v is None:
+                            v = _Value(key, _SplitSquare(vx.compiled, part),
+                                       False, self._n_float, None)
+                            self._n_float += 1
+                            by_ast[key] = v
+                            self.values.append(v)
+                        parts.append(v)
+                    self.outputs.append(
+                        (oa.rename, "stddev", (vx, parts[0], parts[1])))
+                    continue
                 val = value_of(e.args[0])
                 if kind in ("min", "max"):
                     want_minmax = True
@@ -387,6 +432,7 @@ class CompiledGroupedAgg:
         i_plane[lanes32, row] = vals_i
         g_plane[lanes32, row] = gids
         ok_plane[lanes32, row] = ok
+        pre_carry = self.carry
         if self.window_kind == "time":
             ts_plane = self._ts_offsets(data, lanes32, row, ok,
                                         (P, T))
@@ -421,7 +467,12 @@ class CompiledGroupedAgg:
         if self._int_sum_needed and self.window == 0 and \
                 int(counts.max(initial=0)) >= INT_GROUP_MAX:
             # running (no-window) hi/lo sums are exact only below 2^15
-            # live entries per group (i32 partial-sum bound)
+            # live entries per group (i32 partial-sum bound).  Restore
+            # the pre-block carry BEFORE raising so @OnError continuation
+            # sees consistent state (ADVICE r3: the error must not leave
+            # the dropped chunk half-applied)
+            if self.window_kind != "time":
+                self.carry = pre_carry
             raise SiddhiAppRuntimeException(
                 "device grouped-agg path: a group accumulated >= 2^15 "
                 "events; exact running integer sums exceed the i32 "
@@ -433,6 +484,21 @@ class CompiledGroupedAgg:
                 continue
             if kind == "count":
                 out[name] = counts
+                continue
+            if kind == "stddev":
+                vx, vh, vl = ref
+                sx = pick(fhi)[:, vx.vidx].astype(np.float64) + \
+                    pick(flo)[:, vx.vidx].astype(np.float64)
+                sxx = (pick(fhi)[:, vh.vidx].astype(np.float64) +
+                       pick(flo)[:, vh.vidx].astype(np.float64)) + \
+                      (pick(fhi)[:, vl.vidx].astype(np.float64) +
+                       pick(flo)[:, vl.vidx].astype(np.float64))
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    c = np.maximum(counts, 1)
+                    var = sxx / c - (sx / c) ** 2
+                    out[name] = np.where(counts > 0,
+                                         np.sqrt(np.maximum(var, 0.0)),
+                                         np.nan)
                 continue
             v: _Value = ref
             j = v.vidx
@@ -475,6 +541,8 @@ class CompiledGroupedAgg:
                     self.input_definition.attributes}[ref]
         if kind == "count":
             return AttrType.LONG
+        if kind == "stddev":
+            return AttrType.DOUBLE
         if kind == "sum":
             return AttrType.LONG if ref.int_mode else AttrType.DOUBLE
         if kind == "avg":

@@ -494,11 +494,13 @@ class DeviceWindowedAggRuntime:
             attrs.append(Attribute(name, t))
         target = getattr(q.output_stream, "target_id", "") or qr.name
         out_def = StreamDefinition(target, attrs)
-        self.head = qr._finish_device_chain(out_def, factory)
 
-        # trace the kernel now (all-invalid block) so unsupported
-        # expressions — e.g. string-typed filters — reject at PLAN time,
-        # while fallback to the host clone machinery is still possible
+        # trace the kernel BEFORE wiring the output tail (all-invalid
+        # block) so unsupported expressions — e.g. string-typed filters —
+        # reject at PLAN time while fallback to DeviceGroupedAggRuntime
+        # is still clean: a rejected wagg must not leave an output
+        # definition bound for the gagg fallback to rewire against
+        # (ADVICE r3 #3)
         try:
             P = self.cwa.n_partitions
             warm = {a.name: np.zeros((P, 1), np.float32)
@@ -513,6 +515,7 @@ class DeviceWindowedAggRuntime:
         except Exception as e:
             raise SiddhiAppCreationError(
                 f"device wagg path: kernel compile failed ({e})")
+        self.head = qr._finish_device_chain(out_def, factory)
 
         recv = ProcessStreamReceiver(
             _DeviceIngress(self, 0, self.cwa.stream_id), qr.lock,

@@ -221,11 +221,12 @@ def test_oversized_int_value_is_data_error():
 
 
 def test_device_rejects_unsupported_to_host():
-    """stdDev / having fall back from the grouped-agg kernel with
-    recorded reasons.  (lengthBatch used to be in this list; batch
-    windows now run on the device window path, plan/dwin_compiler.)"""
-    for frag in ("select sym, stdDev(price) as s group by sym",
-                 "select sym, sum(price) as t group by sym having t > 10.0"):
+    """having falls back from the grouped-agg kernel with a recorded
+    reason.  (lengthBatch and stdDev used to be in this list; batch
+    windows ride the device window path and stdDev lowers onto split
+    sum-of-squares lanes.)"""
+    for frag in (
+            "select sym, sum(price) as t group by sym having t > 10.0",):
         app = STREAM + f"@info(name='q') from S{'' if frag.startswith('s') else ''}" \
             + ("" if frag.startswith("#") else " ") + frag + \
             " insert into Out;"
@@ -372,3 +373,52 @@ def test_external_time_junk_ts_on_rejected_rows():
              (["a", epoch + 500, 9, 1], 1_000_200)]
     out = assert_parity(app, sends)
     assert out == [("a", 7), ("a", 16)]
+
+
+def test_group_count_bound_raises_with_consistent_state():
+    """ADVICE r3: the >=2^15-events running-int-sum bound must restore
+    the pre-block carry before raising, so @OnError continuation sees the
+    offending chunk fully un-applied (not half-aggregated)."""
+    from siddhi_tpu.ops.grouped_agg import INT_GROUP_MAX
+    from siddhi_tpu.plan.gagg_compiler import CompiledGroupedAgg
+    import siddhi_tpu.ops.grouped_agg as ga
+    app = STREAM + """
+        @info(name='q') from S select sum(volume) as tv insert into Out;"""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("@app:playback " + app)
+    out = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: out.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    qr = rt.query_runtimes["q"]
+    assert qr.backend == "device"
+    cga = qr.device_runtime.cga
+    h = rt.get_input_handler("S")
+    h.send(["a", "u", 1.0, 7], timestamp=1_000_000)
+    carry_before = [np.asarray(a).copy() for a in cga.carry]
+    # force the bound: pretend the group already accumulated 2^15 events
+    cga.carry = type(cga.carry)(*[
+        a if i != cga.carry._fields.index("gcnt")
+        else np.full_like(np.asarray(a), INT_GROUP_MAX)
+        for i, a in enumerate(cga.carry)])
+    carry_forced = [np.asarray(a).copy() for a in cga.carry]
+    h.send(["a", "u", 1.0, 9], timestamp=1_000_100)   # raises via @OnError
+    after = [np.asarray(a) for a in cga.carry]
+    # the offending chunk is fully un-applied: carry == pre-chunk carry
+    assert all((x == y).all() for x, y in zip(carry_forced, after))
+    rt.shutdown()
+    assert out == [(7,)]
+
+
+def test_stddev_randomized_parity():
+    """stdDev lowers onto sum/sum-of-squares lanes (TwoSum pairs); device
+    matches the host's float64 mean/meanSq formula at f32-normalized
+    precision (the suite-wide float contract, _norm)."""
+    app = STREAM + """
+        @info(name='q') from S
+        select sym, stdDev(price) as sd group by sym insert into Out;"""
+    assert_parity(app, _rows(n=60))
+    app2 = STREAM + """
+        @info(name='q') from S#window.length(5)
+        select stdDev(price) as sd insert into Out;"""
+    assert_parity(app2, _rows(n=40))

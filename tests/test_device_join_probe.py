@@ -210,3 +210,27 @@ def test_string_join_with_nulls_guards_to_host_mask():
              ("R", ["IBM", 4], 1_000_300)]
     out = assert_parity(app, sends)
     assert (3.0, 4) in out and (1.0, 2) not in out
+
+
+def test_f32_unsafe_float_literal_routes_to_host():
+    """ADVICE r3: a float constant not exactly representable in float32
+    (e.g. 50.1) could flip borderline compares on device lanes — the
+    probe must stay host for such conditions, and compile for exactly-
+    representable ones (50.5)."""
+    from siddhi_tpu import SiddhiManager
+    base = """
+    define stream L (sym string, price float);
+    define stream R (sym string, price float);
+    @info(name='q')
+    from L#window.length(10) join R#window.length(10)
+        on L.price > R.price and R.price == {lit}
+    select L.sym as ls, R.sym as rs insert into Out;
+    """
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(base.format(lit="50.1"))
+    assert rt.query_runtimes["q"].backend == "host"
+    rt.shutdown()
+    m2 = SiddhiManager()
+    rt2 = m2.create_siddhi_app_runtime(base.format(lit="50.5"))
+    assert rt2.query_runtimes["q"].backend == "device"
+    rt2.shutdown()

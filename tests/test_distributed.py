@@ -1,6 +1,7 @@
 """Multi-host distributed backend (parallel/distributed.py) on the virtual
-8-device CPU mesh — the num_processes=1 degenerate case runs the exact code
-multi-host deployments run (global sharded arrays assembled from
+8-device CPU mesh — the num_processes=1 degenerate case of the code path
+that tests/test_multihost.py additionally executes with TWO real OS
+processes over localhost DCN (global sharded arrays assembled from
 process-local data, sharded step, host-local shard readback)."""
 import numpy as np
 import pytest

@@ -1,0 +1,88 @@
+"""A REAL 2-process jax.distributed run (VERDICT r3 #5): two OS
+processes coordinate over localhost (the DCN path), each with 4 virtual
+CPU devices, jointly executing the mesh-sharded NFA step over a global
+8-device mesh via DistributedPatternBank.step_local.  Asserts global
+match parity with a single-process run over the same stream and that
+egress is host-local (each process sees only its own partition range).
+
+This is the first artifact where the cross-host assembly
+(make_array_from_process_local_data), the SPMD step, the fused stats
+all-reduce, and host-local shard readback execute with
+jax.process_count() > 1."""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "multihost_worker.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _scrubbed_env():
+    env = dict(os.environ)
+    # fresh subprocesses must not register the axon TPU plugin, and must
+    # not inherit the parent's forced device count
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = ""
+    return env
+
+
+def test_two_process_distributed_matches_single_process(tmp_path):
+    coord = f"127.0.0.1:{_free_port()}"
+    outs = [str(tmp_path / f"proc{i}.json") for i in range(2)]
+    env = _scrubbed_env()
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, coord, "2", str(i), outs[i]],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        for i in range(2)]
+    logs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("2-process run timed out")
+        logs.append((p.returncode, out.decode()[-2000:],
+                     err.decode()[-2000:]))
+    assert all(rc == 0 for rc, _o, _e in logs), logs
+
+    r0 = json.load(open(outs[0]))
+    r1 = json.load(open(outs[1]))
+    # disjoint halves of the partition space
+    assert r0["range"] == [0, 8] and r1["range"] == [8, 16]
+
+    # single-process reference over the SAME deterministic stream
+    single = str(tmp_path / "single.json")
+    p = subprocess.run(
+        [sys.executable, WORKER, f"127.0.0.1:{_free_port()}", "1", "0",
+         single], env=env, capture_output=True, timeout=240)
+    assert p.returncode == 0, p.stderr.decode()[-2000:]
+    rs = json.load(open(single))
+    assert rs["range"] == [0, 16]
+
+    for b in range(len(rs["blocks"])):
+        b0, b1, bs = (r0["blocks"][b], r1["blocks"][b], rs["blocks"][b])
+        # the fused stats psum is GLOBAL and identical on both hosts
+        assert b0["stats"] == b1["stats"] == bs["stats"]
+        # the two hosts' local matches partition the global set exactly
+        assert b0["local_matches"] + b1["local_matches"] == \
+            bs["stats"]["matches"] == bs["local_matches"]
+        # per-partition counts line up with the single-process run
+        assert b0["per_partition"] + b1["per_partition"] == \
+            bs["per_partition"]
+    # the workload actually matched something
+    assert sum(b["stats"]["matches"] for b in rs["blocks"]) > 0

@@ -172,3 +172,27 @@ def test_absent_mid_sequence():
     from every e1=A[v > 7.0], not A[v < 1.0] for 1 sec, e3=A[v > 5.0]
     select e1.v as a, e3.v as b insert into Out;"""
     parity(app, gen(21, n=70, step=600))
+
+
+# ---------------------------------------------------------------- pins
+
+def test_within_expiry_self_forward_dies_not_crashes():
+    """ADVICE r3 pin: when a within-expired partial's every-group head is
+    the expiring unit ITSELF (`A -> every B within t`), the reference
+    would re-arm into the pending list it is iterating and throw
+    ConcurrentModificationException — broken upstream.  Our chosen
+    semantics: the partial silently dies (firing stops `within` after the
+    chain start), identically on host and device.  This test pins that
+    choice so a future reference upgrade that fixes the CME is noticed."""
+    app = A + """@info(name='q')
+    from (e1=A[v < 2.0] -> every e2=A[v > 5.0]) within 1 sec
+    select e1.v as a, e2.v as b insert into Out;"""
+    rows = [([1.0, 0.0], 1000), ([6.0, 0.0], 1400), ([7.0, 0.0], 1900),
+            # past within (2100 > 1000+1000): the re-arm must be dead,
+            # not crash — and never fire again
+            ([8.0, 0.0], 2400), ([9.0, 0.0], 2900)]
+    dev = run(app, rows, expect_backend="device")
+    host = run(app, rows, engine="host", expect_backend="host")
+    expect = [(1400, (1.0, 6.0)), (1900, (1.0, 7.0))]
+    assert [(t, (round(a, 2), round(b, 2))) for t, (a, b) in dev] == expect
+    assert dev == host
