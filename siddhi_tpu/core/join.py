@@ -28,6 +28,7 @@ from ..query_api import (EventTrigger, Filter, JoinInputStream, JoinType,
                          StreamFunctionHandler, WindowHandler)
 from ..query_api.definition import Attribute, StreamDefinition
 from ..utils.errors import SiddhiAppCreationError
+from ..query_api.expression import expr_children
 from .event import CURRENT, EXPIRED, TIMER, EventChunk
 from .processor import Processor
 from .window import WindowProcessor, create_window_processor
@@ -124,18 +125,6 @@ class _JoinReceiver:
     def receive_chunk(self, chunk: EventChunk):
         self.runtime.on_arrival(self.side, chunk)
 
-
-
-def _expr_children(e):
-    """Dataclass-field children of an expression node (lists AND tuples —
-    AttributeFunction.args is a Tuple; a list-only walk would skip
-    constants/variables nested in function arguments)."""
-    for f in getattr(e, "__dataclass_fields__", {}):
-        v = getattr(e, f)
-        vs = v if isinstance(v, (list, tuple)) else [v]
-        for x in vs:
-            if hasattr(x, "__dataclass_fields__"):
-                yield x
 
 
 class JoinRuntime:
@@ -322,7 +311,7 @@ class JoinRuntime:
                     self._str_join_attrs.add(e.left.attribute)
                     self._str_join_attrs.add(e.right.attribute)
                     return
-            for x in _expr_children(e):
+            for x in expr_children(e):
                 scan(x)
             if is_str_var(e):
                 raise ValueError(
@@ -343,7 +332,7 @@ class JoinRuntime:
                     (AttrType.INT, AttrType.LONG):
                 return True
             inside = inside or isinstance(e, MathExpr)
-            return any(int_in_math(x, inside) for x in _expr_children(e))
+            return any(int_in_math(x, inside) for x in expr_children(e))
         if int_in_math(jis.on):
             return _fail("arithmetic on INT/LONG attributes can leave the "
                          "f32 exact-integer range")
@@ -358,7 +347,7 @@ class JoinRuntime:
             if isinstance(e, _C) and isinstance(e.value, float) and \
                     float(np.float32(e.value)) != e.value:
                 return True
-            return any(f32_unsafe_const(x) for x in _expr_children(e))
+            return any(f32_unsafe_const(x) for x in expr_children(e))
         if f32_unsafe_const(jis.on):
             return _fail("a float constant in the on-condition is not "
                          "exactly representable in float32")

@@ -86,6 +86,14 @@ class _SplitSquare:
     def fn(self, ctx):
         x = np.asarray(self._base.fn(ctx), np.float64)
         sq = x * x
+        if np.any(sq > 3.0e38):
+            # x² must fit the f32 hi lane; |x| > ~1.8e19 would ride as
+            # inf and poison the running sums — loud data error instead
+            # (routed via the junction's @OnError boundary)
+            raise SiddhiAppRuntimeException(
+                "device grouped-agg path: stdDev argument magnitude "
+                "exceeds the f32 square range (|x| > 1.8e19); re-plan "
+                "with @app:engine('host')")
         hi = sq.astype(np.float32).astype(np.float64)
         return hi if self._part == "hi" else sq - hi
 

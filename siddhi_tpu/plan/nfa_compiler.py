@@ -598,6 +598,7 @@ class CompiledPatternNFA:
         self.str_encoder: Dict[Any, int] = {}
         self.str_decoder: List[Any] = []
         self.encoded_attrs: set = set()
+        self.derived: Dict[str, Tuple[str, Any, str]] = {}
         if not str_attrs:
             return
 
@@ -614,14 +615,42 @@ class CompiledPatternNFA:
                                        Constant(0, "long")))
             return out
 
-        def rewrite(e):
+        def rewrite(e, side=None):
             if isinstance(e, Compare):
                 ls, rs = is_str_var(e.left), is_str_var(e.right)
                 if ls or rs:
                     if e.op not in (CompareOp.EQ, CompareOp.NEQ):
-                        _reject("string attributes support only ==/!= on "
-                                "the device (dictionary codes carry no "
-                                "order)")
+                        # ORDER comparison: dictionary codes carry no
+                        # order, but CURRENT-EVENT-vs-CONSTANT order
+                        # predicates are per-event pure — they lower onto
+                        # a host-computed 0/1 lane the condition reads
+                        # (round 4; null → 0 ⇒ false, the reference law)
+                        from .str_lanes import _REFLECT
+                        var, const = (e.left, e.right) if ls else \
+                            (e.right, e.left)
+                        if (ls and rs) or not (
+                                isinstance(const, Constant) and
+                                isinstance(const.value, str)):
+                            _reject("string ORDER comparisons support "
+                                    "only attribute-vs-constant on the "
+                                    "device")
+                        if getattr(var, "stream_index", None) is not None:
+                            _reject("indexed string references have no "
+                                    "order lanes")
+                        own = (None,) if side is None else \
+                            (None, side.ref, side.stream_id)
+                        if var.stream_id not in own:
+                            # the lane is computed from the CURRENT
+                            # event's column — a captured state's string
+                            # (e1.s > 'mm' inside e2) has no lane
+                            _reject("cross-state string ORDER "
+                                    "comparisons are host-only")
+                        op = e.op if ls else _REFLECT[e.op]
+                        name = f"__sord{len(self.derived)}"
+                        self.derived[name] = (var.attribute, op,
+                                              const.value)
+                        return Compare(Variable(attribute=name),
+                                       CompareOp.GT, Constant(0, "long"))
                     if ls and rs:
                         self.encoded_attrs.add(e.left.attribute)
                         self.encoded_attrs.add(e.right.attribute)
@@ -648,11 +677,13 @@ class CompiledPatternNFA:
                                 f"captures on the device")
                 return e
             if isinstance(e, And):
-                return And(rewrite(e.left), rewrite(e.right))
+                return And(rewrite(e.left, side),
+                           rewrite(e.right, side))
             if isinstance(e, Or):
-                return Or(rewrite(e.left), rewrite(e.right))
+                return Or(rewrite(e.left, side),
+                          rewrite(e.right, side))
             if isinstance(e, Not):
-                return Not(rewrite(e.expr))
+                return Not(rewrite(e.expr, side))
             for v in variables_of(e):
                 if is_str_var(v):
                     _reject(f"string attribute '{v.attribute}' is only "
@@ -662,17 +693,21 @@ class CompiledPatternNFA:
 
         for u in self.units:
             for side in u.sides:
-                side.filters = [rewrite(f) for f in side.filters]
+                side.filters = [rewrite(f, side)
+                                for f in side.filters]
         for oa in query.selector.attributes:
             for v in variables_of(oa.expr):
                 if is_str_var(v):
                     self.encoded_attrs.add(v.attribute)
-        if self.encoded_attrs and parameterize:
+        if (self.encoded_attrs or self.derived) and parameterize:
             _reject("string conditions are not parameterizable "
                     "(pattern-bank mode lowers constants to float lanes)")
         for a in sorted(self.encoded_attrs):
             self.attr_names.append(a)
             self.attr_types[a] = AttrType.LONG
+        for name in self.derived:
+            self.attr_names.append(name)
+            self.attr_types[name] = AttrType.FLOAT
 
     def _encode_str(self, v) -> int:
         code = self.str_encoder.get(v)
@@ -690,6 +725,21 @@ class CompiledPatternNFA:
             self.str_encoder[v] = code
             self.str_decoder.append(v)
         return code
+
+    def derived_lane(self, name: str, col) -> np.ndarray:
+        """Host-computed 0/1 lane for a string ORDER predicate
+        (`s > 'A'`): vectorized unicode comparison; null → 0 (the
+        reference null law: comparisons with null are false)."""
+        from ..query_api.expression import CompareOp
+        _src, op, cval = self.derived[name]
+        obj = np.asarray(col, object)
+        none = np.asarray([x is None for x in obj], bool)
+        strs = np.asarray(["" if x is None else str(x) for x in obj])
+        res = {CompareOp.GT: strs > cval, CompareOp.GTE: strs >= cval,
+               CompareOp.LT: strs < cval, CompareOp.LTE: strs <= cval
+               }[op]
+        res = res & ~none
+        return res.astype(np.float32)
 
     def encode_column(self, col) -> np.ndarray:
         """String column → float32 code lane (dictionary grows on first
@@ -752,6 +802,11 @@ class CompiledPatternNFA:
             scope.add(None, a.name, lane_t, g)
             scope.add(side.stream_id, a.name, lane_t, g)
             scope.add(side.ref, a.name, lane_t, g)
+        # synthetic string-ORDER lanes (host-computed 0/1, see derived_lane)
+        for name in self.derived:
+            def gd(ctx, _a=name):
+                return ctx.columns[_a]
+            scope.add(None, name, AttrType.FLOAT, gd)
         # other states' captures: [K] lanes (first bank at index 0/None,
         # last bank at index -1 for count rows)
         for other in self.rows:
@@ -1172,9 +1227,12 @@ class CompiledPatternNFA:
                                np.int32)
         cols = {}
         for a in self.attr_names:
-            c = columns[a]
-            if a in self.encoded_attrs:
-                c = self.encode_column(c)
+            if a in self.derived and a not in columns:
+                c = self.derived_lane(a, columns[self.derived[a][0]])
+            else:
+                c = columns[a]
+                if a in self.encoded_attrs:
+                    c = self.encode_column(c)
             cols[a] = np.asarray(c)
         block = pack_blocks(np.asarray(partition_ids), cols,
                             np.asarray(timestamps), codes,

@@ -257,6 +257,14 @@ class DevicePatternRuntime:
                                   self.nfa.spec.n_slots)
         cols = {}
         for a in self.nfa.attr_names:
+            if a in self.nfa.derived:
+                # string ORDER lane: computed by dispatch_events from the
+                # raw source column (passed through below)
+                src = self.nfa.derived[a][0]
+                cols[src] = (data.columns.get(src)
+                             if data.columns.get(src) is not None
+                             else np.full(n, None, object))
+                continue
             col = data.columns.get(a)
             if a in self.nfa.encoded_attrs:
                 # raw string column — the NFA dictionary-encodes it
@@ -781,10 +789,6 @@ class DeviceFilterRuntime:
         self.definition = definition
         numeric = {a.name for a in definition.attributes
                    if dtype_for(a.type) is not object}
-        scope = Scope()
-        scope.add_primary(sis.stream_id, sis.stream_ref, definition)
-        compiler = ExprCompiler(scope, jnp)
-        filters = [compiler.compile(h.expr) for h in sis.handlers]
 
         sel_attrs = sel.attributes
         if sel.select_all:            # `select *` → passthrough of all attrs
@@ -792,6 +796,38 @@ class DeviceFilterRuntime:
             from ..query_api.expression import Variable as _V
             sel_attrs = [OutputAttribute(a.name, _V(a.name))
                          for a in definition.attributes]
+
+        # string predicates lower onto per-chunk order-preserving code
+        # lanes (plan/str_lanes.py) — ==/!=/order/is-null over STRING
+        # attrs evaluate ON DEVICE via integer ranks; constructs with no
+        # lane form reject with the rewrite's reason
+        from ..query_api.definition import AttrType as _AT
+        from .str_lanes import StringLanes, StringRewriteError
+        slanes = StringLanes({a.name for a in definition.attributes
+                              if a.type == _AT.STRING})
+        try:
+            filter_exprs = [slanes.rewrite(h.expr) for h in sis.handlers]
+        except StringRewriteError as se:
+            raise SiddhiAppCreationError(f"device filter path: {se}")
+        out_rewritten = {}
+        for oa in sel_attrs:
+            try:
+                out_rewritten[id(oa)] = slanes.rewrite(oa.expr)
+            except StringRewriteError:
+                pass                  # host-expr fallback handles it
+        self._slanes = slanes
+
+        scope = Scope()
+        ext_def = definition
+        if slanes.any:
+            from ..query_api.definition import Attribute as _A
+            from ..query_api.definition import StreamDefinition as _SD
+            ext_def = _SD(definition.id, list(definition.attributes) +
+                          [_A(nm, _AT.FLOAT)
+                           for nm in slanes.lane_names()])
+        scope.add_primary(sis.stream_id, sis.stream_ref, ext_def)
+        compiler = ExprCompiler(scope, jnp)
+        filters = [compiler.compile(e) for e in filter_exprs]
 
         if any(_scan_fns(oa.expr, is_agg) for oa in sel_attrs):
             raise SiddhiAppCreationError(
@@ -830,7 +866,7 @@ class DeviceFilterRuntime:
             ce = None
             if not _scan_fns(e, _is_time_fn):
                 try:
-                    ce = compiler.compile(e)
+                    ce = compiler.compile(out_rewritten.get(id(oa), e))
                 except Exception:       # noqa: BLE001 — host expr instead
                     ce = None
             if ce is None or dtype_for(ce.type) is object or \
@@ -874,6 +910,8 @@ class DeviceFilterRuntime:
         try:
             warm_cols = {a: jnp.zeros((1,), jnp.float32)
                          for a in self.numeric}
+            for nm in self._slanes.lane_names():
+                warm_cols[nm] = jnp.zeros((1,), jnp.float32)
             self._program(warm_cols, jnp.zeros((1,), jnp.int32),
                           jnp.zeros((1,), bool))
         except SiddhiAppCreationError:
@@ -908,6 +946,10 @@ class DeviceFilterRuntime:
             if col is not None:
                 arr[:n] = np.asarray(col, np.float32)
             cols[a] = jnp.asarray(arr)
+        if self._slanes.any:
+            for nm, lane in self._slanes.encode(chunk.columns, n,
+                                                n_pad).items():
+                cols[nm] = jnp.asarray(lane)
         # int32 ts offsets — absolute-timestamp functions are planner-
         # rejected on this path, nothing else reads ctx.timestamps
         ts = np.zeros(n_pad, np.int32)

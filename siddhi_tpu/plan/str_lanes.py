@@ -1,0 +1,216 @@
+"""Per-chunk order-preserving string code lanes for the device filter
+path.
+
+A stateless filter's string predicates need no persistent dictionary:
+each chunk's string values are ranked by np.unique (sorted), so the code
+order IS the string order within the chunk, and every comparison —
+``==``/``!=``, ``<``/``>``/``<=``/``>=``, ``is null``, and
+variable-vs-variable compares — rewrites exactly onto integer code lanes
+the jitted column program evaluates on device.  Constants lower to
+per-chunk threshold lanes (searchsorted left/right ranks), so the traced
+program never bakes a chunk-dependent value.
+
+Null law (reference ExpressionParser compare executors): any comparison
+involving null is false; ``is null`` is the only null-true predicate.
+Null codes are -1; thresholds are >= 0, so ``>=``-style compares are
+null-safe for free and the rest carry an explicit ``code >= 0`` guard.
+
+(The pattern NFA path keeps its PERSISTENT dictionary-code story —
+captures survive across chunks there; see plan/nfa_compiler.py.)
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..query_api.definition import AttrType
+from ..query_api.expression import (And, AttributeFunction, Compare,
+                                    CompareOp, Constant, Expression, In,
+                                    IsNull, MathExpr, Not, Or, Variable,
+                                    expr_children)
+
+
+class StringRewriteError(ValueError):
+    """A string-typed construct with no code-lane rewrite (→ host)."""
+
+
+_REFLECT = {CompareOp.LT: CompareOp.GT, CompareOp.GT: CompareOp.LT,
+            CompareOp.LTE: CompareOp.GTE, CompareOp.GTE: CompareOp.LTE,
+            CompareOp.EQ: CompareOp.EQ, CompareOp.NEQ: CompareOp.NEQ}
+
+
+def _num(v: float) -> Constant:
+    return Constant(value=float(v))
+
+
+class StringLanes:
+    """Collects string attrs/constants used in rewritten predicates and
+    encodes the per-chunk code + threshold lanes."""
+
+    def __init__(self, str_attrs: Set[str]):
+        self.str_attrs = str_attrs
+        self.used: List[str] = []            # attrs needing code lanes
+        self.consts: List[str] = []          # constant values, lane order
+        self.any = False
+
+    # ------------------------------------------------------------ naming
+
+    def code_lane(self, attr: str) -> str:
+        if attr not in self.used:
+            self.used.append(attr)
+        self.any = True
+        return f"__strcode_{attr}"
+
+    def _const_lane(self, value: str, side: str) -> str:
+        if value not in self.consts:
+            self.consts.append(value)
+        self.any = True
+        return f"__strc{self.consts.index(value)}_{side}"
+
+    def lane_names(self) -> List[str]:
+        names = [f"__strcode_{a}" for a in self.used]
+        for i in range(len(self.consts)):
+            names += [f"__strc{i}_lo", f"__strc{i}_hi"]
+        return names
+
+    # ------------------------------------------------------------ rewrite
+
+    def _is_str_var(self, e) -> bool:
+        return isinstance(e, Variable) and e.attribute in self.str_attrs
+
+    def _var(self, e: Variable) -> Variable:
+        if e.stream_index is not None:
+            raise StringRewriteError(
+                "indexed string reference has no code lane")
+        return Variable(attribute=self.code_lane(e.attribute))
+
+    def _cmp_var_const(self, var: Variable, op: CompareOp,
+                       value) -> Expression:
+        if not isinstance(value, str):
+            raise StringRewriteError("string/non-string comparison")
+        code = self._var(var)
+        lo = Variable(attribute=self._const_lane(value, "lo"))
+        hi = Variable(attribute=self._const_lane(value, "hi"))
+        nn = Compare(code, CompareOp.GTE, _num(0.0))     # null guard
+        if op == CompareOp.EQ:
+            # s == c ⟺ lo <= code < hi  (hi = lo + 1 iff c present)
+            return And(Compare(code, CompareOp.GTE, lo),
+                       Compare(code, CompareOp.LT, hi))
+        if op == CompareOp.NEQ:
+            return And(nn, Or(Compare(code, CompareOp.LT, lo),
+                              Compare(code, CompareOp.GTE, hi)))
+        if op == CompareOp.GT:      # s > c ⟺ code >= hi (hi >= 0: null-safe)
+            return Compare(code, CompareOp.GTE, hi)
+        if op == CompareOp.GTE:
+            return Compare(code, CompareOp.GTE, lo)
+        if op == CompareOp.LT:
+            return And(nn, Compare(code, CompareOp.LT, lo))
+        if op == CompareOp.LTE:
+            return And(nn, Compare(code, CompareOp.LT, hi))
+        raise StringRewriteError(f"op {op}")
+
+    def _cmp_var_var(self, a: Variable, op: CompareOp,
+                     b: Variable) -> Expression:
+        ca, cb = self._var(a), self._var(b)
+        guards = And(Compare(ca, CompareOp.GTE, _num(0.0)),
+                     Compare(cb, CompareOp.GTE, _num(0.0)))
+        return And(guards, Compare(ca, op, cb))
+
+    def rewrite(self, e):
+        """Expression → same tree with string predicates lowered onto
+        code/threshold lanes; raises StringRewriteError when a string
+        construct has no lane form (→ the caller falls back to host)."""
+        if isinstance(e, Compare):
+            ls, rs = self._is_str_var(e.left), self._is_str_var(e.right)
+            lc = isinstance(e.left, Constant) and isinstance(e.left.value,
+                                                             str)
+            rc = isinstance(e.right, Constant) and \
+                isinstance(e.right.value, str)
+            if ls and rs:
+                return self._cmp_var_var(e.left, e.op, e.right)
+            if ls and rc:
+                return self._cmp_var_const(e.left, e.op, e.right.value)
+            if lc and rs:
+                return self._cmp_var_const(e.right, _REFLECT[e.op],
+                                           e.left.value)
+            if ls or rs or lc or rc:
+                raise StringRewriteError(
+                    "string comparison against a non-string/computed side")
+            return Compare(self.rewrite(e.left), e.op,
+                           self.rewrite(e.right))
+        if isinstance(e, IsNull):
+            # `symbol is null` parses as IsNull(stream_id='symbol') — a
+            # bare identifier is stream-or-attribute; in a single-stream
+            # filter a string-attribute name resolves to the attribute
+            target = None
+            if e.expr is not None and self._is_str_var(e.expr):
+                target = e.expr
+            elif e.expr is None and e.stream_id in self.str_attrs and \
+                    e.stream_index is None:
+                target = Variable(attribute=e.stream_id)
+            if target is not None:
+                return Compare(self._var(target), CompareOp.LT,
+                               _num(0.0))
+        if isinstance(e, And):
+            return And(self.rewrite(e.left), self.rewrite(e.right))
+        if isinstance(e, Or):
+            return Or(self.rewrite(e.left), self.rewrite(e.right))
+        if isinstance(e, Not):
+            return Not(self.rewrite(e.expr))
+        if isinstance(e, MathExpr):
+            return MathExpr(e.op, self.rewrite(e.left),
+                            self.rewrite(e.right))
+        if isinstance(e, In):
+            if self._contains_str(e):
+                raise StringRewriteError(
+                    "string table membership has no code lanes")
+            return e
+        if self._is_str_var(e):
+            raise StringRewriteError(
+                f"string attribute '{e.attribute}' outside a comparison")
+        if isinstance(e, AttributeFunction):
+            if self._contains_str(e):
+                raise StringRewriteError(
+                    "string arguments to functions have no code lanes")
+            return e
+        return e
+
+    def _contains_str(self, e) -> bool:
+        if self._is_str_var(e) or (isinstance(e, Constant) and
+                                   isinstance(e.value, str)):
+            return True
+        return any(self._contains_str(x) for x in expr_children(e))
+
+    # ------------------------------------------------------------ encode
+
+    def encode(self, columns: Dict[str, np.ndarray], n: int,
+               n_pad: int) -> Dict[str, np.ndarray]:
+        """Per-chunk lanes: order-preserving codes for each used attr +
+        lo/hi rank thresholds for each constant (all float32 [n_pad])."""
+        cols = {}
+        pools = []
+        per_attr: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        for a in self.used:
+            col = columns.get(a)
+            obj = (np.asarray(col, object) if col is not None
+                   else np.full(n, None, object))
+            none = np.asarray([x is None for x in obj], bool)
+            strs = np.asarray(["" if x is None else x for x in obj])
+            per_attr[a] = (strs, none)
+            if (~none).any():
+                pools.append(strs[~none])
+        uniq = np.unique(np.concatenate(pools)) if pools else \
+            np.zeros(0, "U1")
+        for a, (strs, none) in per_attr.items():
+            codes = np.searchsorted(uniq, strs).astype(np.float32)
+            codes[none] = -1.0
+            lane = np.full(n_pad, -1.0, np.float32)
+            lane[:n] = codes
+            cols[f"__strcode_{a}"] = lane
+        for i, v in enumerate(self.consts):
+            lo = float(np.searchsorted(uniq, v, side="left"))
+            hi = float(np.searchsorted(uniq, v, side="right"))
+            cols[f"__strc{i}_lo"] = np.full(n_pad, lo, np.float32)
+            cols[f"__strc{i}_hi"] = np.full(n_pad, hi, np.float32)
+        return cols

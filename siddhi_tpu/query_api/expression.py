@@ -236,3 +236,15 @@ def walk(expr: Expression):
 
 def variables_of(expr: Expression) -> List[Variable]:
     return [n for n in walk(expr) if isinstance(n, Variable)]
+
+
+def expr_children(e):
+    """Dataclass-field children of an expression node — list AND tuple
+    fields (AttributeFunction.args is a Tuple; a list-only walk silently
+    skips nodes nested in function arguments)."""
+    for f in getattr(e, "__dataclass_fields__", {}):
+        v = getattr(e, f)
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        for x in vs:
+            if hasattr(x, "__dataclass_fields__"):
+                yield x

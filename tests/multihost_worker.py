@@ -9,12 +9,15 @@ import json
 import os
 import sys
 
-# CPU backend with 4 virtual devices, BEFORE jax import (fresh process:
-# the axon hook is skipped because PALLAS_AXON_POOL_IPS is scrubbed by
-# the parent)
-os.environ["JAX_PLATFORMS"] = "cpu"
+# 4 virtual CPU devices: XLA_FLAGS must be set before backend init; the
+# platform itself is forced via jax.config.update below — the env var
+# alone is a no-op in this image (the sitecustomize hook snapshots
+# JAX_PLATFORMS at interpreter start; see tests/conftest.py)
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                            " --xla_force_host_platform_device_count=4")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
@@ -65,7 +68,6 @@ def pack_local(cols, ts, lo, hi):
 def main():
     coord, nproc, pid, out_path = sys.argv[1:5]
     ok = dist.init_distributed(coord, int(nproc), int(pid))
-    import jax
     assert ok and jax.process_count() == int(nproc), \
         f"distributed init failed: {jax.process_count()}"
     assert len(jax.devices()) == 4 * int(nproc), len(jax.devices())

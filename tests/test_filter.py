@@ -135,3 +135,51 @@ def test_cast_convert():
         insert into Out;
     """, [[3]])
     assert got[0].data == [3.0, "3"]
+
+
+def test_string_lane_filter_randomized_parity():
+    """Round 4: string predicates on the device filter path ride per-chunk
+    order-preserving code lanes (plan/str_lanes.py) — randomized parity
+    vs host across ==/!=/order/is-null, nulls included."""
+    import numpy as np
+    from siddhi_tpu import SiddhiManager, StreamCallback
+
+    apps = {
+        "eq":   "s == 'mm'",
+        "neq":  "s != 'mm'",
+        "gt":   "s > 'mm'",
+        "lte":  "s <= 'mm'",
+        "vv":   "s < t",
+        "null": "s is null",
+        "mix":  "(s > 'aa' and s < 'zz') or t == 'mm'",
+    }
+    rng = np.random.default_rng(5)
+    words = np.asarray(["aa", "mm", "zz", "ab", "ya", None], object)
+    n = 200
+    scol = words[rng.integers(0, len(words), n)]
+    tcol = words[rng.integers(0, len(words), n)]
+    vcol = rng.uniform(0, 10, n).astype(np.float32)
+    ts = 1_000_000 + np.arange(n, dtype=np.int64) * 10
+
+    for name, cond in apps.items():
+        app = (f"define stream S (s string, t string, v float);\n"
+               f"@info(name='q') from S[{cond}] select v insert into O;")
+
+        def run(engine):
+            m = SiddhiManager()
+            pre = "@app:playback " + (
+                f"@app:engine('{engine}') " if engine else "")
+            rt = m.create_siddhi_app_runtime(pre + app)
+            got = []
+            rt.add_callback("O", StreamCallback(
+                lambda evs: got.extend(tuple(e.data) for e in evs)))
+            rt.start()
+            rt.get_input_handler("S").send_batch(
+                {"s": scol, "t": tcol, "v": vcol}, timestamps=ts)
+            b = rt.query_runtimes["q"].backend
+            rt.shutdown()
+            return b, got
+        bd, dev = run(None)
+        bh, host = run("host")
+        assert bd == "device" and bh == "host", (name, bd)
+        assert dev == host, (name, len(dev), len(host))

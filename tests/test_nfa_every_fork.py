@@ -196,3 +196,44 @@ def test_within_expiry_self_forward_dies_not_crashes():
     expect = [(1400, (1.0, 6.0)), (1900, (1.0, 7.0))]
     assert [(t, (round(a, 2), round(b, 2))) for t, (a, b) in dev] == expect
     assert dev == host
+
+
+def test_string_order_vs_constant_compiles():
+    """Round 4: `s > 'A'` lowers onto a host-computed 0/1 lane the device
+    condition reads — order-vs-constant string predicates compile."""
+    app = """define stream A (s string, v float);
+    @info(name='q')
+    from every e1=A[s > 'bbb'] -> e2=A[v > e1.v and s <= 'bbb']
+    select e1.s as a, e1.v as x, e2.s as b insert into Out;"""
+    import numpy as np
+    rng = np.random.default_rng(3)
+    words = ["aaa", "abc", "bbb", "bcd", "ccc", "zzz"]
+    rows = []
+    ts = 1_000_000
+    for _ in range(60):
+        ts += int(rng.integers(1, 300))
+        rows.append(([words[int(rng.integers(0, len(words)))],
+                      float(np.float32(rng.uniform(0, 10)))], ts))
+    m_rows = [([r[0], r[1]], t) for (r, t) in rows]
+    from siddhi_tpu import QueryCallback, SiddhiManager
+
+    def go(engine):
+        m = SiddhiManager()
+        pre = "@app:playback " + (f"@app:engine('{engine}') " if engine
+                                  else "")
+        rt = m.create_siddhi_app_runtime(pre + app)
+        got = []
+        rt.add_callback("q", QueryCallback(
+            lambda _ts, cur, exp: got.extend(tuple(e.data)
+                                             for e in (cur or []))))
+        rt.start()
+        h = rt.get_input_handler("A")
+        for row, t in m_rows:
+            h.send(row, timestamp=t)
+        b = rt.query_runtimes["q"].backend
+        rt.shutdown()
+        return b, got
+    bd, dev = go(None)
+    bh, host = go("host")
+    assert bd == "device" and bh == "host"
+    assert dev == host and dev

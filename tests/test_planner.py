@@ -78,12 +78,13 @@ def test_backend_identical_output(name, app, sends):
 
 def test_unsupported_shapes_fall_back_with_reason():
     cases = {
-        # string equality/captures are dictionary-encoded onto the device
-        # (test_tpu_strings.py); ORDER comparisons on strings stay host-only
-        "string_order_compare": """
+        # string equality/captures are dictionary-encoded and ORDER-vs-
+        # constant lowers onto host-computed 0/1 lanes (round 4);
+        # CROSS-STATE string order stays host-only (codes carry no order)
+        "string_order_cross_state": """
             define stream A (s string, v float);
             @info(name='q')
-            from every e1=A[s > 'A'] -> e2=A[v > e1.v]
+            from every e1=A[v > 0.0] -> e2=A[s > e1.s]
             select e1.v as v1, e2.v as v2 insert into Out;
         """,
         "nested_every": """
@@ -137,7 +138,7 @@ def test_engine_device_mode_raises_on_unsupported():
             @app:engine('device')
             define stream A (s string, v float);
             @info(name='q')
-            from every e1=A[s > 'A'] -> e2=A[v > e1.v]
+            from every e1=A[v > 0.0] -> e2=A[s > e1.s]
             select e1.v as v1 insert into Out;
         """)
 
@@ -391,7 +392,9 @@ def test_filter_select_star_device():
     assert dev == [("A", 11.0)]
 
 
-def test_filter_string_condition_falls_back():
+def test_filter_string_condition_compiles_to_device():
+    # round 4: string predicates lower onto per-chunk order-preserving
+    # code lanes (plan/str_lanes.py) — ==/!=/order/is-null compile
     app = """
         define stream S (symbol string, price float);
         @info(name='q')
@@ -400,7 +403,7 @@ def test_filter_string_condition_falls_back():
     m = SiddhiManager()
     rt = m.create_siddhi_app_runtime(app)
     qr = rt.query_runtimes["q"]
-    assert qr.backend == "host" and qr.backend_reason
+    assert qr.backend == "device"
     rt.shutdown()
 
 

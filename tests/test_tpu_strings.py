@@ -64,11 +64,17 @@ def test_string_not_equal_parity():
     assert host == dev and len(host) > 0
 
 
-def test_string_order_compare_falls_back():
+def test_string_order_vs_constant_compiles_cross_state_falls_back():
+    # round 4: order-vs-constant lowers onto a host-computed 0/1 lane
     app = APP.replace("symbol == 'IBM'", "symbol > 'A'")
-    bd, reason, _ = run(app, SENDS)
-    assert bd == "host"
-    assert "==/!=" in (reason or "")
+    bd, _reason, dev = run(app, SENDS)
+    bh, _r2, host = run(app, SENDS, engine="host")
+    assert bd == "device" and bh == "host" and dev == host
+    # cross-state string ORDER still has no lane form
+    app2 = APP.replace("symbol == 'IBM'", "symbol > 'Z'").replace(
+        "price > e1.price", "price > e1.price and symbol > e1.symbol")
+    bd2, reason2, _ = run(app2, SENDS)
+    assert bd2 == "host" and "ORDER" in (reason2 or "")
 
 
 def test_string_function_falls_back():
