@@ -390,6 +390,9 @@ class QueryRuntime:
     def start(self):
         if self.state_runtime is not None:
             self.state_runtime.start()
+        if self.device_runtime is not None and \
+                hasattr(self.device_runtime, "start"):
+            self.device_runtime.start()
 
     # ------------------------------------------------------------ callbacks
 

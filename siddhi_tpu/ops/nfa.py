@@ -101,6 +101,13 @@ class NfaSpec(NamedTuple):
     eps_start: bool = False           # leading min-0 kleene: unit 1 is an
     #                                   alternate start state (empty-kleene
     #                                   path), see _one_partition_step
+    lead_absent: bool = False         # `not A for t -> ...`: the start
+    #                                   state is an absent unit — a partial
+    #                                   with a deadline is kept armed at
+    #                                   unit 0 (ensure-arm; arrivals kill +
+    #                                   re-arm with a fresh deadline), the
+    #                                   reference's AbsentStreamPreState
+    #                                   Processor start/init/re-init loop
 
     @property
     def n_states(self) -> int:
@@ -405,6 +412,35 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
             expired = expired & ~((s.st == 1) & (s.cnt_prev == 0))
         s.st = jnp.where(expired, -1, s.st)
 
+    # ---- leading absent ensure-arm: the oracle re-initializes the start
+    # absent partial whenever its pending list is empty (absent_tick
+    # initialize + init_start), so exactly one partial waits at unit 0
+    # with a live deadline; arrivals below kill + re-arm it in place
+    if spec.lead_absent:
+        # REAL events only: the oracle's ticks stop after a successful
+        # confirmation until an arrival (or fresh scheduling) restarts
+        # them — re-arming on an injected TIMER row would chain
+        # confirmations the reference never produces
+        have0 = jnp.any(s.st == 0)
+        want0 = valid & (stream != -2) & ~have0
+        free0 = (s.st < 0) & ~s.m_mask
+        armed0 = (want0 & jnp.any(free0)) & \
+            (jnp.arange(K) == jnp.argmax(free0))
+        s.clear_slot(armed0)
+        s.st = jnp.where(armed0, 0, s.st)
+        s.deadline = jnp.where(armed0, ts + spec.units[0].waiting_ms,
+                               s.deadline)
+        s.start = jnp.where(armed0, ts, s.start)
+        s.enter = jnp.where(armed0, ts, s.enter)
+        s.seq = jnp.where(armed0, s.arm_seq, s.seq)
+        s.arm_seq = s.arm_seq + jnp.where(jnp.any(armed0), 1, 0)
+        if s.lmask is not None:
+            s.lmask = jnp.where(armed0, 0, s.lmask)
+        if s.cnt_cur is not None:
+            s.cnt_cur = jnp.where(armed0, 0, s.cnt_cur)
+            s.cnt_prev = jnp.where(armed0, -1, s.cnt_prev)
+        s.dropped = s.dropped + jnp.where(want0 & ~jnp.any(free0), 1, 0)
+
     # ---- SEQUENCE early deadline pass: the playback scheduler fires a
     # deadline that coincides with (or precedes) an event's timestamp
     # BEFORE that event stabilizes the sequence — a due `not … for t`
@@ -527,7 +563,16 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
             # an actual arrival on the `not` stream kills the partial
             # (AbsentStreamPostStateProcessor: never advances)
             kill = at & (stream == u.stream_a) & conds[u.cond_a]
-            s.st = jnp.where(kill, -1, s.st)
+            if j == 0 and spec.lead_absent:
+                # leading absent: the kill re-arms in place with a fresh
+                # deadline (oracle add_every_state on arrival — the wait
+                # restarts from the arrival)
+                s.deadline = jnp.where(kill, ts + u.waiting_ms,
+                                       s.deadline)
+                s.start = jnp.where(kill, ts, s.start)
+                s.enter = jnp.where(kill, ts, s.enter)
+            else:
+                s.st = jnp.where(kill, -1, s.st)
 
     # ---- live-append phase: a forwarded count keeps growing its last
     # bank while the next unit is pending (the reference shares one

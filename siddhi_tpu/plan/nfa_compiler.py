@@ -227,8 +227,8 @@ class _Lowering:
         units = self.units
         if not units:
             _reject("empty pattern")
-        if units[0].kind == "absent":
-            _reject("leading absent states are host-only")
+        # leading absent compiles for PATTERN mode (kernel ensure-arm /
+        # kill-rearm); CompiledPatternNFA rejects the SEQUENCE case
         self.eps_start = False
         if units[0].kind == "count" and units[0].min_count == 0:
             # leading min-0 kleene: the start partial lives at unit 1 with
@@ -328,6 +328,8 @@ class CompiledPatternNFA:
         low = _Lowering(sis, app)
         self.units = low.units
         self.is_sequence = sis.state_type == StateType.SEQUENCE
+        if self.units[0].kind == "absent" and self.is_sequence:
+            _reject("leading absent states in a sequence are host-only")
         if low.eps_start and self.is_sequence and low.is_every:
             # the oracle's shared start partial can sit in the count's
             # pending list while BLOCKED from the successor's (another
@@ -561,7 +563,8 @@ class CompiledPatternNFA:
             every_group_end=low.every_group_end,
             tail_every_start=low.tail_every_start,
             mid_every=tuple(low.mid_every),
-            eps_start=low.eps_start)
+            eps_start=low.eps_start,
+            lead_absent=self.units[0].kind == "absent")
         self.has_absent = any(u.kind == "absent" for u in self.units)
         from ..parallel.mesh import auto_mesh, round_up_partitions
         self.mesh = auto_mesh() if isinstance(mesh, str) and mesh == "auto" \
@@ -1186,6 +1189,29 @@ class CompiledPatternNFA:
                 col = out
             cols[name] = col
         return pids, ts, cols
+
+    def arm_leading(self, now_ms: int) -> None:
+        """Arm the initial leading-absent partial at engine start
+        (reference AbsentStreamPreStateProcessor.start + init): one slot
+        per lane at unit 0 with deadline = start + waiting.  Host-side
+        carry mutation (startup only)."""
+        if not self.spec.lead_absent:
+            return
+        if self.base_ts is None:
+            self.base_ts = now_ms
+        c = {k: np.asarray(v).copy() for k, v in self.carry.items()}
+        off = now_ms - self.base_ts
+        empty = c["slot_state"][:, 0] < 0
+        c["slot_state"][:, 0] = np.where(empty, 0, c["slot_state"][:, 0])
+        c["deadline"][:, 0] = np.where(
+            empty, off + self.spec.units[0].waiting_ms,
+            c["deadline"][:, 0])
+        c["slot_start"][:, 0] = np.where(empty, off, c["slot_start"][:, 0])
+        c["slot_enter"][:, 0] = np.where(empty, off, c["slot_enter"][:, 0])
+        c["slot_seq"][:, 0] = np.where(empty, c["arm_seq"],
+                                       c["slot_seq"][:, 0])
+        c["arm_seq"] = c["arm_seq"] + empty.astype(np.int32)
+        self.carry = self._place_carry(c)
 
     def process_timer(self, now_ms: int):
         """Inject one virtual TIMER row at absolute time now_ms (absent

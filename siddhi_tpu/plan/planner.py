@@ -381,7 +381,16 @@ class DevicePatternRuntime:
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> None:
-        pass
+        if self.nfa.spec.lead_absent and not self.keyed:
+            # the leading absent partial waits from ENGINE START
+            # (reference AbsentStreamPreStateProcessor.start).  Keyed
+            # lanes arm on their FIRST event instead (kernel ensure-arm)
+            # — the oracle's per-key clone is created on first sight of
+            # the key, so its wait starts there too
+            now = self.qr.app_runtime.app_ctx.timestamp_generator \
+                .current_time()
+            self.nfa.arm_leading(now)
+            self._schedule_absent()
 
     def shutdown(self) -> None:
         self.flush()

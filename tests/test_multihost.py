@@ -86,3 +86,29 @@ def test_two_process_distributed_matches_single_process(tmp_path):
             bs["per_partition"]
     # the workload actually matched something
     assert sum(b["stats"]["matches"] for b in rs["blocks"]) > 0
+
+
+def test_single_device_absent_semantics(tmp_path):
+    """The conftest mesh can mask single-device NFA bugs (round 4: a
+    leading-absent TIMER re-arm chained confirmations only when mesh is
+    None — the real-TPU flavor).  Run the leading-absent conformance
+    shapes in a fresh 1-device CPU process."""
+    code = """
+import sys
+sys.path.insert(0, {repo!r}); sys.path.insert(0, {tests!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 1
+import test_ref_pattern_absent as t
+t.test_absent_5_leading_quiet_then_match()
+t.test_absent_6_leading_reset_by_arrival()
+t.test_absent_8_leading_arrival_then_quick_e2()
+t.test_absent_18_leading_rearmed_after_arrival()
+t.test_absent_24_two_absents()
+print("OK")
+""".format(repo=os.path.dirname(HERE), tests=HERE)
+    env = _scrubbed_env()
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, timeout=240)
+    assert p.returncode == 0 and b"OK" in p.stdout, \
+        p.stderr.decode()[-2000:]

@@ -93,11 +93,11 @@ def test_unsupported_shapes_fall_back_with_reason():
             from e1=A[v > 0.0] -> every (every e2=A[v > e1.v])
             select e1.v as v1, e2.v as v2 insert into Out;
         """,
-        "leading_absent": """
+        "leading_absent_sequence": """
             define stream A (v float);
             define stream B (w float);
             @info(name='q')
-            from not B[w > 0.0] for 1 sec -> e2=A[v > 0.0]
+            from not B[w > 0.0] for 1 sec, e2=A[v > 0.0]
             select e2.v as v2 insert into Out;
         """,
         "logical_absent_side": """
