@@ -101,6 +101,15 @@ class NfaSpec(NamedTuple):
     eps_start: bool = False           # leading min-0 kleene: unit 1 is an
     #                                   alternate start state (empty-kleene
     #                                   path), see _one_partition_step
+    n_last: Tuple[int, ...] = ()      # per row: #lanes in the last bank
+    idx_banks: Tuple = ()             # per row: ((k, start, len), ...) —
+    #                                   e[k] banks, written when the kleene
+    #                                   chain reaches k+1 elements
+    lastk_banks: Tuple = ()           # per row: ((j, start), ...) — e[last-j]
+    #                                   banks, shift chain behind the last
+    #                                   bank on every append
+    m_src: Tuple = ()                 # per row: last-bank source lanes for
+    #                                   the shift chain (lane-aligned)
     lead_absent: bool = False         # `not A for t -> ...`: the start
     #                                   state is an absent unit — a partial
     #                                   with a deadline is kept armed at
@@ -322,7 +331,9 @@ class _StepState:
 
     def write_count(self, pred_first, pred_last, row: int, ev_rows, new_n):
         """Count-row append: first bank on the first append, last bank +
-        __n lane on every append."""
+        __n lane on every append; e[last-j] banks shift behind the last
+        bank (deepest first, BEFORE the new value lands) and e[k] banks
+        capture the append that brings the chain to k+1 elements."""
         if row < 0:
             return
         spec = self.spec
@@ -331,15 +342,39 @@ class _StepState:
         nf = spec.n_first[row]
         first_lanes = lane < nf
         nl = spec.n_lane[row]
-        last_lanes = (lane >= nf) & ((lane != nl) if nl >= 0 else True)
+        n_l = spec.n_last[row] if spec.n_last else 0
+        last_lanes = (lane >= nf) & (lane < nf + n_l) & \
+            ((lane != nl) if nl >= 0 else True)
         row_sel = (jnp.arange(R)[None, :, None] == row)
         ev = ev_rows[row][None, None, :]
+        mb = spec.lastk_banks[row] if spec.lastk_banks else ()
+        src = spec.m_src[row] if spec.m_src else ()
+        if mb and src:
+            L = len(src)
+            starts = {j: st for (j, st) in mb}
+            for j, start in sorted(mb, reverse=True):
+                src_lanes = np.asarray(
+                    src if j == 1
+                    else range(starts[j - 1], starts[j - 1] + L),
+                    np.int32)
+                dst_lanes = np.asarray(range(start, start + L), np.int32)
+                vals = self.caps[:, row, src_lanes]
+                cur = self.caps[:, row, dst_lanes]
+                self.caps = self.caps.at[:, row, dst_lanes].set(
+                    jnp.where(pred_last[:, None], vals, cur))
         self.caps = jnp.where(
             pred_first[:, None, None] & row_sel & first_lanes[None, None, :],
             ev, self.caps)
         self.caps = jnp.where(
             pred_last[:, None, None] & row_sel & last_lanes[None, None, :],
             ev, self.caps)
+        for (k, start, ln) in (spec.idx_banks[row]
+                               if spec.idx_banks else ()):
+            predk = pred_last & (new_n == k + 1)
+            sel = (lane >= start) & (lane < start + ln)
+            self.caps = jnp.where(
+                predk[:, None, None] & row_sel & sel[None, None, :],
+                ev, self.caps)
         if nl >= 0:
             nsel = pred_last[:, None, None] & row_sel & \
                 (lane == nl)[None, None, :]

@@ -330,13 +330,15 @@ class CompiledPatternNFA:
         self.is_sequence = sis.state_type == StateType.SEQUENCE
         if self.units[0].kind == "absent" and self.is_sequence:
             _reject("leading absent states in a sequence are host-only")
-        if low.eps_start and self.is_sequence and low.is_every:
-            # the oracle's shared start partial can sit in the count's
-            # pending list while BLOCKED from the successor's (another
-            # chain occupies it) — a membership split the one-state slot
-            # encoding cannot represent; only reachable with every+SEQ
-            _reject("leading min-0 kleene in an `every` sequence is "
-                    "host-only")
+        if self.is_sequence and self.units[0].kind == "count":
+            # the oracle's sequence leading-accumulator semantics (shared
+            # chain re-init/re-arm against the strict barrier) diverge
+            # from the slot model on adversarial data — verified for both
+            # every and non-every shapes (review r4: a device chain can
+            # match a closing event the oracle's barrier already killed);
+            # the whole family stays host
+            _reject("a leading kleene in a SEQUENCE is host-only "
+                    "(accumulator/barrier semantics diverge)")
         is_every = low.is_every
         within_ms = sis.within_ms
         if low.group_within is not None:
@@ -403,16 +405,36 @@ class CompiledPatternNFA:
         # ---- scan filters + select for cross-state references
         needed_f: List[set] = [set() for _ in rows]
         needed_l: List[set] = [set() for _ in rows]
+        needed_idx: List[dict] = [{} for _ in rows]     # k -> attrs
+        needed_lastk: List[dict] = [{} for _ in rows]   # j -> attrs
 
-        def which_of(var: Variable, row: int) -> str:
+        def which_of(var: Variable, row: int,
+                     select_ctx: bool = False) -> str:
             si = var.stream_index
             unit = self.units[self.row_unit[row]]
             if si is None or si == 0:
                 return "f"
             if si == -1:
                 return "l" if unit.kind == "count" else "f"
-            _reject(f"only e[0]/e[last] capture indexing is supported "
-                    f"(got index {si})")
+            if unit.kind != "count":
+                _reject(f"indexing into a non-kleene capture "
+                        f"(got index {si})")
+            if not select_ctx:
+                # conditions read per-slot capture lanes at trace time —
+                # only first/last banks exist there
+                _reject("indexed kleene captures in CONDITIONS are "
+                        "host-only (select-side e[k]/e[last-k] compile)")
+            # select-side arbitrary indexing: each referenced index gets
+            # its own capture bank (written when the chain reaches it /
+            # shifted behind the last bank — ops/nfa.write_count)
+            if si >= 1:
+                if si > 30:
+                    _reject(f"capture index {si} exceeds the bank budget")
+                return f"i{si}"
+            j = -si - 1                  # last-j  (si = -(j+1))
+            if j > 30:
+                _reject(f"capture index last-{j} exceeds the bank budget")
+            return f"m{j}"
 
         def note(var: Variable, current_side: Optional[_Side]):
             if var.stream_id is None:
@@ -431,6 +453,10 @@ class CompiledPatternNFA:
                 if var.stream_index not in (None, 0) or \
                         self.units[self.row_unit[side.row]].kind == "count" \
                         and var.stream_index is not None:
+                    # (an e[last] self-ref ≈ the appending event under the
+                    # oracle's append-then-filter, but the live-append /
+                    # barrier interplay diverges in chained shapes —
+                    # verified; whole family stays host)
                     _reject("self-indexed references inside a kleene "
                             "condition are host-only")
                 return              # binds to the current event
@@ -474,8 +500,20 @@ class CompiledPatternNFA:
             if e.attribute not in self.attr_types:
                 _reject(f"selected attribute "
                         f"'{e.stream_id}.{e.attribute}' is not numeric")
-            w = which_of(e, side.row)
-            (needed_f if w == "f" else needed_l)[side.row].add(e.attribute)
+            w = which_of(e, side.row, select_ctx=True)
+            if w == "f":
+                needed_f[side.row].add(e.attribute)
+            elif w == "l":
+                needed_l[side.row].add(e.attribute)
+            elif w.startswith("i"):
+                needed_idx[side.row].setdefault(int(w[1:]),
+                                                set()).add(e.attribute)
+            else:
+                needed_lastk[side.row].setdefault(int(w[1:]),
+                                                  set()).add(e.attribute)
+                # last-j shifts source from the LAST bank: its attrs must
+                # ride there too
+                needed_l[side.row].add(e.attribute)
             self.select_outputs.append((oa.rename, side.row, e.attribute, w))
 
         # ---- lane layout per row: first bank ++ last bank ++ meta lanes
@@ -484,6 +522,10 @@ class CompiledPatternNFA:
         n_lane: List[int] = []
         matched_lane: List[int] = []
         self.cap_lane: Dict[Tuple[int, str, str], int] = {}
+        idx_banks: List[Tuple] = []      # per row: ((k, start, len), ...)
+        lastk_banks: List[Tuple] = []    # per row: ((j, start), ...)
+        m_src: List[Tuple[int, ...]] = []  # per row: l-bank source lanes
+        n_last: List[int] = []
         for r, side in enumerate(rows):
             unit = self.units[self.row_unit[r]]
             fcols = sorted(needed_f[r])
@@ -497,6 +539,32 @@ class CompiledPatternNFA:
                 self.cap_lane[(r, a, "l")] = len(fcols) + lane
                 if a not in fcols:
                     self.cap_lane[(r, a, "f")] = len(fcols) + lane
+            n_last.append(len(lcols))
+            # absolute-index banks e[k]: written when the chain reaches
+            # k+1 elements
+            row_ib = []
+            for k in sorted(needed_idx[r]):
+                attrs = sorted(needed_idx[r][k])
+                start = len(cols)
+                for lane, a in enumerate(attrs):
+                    self.cap_lane[(r, a, f"i{k}")] = start + lane
+                cols += attrs
+                row_ib.append((k, start, len(attrs)))
+            idx_banks.append(tuple(row_ib))
+            # last-k banks: all share the union attr set (lane-aligned
+            # shift chain m_j <- m_{j-1} <- last bank)
+            um = sorted(set().union(*needed_lastk[r].values())) \
+                if needed_lastk[r] else []
+            row_mb = []
+            max_j = max(needed_lastk[r], default=0)
+            for j in range(1, max_j + 1):
+                start = len(cols)
+                for lane, a in enumerate(um):
+                    self.cap_lane[(r, a, f"m{j}")] = start + lane
+                cols += [f"__m{j}_{a}" for a in um]
+                row_mb.append((j, start))
+            lastk_banks.append(tuple(row_mb))
+            m_src.append(tuple(len(fcols) + lcols.index(a) for a in um))
             if unit.kind == "count":
                 n_lane.append(len(cols))
                 cols.append("__n")
@@ -516,6 +584,10 @@ class CompiledPatternNFA:
         # become per-pattern lanes fed through the event dict
         self._param_map: Dict[int, str] = {}
         self.param_names: List[str] = []
+        if parameterize and any(w[0] in "im"
+                                for (_n, _r, _a, w) in self.select_outputs):
+            _reject("indexed kleene selects ride extra capture banks the "
+                    "bank ring decode does not gate — not parameterizable")
         if parameterize:
             for j, c in enumerate(_walk_filter_constants(self.units)):
                 name = f"__param_{j}"
@@ -564,7 +636,9 @@ class CompiledPatternNFA:
             tail_every_start=low.tail_every_start,
             mid_every=tuple(low.mid_every),
             eps_start=low.eps_start,
-            lead_absent=self.units[0].kind == "absent")
+            lead_absent=self.units[0].kind == "absent",
+            n_last=tuple(n_last), idx_banks=tuple(idx_banks),
+            lastk_banks=tuple(lastk_banks), m_src=tuple(m_src))
         self.has_absent = any(u.kind == "absent" for u in self.units)
         from ..parallel.mesh import auto_mesh, round_up_partitions
         self.mesh = auto_mesh() if isinstance(mesh, str) and mesh == "auto" \
@@ -705,6 +779,7 @@ class CompiledPatternNFA:
         if (self.encoded_attrs or self.derived) and parameterize:
             _reject("string conditions are not parameterizable "
                     "(pattern-bank mode lowers constants to float lanes)")
+
         for a in sorted(self.encoded_attrs):
             self.attr_names.append(a)
             self.attr_types[a] = AttrType.LONG
@@ -1126,6 +1201,10 @@ class CompiledPatternNFA:
                 if caps_row[row, vlane] <= 0:
                     vals[name] = None
                     continue
+            if which[0] in "im" and self._n_lane[row] >= 0 and \
+                    caps_row[row, self._n_lane[row]] < int(which[1:]) + 1:
+                vals[name] = None
+                continue
             lane = self.cap_lane[(row, attr, which)]
             v = float(caps_row[row, lane])
             at = self.attr_types.get(attr)
@@ -1169,6 +1248,13 @@ class CompiledPatternNFA:
                 vlane = self._n_lane[row] if self._n_lane[row] >= 0 \
                     else self._matched_lane[row]
                 null_mask = caps_f[:, row, vlane] <= 0
+            if which[0] in "im" and self._n_lane[row] >= 0:
+                # e[k] valid iff the chain reached k+1 elements;
+                # e[last-j] valid iff it reached j+1
+                need = int(which[1:]) + 1
+                short = caps_f[:, row, self._n_lane[row]] < need
+                null_mask = short if null_mask is None \
+                    else (null_mask | short)
             if attr in self.encoded_attrs:
                 codes = np.rint(v).astype(np.int64)
                 out = np.full(n, None, object)

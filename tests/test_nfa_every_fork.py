@@ -131,11 +131,15 @@ def test_leading_min0_empty_match():
     assert out == [(1000, (None, pytest.approx(8.1)))]
 
 
-def test_leading_min0_sequence_nonevery():
+def test_leading_min0_sequence_nonevery_falls_back():
+    # part of the sequence leading-kleene family (host-only, review r4)
     app = A + """@info(name='q')
     from e1=A[v < 3.0]<0:2>, e2=A[v > 5.0]
     select e1[0].v as a, e2.v as b insert into Out;"""
-    parity(app, gen(12, n=40))
+    rows = gen(12, n=40)
+    host = run(app, rows, engine="host", expect_backend="host")
+    auto = run(app, rows, expect_backend="host")
+    assert auto == host
 
 
 def test_leading_min0_every_sequence_falls_back():
@@ -237,3 +241,30 @@ def test_string_order_vs_constant_compiles():
     bh, host = go("host")
     assert bd == "device" and bh == "host"
     assert dev == host and dev
+
+
+def test_indexed_kleene_selects():
+    """Round 4: e[k] / e[last-k] SELECT indexing rides dedicated capture
+    banks (absolute banks written at chain length k+1; last-k banks shift
+    behind the last bank) — parity incl. out-of-range None decode."""
+    app = A + """@info(name='q')
+    from every e1=A[v < 5.0]<2:6> -> e2=A[v > 8.0]
+    select e1[0].v as a, e1[1].v as b, e1[3].v as c, e1[last].v as d,
+           e1[last-1].v as e, e1[last-2].v as f, e2.v as g
+    insert into Out;"""
+    parity(app, gen(30, n=80))
+
+
+def test_leading_kleene_sequence_falls_back():
+    """Review r4: the sequence leading-accumulator family diverges from
+    the oracle on adversarial data (every AND non-every) — whole family
+    host-only, parity by fallback."""
+    for head in ("every e1=A[v < 9.0]<2:6>", "e1=A[v < 9.0]<2:6>"):
+        app = A + f"""@info(name='q')
+        from {head}, e2=A[v > 8.0]
+        select e1[1].v as b, e2.v as g insert into Out;"""
+        for seed in (13, 29):
+            rows = gen(seed, n=80)
+            host = run(app, rows, engine="host", expect_backend="host")
+            auto = run(app, rows, expect_backend="host")
+            assert auto == host
