@@ -246,7 +246,7 @@ class QueryRuntime:
         windows (one tiny device state per key would serialize)."""
         app = self.app_runtime
         if self.partition_key is not None or \
-                getattr(app, "app", None) is None or h.namespace:
+                getattr(app, "app", None) is None:
             return None
         from ..plan.dwin_compiler import (DEVICE_KINDS,
                                           DeviceWindowProcessor)
@@ -255,8 +255,15 @@ class QueryRuntime:
         if mode == "host":
             return None
         kind = next((k for k in DEVICE_KINDS
-                     if k.lower() == h.name.lower()), None)
+                     if k.lower() == h.name.lower()), None) \
+            if not h.namespace else None
         if kind is None:
+            if mode == "device":
+                # engine('device') is strict: no silent host fallback
+                label = (f"#{h.namespace}:{h.name}" if h.namespace
+                         else f"#window.{h.name}")
+                raise SiddhiAppCreationError(
+                    f"device window path: {label} has no device kernel")
             return None
         try:
             wp = DeviceWindowProcessor(app.app_ctx, definition, kind,

@@ -93,6 +93,14 @@ class QuerySelector(Processor):
         else:
             for oa in selector.attributes:
                 ce = compiler.compile(oa.expr)
+                if oa.rename in self.out_names:
+                    # reference DuplicateAttributeException
+                    # (SelectorParser): columnar output would silently
+                    # overwrite the earlier column
+                    from ..utils.errors import SiddhiAppCreationError
+                    raise SiddhiAppCreationError(
+                        f"Duplicate output attribute '{oa.rename}' in "
+                        "select (use 'as' to alias)")
                 self.out_exprs.append(ce)
                 self.out_names.append(oa.rename)
                 out_attrs.append(Attribute(oa.rename, ce.type))

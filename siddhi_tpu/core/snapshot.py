@@ -113,10 +113,10 @@ class SnapshotService:
 
     # ------------------------------------------------------------ snapshot
 
-    def full_snapshot(self) -> bytes:
+    def full_snapshot(self, flush: bool = True) -> bytes:
         """ThreadBarrier-locked capture of every element's state
         (reference SnapshotService.fullSnapshot:97-158)."""
-        if self.pre_snapshot is not None:
+        if flush and self.pre_snapshot is not None:
             self.pre_snapshot()
         barrier = self.app_ctx.thread_barrier
         barrier.lock()
@@ -142,11 +142,11 @@ class SnapshotService:
         finally:
             barrier.unlock()
 
-    def incremental_snapshot(self) -> bytes:
+    def incremental_snapshot(self, flush: bool = True) -> bytes:
         """Only elements whose state changed since the last persisted
         snapshot (full or incremental)."""
         import hashlib
-        if self.pre_snapshot is not None:
+        if flush and self.pre_snapshot is not None:
             self.pre_snapshot()
         barrier = self.app_ctx.thread_barrier
         barrier.lock()
@@ -180,16 +180,24 @@ class SnapshotService:
         """Full revisions end `_full`; incremental deltas end `_inc` and are
         replayed on top of the latest full base at restore (reference
         IncrementalFileSystemPersistenceStore revision chains)."""
-        now = int(time.time() * 1000)
-        if incremental and self._last_digest:
-            revision = f"{now}_{app_name}_inc"
-            store.save(app_name, revision, self.incremental_snapshot())
-        else:
-            revision = f"{now}_{app_name}_full"
-            snap = self.full_snapshot()
-            self._mark_digests(snap)
-            store.save(app_name, revision, snap)
-        return revision
+        # Flush BEFORE taking the lock: pre_snapshot waits on junction
+        # flush barriers, and a worker-callback persist() blocked on the
+        # lock would never consume its barrier copy (deadlock cycle:
+        # lock-holder waits on worker, worker waits on lock).
+        if self.pre_snapshot is not None:
+            self.pre_snapshot()
+        with self._lock:      # serialize concurrent persist callers
+            now = int(time.time() * 1000)
+            if incremental and self._last_digest:
+                revision = f"{now}_{app_name}_inc"
+                store.save(app_name, revision,
+                           self.incremental_snapshot(flush=False))
+            else:
+                revision = f"{now}_{app_name}_full"
+                snap = self.full_snapshot(flush=False)
+                self._mark_digests(snap)
+                store.save(app_name, revision, snap)
+            return revision
 
     def restore_revision(self, app_name: str, store: PersistenceStore,
                          revision: str):

@@ -119,6 +119,7 @@ class StreamJunction:
         self._worker_threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self._drain = threading.Event()
+        self._flush_lock = threading.Lock()
         self._configure_from_annotations()
 
     @property
@@ -247,15 +248,25 @@ class StreamJunction:
         compile."""
         q = self._queue
         workers = list(self._worker_threads)
+        if threading.current_thread() in workers:
+            # a worker calling flush() from inside its own delivery (e.g.
+            # persist() from a callback) would wait forever for its own
+            # barrier copy — its in-hand delivery IS finished from the
+            # caller's perspective, so flush receivers directly
+            self._flush_receivers()
+            return
         if self.is_async and q is not None and workers and \
                 not self._drain.is_set():
-            b = _FlushBarrier(len(workers))
-            for _ in workers:
-                q.put(b)
-            while not b.done.wait(timeout=1.0):
-                if not any(t.is_alive() for t in workers):
-                    self._flush_receivers()   # stop() won the race
-                    return
+            # serialize concurrent flushes: two barriers' copies
+            # interleaved across workers would stall both rendezvous
+            with self._flush_lock:
+                b = _FlushBarrier(len(workers))
+                for _ in workers:
+                    q.put(b)
+                while not b.done.wait(timeout=1.0):
+                    if not any(t.is_alive() for t in workers):
+                        self._flush_receivers()   # stop() won the race
+                        return
         else:
             self._flush_receivers()
 

@@ -117,6 +117,13 @@ class NfaSpec(NamedTuple):
     #                                   re-arm with a fresh deadline), the
     #                                   reference's AbsentStreamPreState
     #                                   Processor start/init/re-init loop
+    dead_start: bool = False          # SEQUENCE leading kleene min >= 2:
+    #                                   the per-event barrier clears every
+    #                                   pending list and CountPost only
+    #                                   re-adds at cnt >= min, so a sub-min
+    #                                   accumulator never survives — the
+    #                                   shape produces ZERO matches (oracle
+    #                                   verified); arming is suppressed
 
     @property
     def n_states(self) -> int:
@@ -162,6 +169,12 @@ def make_carry(spec: NfaSpec, n_partitions: int) -> Dict[str, jnp.ndarray]:
     if _has(spec, "count"):
         carry["cnt_cur"] = jnp.zeros((P, K), jnp.int32)
         carry["cnt_prev"] = jnp.full((P, K), -1, jnp.int32)
+    if spec.eps_start and spec.is_sequence:
+        # 1 when the leading kleene froze at max on the previous event:
+        # the oracle's fresh virgin then finds the next unit's new-list
+        # still holding the frozen partial and is closer-blocked for its
+        # creation event (CountPre addState SEQUENCE empty-list guard)
+        carry["seq_froze"] = jnp.zeros((P,), jnp.int32)
     if _has(spec, "logical"):
         carry["lmask"] = jnp.zeros((P, K), jnp.int32)
     if _has(spec, "absent"):
@@ -201,6 +214,7 @@ class _StepState:
         self.dropped = carry["dropped"]
         self.cnt_cur = carry.get("cnt_cur")
         self.cnt_prev = carry.get("cnt_prev")
+        self.seq_froze = carry.get("seq_froze")
         self.lmask = carry.get("lmask")
         self.deadline = carry.get("deadline")
         self.armed_total = carry.get("armed_total")
@@ -514,7 +528,14 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
         # max) — the reference start partial sits in BOTH the count's and
         # the successor's pending lists, never duplicated; re-init only
         # after it advances out
-        have = jnp.any(s.st == 1)
+        if spec.is_sequence:
+            # the oracle re-inits whenever the start's new-list is empty:
+            # a LIVE chain (appending, cnt_prev >= 0) occupies it, a
+            # frozen-at-max chain (cnt_prev == -1) does not — the frozen
+            # partial keeps waiting at unit 1 while a fresh virgin arms
+            have = jnp.any((s.st == 1) & (s.cnt_prev >= 0))
+        else:
+            have = jnp.any(s.st == 1)
         want = valid & ~have
         if spec.arm_once:
             want = want & (s.armed_total == 0)
@@ -537,6 +558,10 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
         s.dropped = s.dropped + jnp.where(want & ~jnp.any(freev), 1, 0)
 
     st_pre = s.st
+    # pre-event live-append state: the arm occupancy gate must see the
+    # chain as the ORACLE's barrier did (a freeze during this event's
+    # live-append frees the start only at the NEXT event's re-init)
+    cnt_prev_pre = s.cnt_prev
 
     # ---- condition programs over the current capture state
     conds = [fn(event, s.caps) for fn in spec.cond_fns]
@@ -544,6 +569,16 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
 
     advanced = jnp.zeros((K,), bool)
     appended = jnp.zeros((K,), bool)
+    # every-min-0 SEQUENCE: set when the empty-chain virgin closes this
+    # event — the re-init pair's every-clone (oracle _min_count_reached →
+    # addEveryState) then appends the SAME event, seeding the next chain
+    seed_req = None
+    # SEQUENCE single-admission: a unit's new-list admits ONE partial per
+    # event (StreamPreStateProcessor.addState empty-list guard) and units
+    # process in REVERSE order, so a chain re-adding itself into the
+    # count unit's list (CountPost, cnt >= min and cnt != max) blocks the
+    # every-arm forwarded there the same event
+    seq_block_arm = jnp.zeros((), bool)
 
     # ---- main transitions, one unit at a time (statically unrolled)
     for j, u in enumerate(units):
@@ -551,6 +586,12 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
         if u.kind == "simple":
             ok = at & (stream == u.stream_a) & conds[u.cond_a]
             if spec.eps_start and j == 1:
+                if spec.is_sequence and s.seq_froze is not None:
+                    # a virgin created right after a freeze is closer-
+                    # blocked for its creation event (see make_carry)
+                    ok = ok & ~((s.cnt_prev == 0) & (s.seq_froze > 0))
+                if spec.is_sequence and spec.is_every:
+                    seed_req = jnp.any(ok & (s.cnt_prev == 0))
                 # empty-kleene start partial advancing directly: its
                 # chain-start timestamp is THIS event (a normal arm would
                 # have set start = ts)
@@ -590,6 +631,10 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
             dead = reach & (c2 == u.max_count)
             s.land(reach, j, ts, fwd_cnt=c2, fwd_dead=dead)
             advanced = advanced | reach
+            if spec.is_sequence and j == 1 and \
+                    units[0].kind == "simple":
+                seq_block_arm = seq_block_arm | \
+                    jnp.any(ok & (c2 >= u.min_count) & (c2 != u.max_count))
             if spec.is_sequence:
                 appended = appended | (ok & (c2 >= u.min_count))
             else:
@@ -631,8 +676,19 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
             s.write_count(ok & (s.cnt_prev == 0), ok, u.row_a, ev_rows, c2)
             s.cnt_prev = jnp.where(ok, c2, s.cnt_prev)
             # max reached → the reference marks stateChanged and stops
-            s.cnt_prev = jnp.where(ok & (c2 == u.max_count), -1, s.cnt_prev)
+            froze = ok & (c2 == u.max_count)
+            s.cnt_prev = jnp.where(froze, -1, s.cnt_prev)
             appended = appended | ok
+            if j == 0 and spec.eps_start and spec.is_sequence and \
+                    s.seq_froze is not None:
+                s.seq_froze = jnp.where(
+                    valid, jnp.any(froze).astype(jnp.int32),
+                    s.seq_froze)
+            if spec.is_sequence and j == 1 and \
+                    units[0].kind == "simple":
+                # CountPost re-adds while cnt != max — that re-add owns
+                # the count's new-list slot for this event
+                seq_block_arm = seq_block_arm | jnp.any(ok & ~froze)
 
     # ---- SEQUENCE strict contiguity: partials at simple/count/logical
     # units must advance or append on every event or die (per-event
@@ -665,6 +721,18 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
     occ_gate = ~jnp.any((st_pre >= 0) & (st_pre <= spec.every_group_end)) \
         if (spec.is_every and spec.every_group_end > 0) or \
         u0.kind in ("count", "logical") else jnp.bool_(True)
+    if spec.is_sequence and u0.kind == "count" and not spec.eps_start \
+            and not spec.dead_start:
+        # SEQUENCE leading min-1 kleene: the shared StateEvent re-occupies
+        # the start's new-list on every successful append, so the oracle
+        # re-inits only once the chain freezes at max, closes, or dies —
+        # and only at the NEXT event's barrier, hence the PRE-event
+        # cnt_prev (a freeze during this event frees nothing yet)
+        t0, _l0, _c0 = _land_static(spec, 0)
+        occ = (st_pre >= 0) & (st_pre <= spec.every_group_end)
+        if not _c0:
+            occ = occ | ((st_pre == t0) & (cnt_prev_pre >= 0))
+        occ_gate = ~jnp.any(occ)
     if spec.arm_once:
         occ_gate = occ_gate & (s.armed_total == 0)
 
@@ -689,8 +757,18 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
             arm_cnt_prev = jnp.int32(0 if _live0 else -1)
     elif u0.kind == "count" and spec.eps_start:
         pass        # leading min-0: arming is the ensure-virgin block above
+    elif u0.kind == "count" and spec.dead_start:
+        pass        # SEQUENCE min>=2: dead shape, never arms (see NfaSpec)
     elif u0.kind == "count":
-        c0 = valid & (stream == u0.stream_a) & conds[u0.cond_a][0]
+        if spec.is_sequence:
+            # a SEQUENCE re-arm is a FRESH empty chain: self e[last] refs
+            # in the kleene's own condition must see a virgin context
+            # (empty last bank, __cnt == 0), not slot 0's stale captures
+            zero_caps = jnp.zeros((1,) + s.caps.shape[1:], s.caps.dtype)
+            cond0 = spec.cond_fns[u0.cond_a](event, zero_caps)[0]
+        else:
+            cond0 = conds[u0.cond_a][0]
+        c0 = valid & (stream == u0.stream_a) & cond0
         arm = c0
         arm_row_writes.append(u0.row_a)
         arm_n1_rows.append(u0.row_a)
@@ -728,7 +806,7 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
     else:                       # absent at start: planner rejects
         arm = jnp.zeros((), bool)
 
-    do_arm = arm & occ_gate
+    do_arm = arm & occ_gate & ~seq_block_arm
     free = (s.st < 0) & ~s.m_mask
     first_free = jnp.argmax(free)
     any_free = jnp.any(free)
@@ -782,6 +860,38 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
             s.deadline = jnp.where(live_arm & (s.st == t0),
                                    ts + units[t0].waiting_ms, s.deadline)
 
+    # ---- every-min-0 SEQUENCE seed: the virgin closed this event while
+    # the event also passes the kleene condition — the oracle's re-init
+    # every-clone appends it, so the NEXT chain starts with THIS event
+    if seed_req is not None:
+        # the seed clone starts an EMPTY chain — virgin condition context
+        # (self e[last] refs read nothing), like the count re-arm above
+        zero_caps = jnp.zeros((1,) + s.caps.shape[1:], s.caps.dtype)
+        c0 = valid & (stream == u0.stream_a) & \
+            spec.cond_fns[u0.cond_a](event, zero_caps)[0]
+        want_seed = seed_req & c0
+        free_s = (s.st < 0) & ~s.m_mask
+        seeded = (want_seed & jnp.any(free_s)) & \
+            (jnp.arange(K) == jnp.argmax(free_s))
+        s.clear_slot(seeded)
+        s.st = jnp.where(seeded, 1, s.st)
+        s.write_count(seeded, seeded, u0.row_a, ev_rows,
+                      jnp.full((K,), 1, jnp.int32))
+        mx1 = u0.max_count == 1
+        s.cnt_prev = jnp.where(seeded, jnp.int32(-1 if mx1 else 1),
+                               s.cnt_prev)
+        s.cnt_cur = jnp.where(seeded, 0, s.cnt_cur)
+        s.start = jnp.where(seeded, ts, s.start)
+        s.enter = jnp.where(seeded, ts, s.enter)
+        s.seq = jnp.where(seeded, s.arm_seq, s.seq)
+        s.arm_seq = s.arm_seq + jnp.where(jnp.any(seeded), 1, 0)
+        s.dropped = s.dropped + jnp.where(want_seed & ~jnp.any(free_s),
+                                          1, 0)
+        if mx1 and s.seq_froze is not None:
+            # a max-1 seed freezes immediately: its forward blocks the
+            # next virgin's closer-eligibility (see make_carry)
+            s.seq_froze = jnp.where(jnp.any(seeded), 1, s.seq_froze)
+
     # ---- mid-chain `every` clone allocation (requests collected by
     # land() during the unit loop; placed after arming so pending-list
     # append order matches the oracle: armed partial first, clones after)
@@ -809,6 +919,8 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
     if s.cnt_cur is not None:
         out["cnt_cur"] = s.cnt_cur
         out["cnt_prev"] = s.cnt_prev
+    if s.seq_froze is not None:
+        out["seq_froze"] = s.seq_froze
     if s.lmask is not None:
         out["lmask"] = s.lmask
     if s.deadline is not None:

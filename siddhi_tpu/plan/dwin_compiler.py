@@ -10,9 +10,11 @@ CURRENT/EXPIRED/RESET semantics (siddhi-architecture.md:253-268) hold by
 construction; the hybrid split (device window state + host selector) is
 recorded in docs/device_coverage.md.
 
-Payload lanes: FLOAT→f32, INT/BOOL→i32, LONG→i32 hi/lo pair (exact),
-STRING→dictionary code.  DOUBLE and OBJECT payloads reject at plan time
-(f32 lanes would round-trip lossily).
+Payload lanes: FLOAT→f32, INT/BOOL→i32, LONG→i32 hi/lo pair (exact
+within ±2^62; values beyond raise at encode time), STRING→dictionary
+code, DOUBLE→two bitcast i32 lanes (exact, incl. NaN/±0 — a reserved
+quiet-NaN bit pattern is the null sentinel).  Only OBJECT payloads
+reject at plan time.
 
 Reference: query/processor/stream/window/{Length,LengthBatch,Time,
 TimeBatch,ExternalTime,ExternalTimeBatch,TimeLength,Delay,Batch}
@@ -242,6 +244,18 @@ class DeviceWindowProcessor(WindowProcessor):
                                np.int64)
                 none = np.asarray([x is None for x in col], bool)
                 hi = np.floor_divide(v, LONG_BASE)
+                # hi must survive the int32 cast AND stay clear of the
+                # null sentinel: |v| >= 2^62 wraps, and v in
+                # [-2^62, -2^62+2^31) lands exactly on INT_NONE and would
+                # decode as null (ADVICE r4).
+                bad = ~none & ((hi < np.int64(-(2 ** 31))) |
+                               (hi >= np.int64(2 ** 31)) |
+                               (hi == np.int64(INT_NONE)))
+                if bad.any():
+                    raise SiddhiAppRuntimeException(
+                        "device window path: LONG value outside ±2^62 "
+                        "(or whose hi word collides with the null "
+                        "sentinel) has no exact lane encoding")
                 lo = (v - hi * LONG_BASE).astype(np.int64)
                 hi = np.where(none, np.int64(INT_NONE), hi)
                 ev_i[0, :, lanes[0]] = hi.astype(np.int32)

@@ -287,6 +287,22 @@ class ExprCompiler:
                   CompareOp.GTE: lambda a, b: a >= b,
                   CompareOp.EQ: lambda a, b: a == b,
                   CompareOp.NEQ: lambda a, b: a != b}[op]
+            if op in (CompareOp.LT, CompareOp.GT, CompareOp.LTE,
+                      CompareOp.GTE):
+                # Java String.compareTo orders by UTF-16 code unit, not
+                # code point; the orders diverge only when a
+                # supplementary-plane character is present — encode to
+                # utf-16-be bytes only then (plain strings keep the
+                # native compare)
+                base = py
+
+                def py(a, b, _base=base):
+                    if isinstance(a, str) and isinstance(b, str) and \
+                            ((a and max(a) > "\uffff") or
+                             (b and max(b) > "\uffff")):
+                        return _base(a.encode("utf-16-be"),
+                                     b.encode("utf-16-be"))
+                    return _base(a, b)
 
             def fn(ctx):
                 a, b = l.fn(ctx), r.fn(ctx)

@@ -262,19 +262,24 @@ def _scan_vars(e, fn):
             _scan_vars(v, fn)
 
 
-def _contains_guarded_null_ref(e, nullable_refs, inside=False) -> bool:
+def _contains_guarded_null_ref(e, nullable_refs, count_refs=(),
+                               inside=False) -> bool:
     """True if a Not/IsNull wraps a reference to a maybe-unmatched row
-    (None-propagation differs from zero-filled lanes there)."""
+    (None-propagation differs from zero-filled lanes there).  [last] refs
+    to kleene units are exempt: their null truth rides the __n
+    chain-length lane exactly (_rewrite_last_refs, round 5)."""
     if isinstance(e, (Not, IsNull)):
         inside = True
     if inside and isinstance(e, Variable) and e.stream_id in nullable_refs:
-        return True
+        if not (e.stream_index == -1 and e.stream_id in count_refs):
+            return True
     for f in getattr(e, "__dataclass_fields__", {}):
         v = getattr(e, f)
         vs = v if isinstance(v, list) else [v]
         for x in vs:
             if hasattr(x, "__dataclass_fields__") and \
-                    _contains_guarded_null_ref(x, nullable_refs, inside):
+                    _contains_guarded_null_ref(x, nullable_refs,
+                                               count_refs, inside):
                 return True
     return False
 
@@ -330,15 +335,32 @@ class CompiledPatternNFA:
         self.is_sequence = sis.state_type == StateType.SEQUENCE
         if self.units[0].kind == "absent" and self.is_sequence:
             _reject("leading absent states in a sequence are host-only")
+        self.seq_dead_start = False
         if self.is_sequence and self.units[0].kind == "count":
-            # the oracle's sequence leading-accumulator semantics (shared
-            # chain re-init/re-arm against the strict barrier) diverge
-            # from the slot model on adversarial data — verified for both
-            # every and non-every shapes (review r4: a device chain can
-            # match a closing event the oracle's barrier already killed);
-            # the whole family stays host
-            _reject("a leading kleene in a SEQUENCE is host-only "
-                    "(accumulator/barrier semantics diverge)")
+            # Round 5: the leading-kleene family compiles (retiring the r4
+            # pin).  Oracle semantics (StreamPreStateProcessor.resetState
+            # :263-279, CountPreStateProcessor:53-105, verified
+            # empirically against core/pattern.py):
+            #   - the per-event barrier clears every pending list, so an
+            #     accumulator below `min` survives ONLY via the CountPost
+            #     re-add — which fires at cnt >= min.  min >= 2 therefore
+            #     NEVER forwards: the shape is dead (zero matches ever)
+            #     for every and non-every alike.
+            #   - min == 1: one live chain at a time (the shared StateEvent
+            #     occupies the start's new-list while appending; re-init
+            #     only after it freezes at max, closes, or dies).
+            #   - min == 0: the eps_start virgin; every-mode recreates it
+            #     whenever no LIVE (cnt >= 0) chain holds unit 1.
+            if len(self.units) < 2:
+                _reject("a single-unit SEQUENCE kleene is host-only")
+            if self.units[1].kind in ("absent", "logical"):
+                _reject("a SEQUENCE leading kleene directly before an "
+                        "absent/logical unit is host-only")
+            if self.units[0].min_count >= 2:
+                self.seq_dead_start = True
+            elif sis.within_ms is not None or low.group_within is not None:
+                _reject("`within` on a SEQUENCE leading kleene is "
+                        "host-only")
         is_every = low.is_every
         within_ms = sis.within_ms
         if low.group_within is not None:
@@ -450,15 +472,22 @@ class CompiledPatternNFA:
                 else:
                     return
             if current_side is not None and side is current_side:
+                is_count = self.units[self.row_unit[side.row]].kind == \
+                    "count"
+                if is_count and var.stream_index == -1:
+                    # e[last] inside the kleene's OWN condition: the
+                    # oracle shifts self negative indexes past the just-
+                    # appended candidate (core/pattern._register_qualified
+                    # self_unit; ExpressionParser.java:1366), i.e. the
+                    # last PREVIOUSLY accepted element — exactly the
+                    # kernel's pre-write last bank.  Null law rides the
+                    # __n chain-length lane (_rewrite_last_refs).
+                    needed_l[side.row].add(var.attribute)
+                    return
                 if var.stream_index not in (None, 0) or \
-                        self.units[self.row_unit[side.row]].kind == "count" \
-                        and var.stream_index is not None:
-                    # (an e[last] self-ref ≈ the appending event under the
-                    # oracle's append-then-filter, but the live-append /
-                    # barrier interplay diverges in chained shapes —
-                    # verified; whole family stays host)
-                    _reject("self-indexed references inside a kleene "
-                            "condition are host-only")
+                        (is_count and var.stream_index is not None):
+                    _reject("self-indexed references (other than [last]) "
+                            "inside a kleene condition are host-only")
                 return              # binds to the current event
             if side.row < 0:
                 _reject(f"'{var.stream_id}' is an absent state; it "
@@ -471,9 +500,13 @@ class CompiledPatternNFA:
 
         for ui, u in enumerate(self.units):
             for side in u.sides:
+                count_refs = {s.ref for s in self.rows
+                              if self.units[self.row_unit[s.row]].kind ==
+                              "count"}
                 for fe in side.filters:
                     _scan_vars(fe, lambda v, _s=side: note(v, _s))
-                    if _contains_guarded_null_ref(fe, self.nullable_refs):
+                    if _contains_guarded_null_ref(fe, self.nullable_refs,
+                                                  count_refs):
                         _reject("not()/isNull() over a maybe-unmatched "
                                 "state is host-only")
                     # unit-0 conditions must be capture-free (arming reads
@@ -514,6 +547,10 @@ class CompiledPatternNFA:
                 # last-j shifts source from the LAST bank: its attrs must
                 # ride there too
                 needed_l[side.row].add(e.attribute)
+            if any(o[0] == oa.rename for o in self.select_outputs):
+                # reference DuplicateAttributeException (SelectorParser)
+                _reject(f"duplicate output attribute '{oa.rename}' in "
+                        "select (use 'as' to alias)")
             self.select_outputs.append((oa.rename, side.row, e.attribute, w))
 
         # ---- lane layout per row: first bank ++ last bank ++ meta lanes
@@ -637,6 +674,7 @@ class CompiledPatternNFA:
             mid_every=tuple(low.mid_every),
             eps_start=low.eps_start,
             lead_absent=self.units[0].kind == "absent",
+            dead_start=self.seq_dead_start,
             n_last=tuple(n_last), idx_banks=tuple(idx_banks),
             lastk_banks=tuple(lastk_banks), m_src=tuple(m_src))
         self.has_absent = any(u.kind == "absent" for u in self.units)
@@ -813,6 +851,11 @@ class CompiledPatternNFA:
         obj = np.asarray(col, object)
         none = np.asarray([x is None for x in obj], bool)
         strs = np.asarray(["" if x is None else str(x) for x in obj])
+        from .str_lanes import has_supplementary, utf16_keys
+        if has_supplementary(strs) or any(ord(c) > 0xFFFF for c in cval):
+            # match Java's UTF-16 code-unit order (see str_lanes)
+            strs = utf16_keys(strs)
+            cval = cval.encode("utf-16-be")
         res = {CompareOp.GT: strs > cval, CompareOp.GTE: strs >= cval,
                CompareOp.LT: strs < cval, CompareOp.LTE: strs <= cval
                }[op]
@@ -846,6 +889,71 @@ class CompiledPatternNFA:
                 return el
         raise SiddhiAppCreationError(f"No query '{query_name}' in app")
 
+    def _last_ref_row(self, v) -> Optional[int]:
+        """Capture row of a `[last]`-indexed ref to a kleene unit (self or
+        cross), else None."""
+        if not isinstance(v, Variable) or v.stream_index != -1:
+            return None
+        s2 = self.ref_to_side.get(v.stream_id or "")
+        if s2 is None or s2.row < 0:
+            return None
+        if self.units[self.row_unit[s2.row]].kind != "count":
+            return None
+        return s2.row
+
+    def _rewrite_last_refs(self, expr):
+        """Null law for `[last]` kleene refs in CONDITIONS: an empty chain
+        makes `x is null` true and every comparison false (reference
+        compare executors).  Lanes are zero-filled, so the truth rides the
+        __n chain-length lane instead: IsNull → __cnt == 0, and each
+        Compare touching a [last] ref gains an `__cnt >= 1` guard.
+        Returns (expr', rows_used)."""
+        from ..query_api.expression import (And, Compare, CompareOp,
+                                            Constant, IsNull, MathExpr,
+                                            Not, Or)
+        used: set = set()
+
+        def scan_rows(e, acc):
+            r = self._last_ref_row(e)
+            if r is not None:
+                acc.add(r)
+            for f in getattr(e, "__dataclass_fields__", {}):
+                v = getattr(e, f)
+                vs = v if isinstance(v, list) else [v]
+                for x in vs:
+                    if hasattr(x, "__dataclass_fields__"):
+                        scan_rows(x, acc)
+
+        def cnt_var(r):
+            used.add(r)
+            return Variable(attribute=f"__cnt_{r}")
+
+        def rw(e):
+            if isinstance(e, IsNull) and e.expr is not None:
+                r = self._last_ref_row(e.expr)
+                if r is not None:
+                    return Compare(cnt_var(r), CompareOp.EQ,
+                                   Constant(0, "long"))
+            if isinstance(e, Compare):
+                rows: set = set()
+                scan_rows(e, rows)
+                out = Compare(rw(e.left), e.op, rw(e.right))
+                for r in sorted(rows):
+                    used.add(r)
+                    out = And(out, Compare(cnt_var(r), CompareOp.GTE,
+                                           Constant(1, "long")))
+                return out
+            if isinstance(e, And):
+                return And(rw(e.left), rw(e.right))
+            if isinstance(e, Or):
+                return Or(rw(e.left), rw(e.right))
+            if isinstance(e, Not):
+                return Not(rw(e.expr))
+            if isinstance(e, MathExpr):
+                return MathExpr(e.op, rw(e.left), rw(e.right))
+            return e
+        return rw(expr), used
+
     def _compile_condition(self, side: _Side, n_slots: int,
                            n_lane, matched_lane) -> Callable:
         if not side.filters:
@@ -856,6 +964,7 @@ class CompiledPatternNFA:
         expr = side.filters[0]
         for fe in side.filters[1:]:
             expr = And(expr, fe)
+        expr, cnt_rows = self._rewrite_last_refs(expr)
 
         # rows this condition references → validity gates for nullable rows
         gate_rows: set = set()
@@ -885,6 +994,22 @@ class CompiledPatternNFA:
             def gd(ctx, _a=name):
                 return ctx.columns[_a]
             scope.add(None, name, AttrType.FLOAT, gd)
+        # own-row [last] bank (self e[last] refs) + chain-length lanes
+        # (__cnt_r guards from _rewrite_last_refs)
+        if side.row >= 0 and \
+                self.units[self.row_unit[side.row]].kind == "count":
+            for a in side.definition.attributes:
+                if a.name not in self.attr_types:
+                    continue
+
+                def gsl(ctx, _r=side.ref, _a=a.name):
+                    return ctx.qualified[(_r, -1)][_a]
+                scope.add(side.ref, a.name, self.attr_types[a.name], gsl,
+                          index=-1)
+        for r in cnt_rows:
+            def gc(ctx, _a=f"__cnt_{r}"):
+                return ctx.columns[_a]
+            scope.add(None, f"__cnt_{r}", AttrType.LONG, gc)
         # other states' captures: [K] lanes (first bank at index 0/None,
         # last bank at index -1 for count rows)
         for other in self.rows:
@@ -920,22 +1045,32 @@ class CompiledPatternNFA:
         rows = self.rows
 
         def fn(event, captures, _c=compiled, _side=side,
-               _gates=tuple(sorted(gate_rows))):
+               _gates=tuple(sorted(gate_rows)),
+               _cnt_rows=tuple(sorted(cnt_rows))):
             k = captures.shape[0]
             qualified = {}
             for other in rows:
-                if other is _side:
-                    continue
                 cols_f, cols_l = {}, {}
                 for (r, a, w), lane in cap_lane.items():
                     if r != other.row:
                         continue
-                    (cols_f if w == "f" else cols_l)[a] = \
-                        captures[:, r, lane]
+                    if w == "f":
+                        cols_f[a] = captures[:, r, lane]
+                    elif w == "l":
+                        cols_l[a] = captures[:, r, lane]
+                    # i{k}/m{j} banks are select-side only
+                if other is _side:
+                    # self refs: only the [last] bank is addressable (the
+                    # un-indexed name binds to the current event)
+                    if cols_l:
+                        qualified[(other.ref, -1)] = cols_l
+                    continue
                 qualified[(other.ref, 0)] = cols_f
                 if cols_l:
                     qualified[(other.ref, -1)] = cols_l
             cols_now = {a: event[a] for a in self.attr_names}
+            for r in _cnt_rows:
+                cols_now[f"__cnt_{r}"] = captures[:, r, self._n_lane[r]]
             for pn in self.param_names:
                 if pn in event:
                     cols_now[pn] = event[pn]

@@ -118,7 +118,11 @@ class _DeviceIngress:
         self.runtime.ingest(self.stream_code, self.stream_id, chunk)
 
     def flush(self):
-        self.runtime.flush()
+        # synchronous runtimes (filter/gagg/wagg — nothing in flight)
+        # have no flush; pipelined ones retire their in-flight work
+        f = getattr(self.runtime, "flush", None)
+        if f is not None:
+            f()
 
 
 class DevicePatternRuntime:
@@ -1047,6 +1051,12 @@ def plan_single_runtime(query_runtime, sis, factory):
                   for oa in q.selector.attributes) or \
         (q.selector.having is not None and
          _scan_fns(q.selector.having, is_agg))
+    if has_window and not has_agg and not q.selector.group_by:
+        # plain projection over a window: the dwin hybrid (device window
+        # state, host selector) owns this shape — routing it to the
+        # grouped-agg kernel would reject ("no aggregates"), and under
+        # engine('device') that rejection must not veto the dwin path
+        return None, "window with plain projection → dwin hybrid path"
     if has_window or has_agg or q.selector.group_by:
         return _plan(query_runtime,
                      lambda: DeviceGroupedAggRuntime(query_runtime, sis,

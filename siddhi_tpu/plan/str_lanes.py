@@ -35,6 +35,26 @@ class StringRewriteError(ValueError):
     """A string-typed construct with no code-lane rewrite (→ host)."""
 
 
+def has_supplementary(strs: np.ndarray) -> bool:
+    """True if any string contains a code point above U+FFFF.
+
+    numpy unicode arrays are UCS4, so viewing as uint32 exposes the raw
+    code points (padding is 0).  Java's String.compareTo orders by UTF-16
+    code unit, numpy/Python by code point; the two orders agree exactly
+    unless a supplementary-plane character is present (its surrogates
+    0xD800-0xDFFF sort below U+E000..U+FFFF in UTF-16)."""
+    if strs.size == 0:
+        return False
+    if strs.dtype.kind != "U":
+        return any(ord(c) > 0xFFFF for s in strs for c in str(s))
+    return bool((strs.view(np.uint32) > 0xFFFF).any())
+
+
+def utf16_keys(strs) -> np.ndarray:
+    """Per-string utf-16-be byte keys; bytewise order == Java compareTo."""
+    return np.asarray([str(s).encode("utf-16-be") for s in strs], object)
+
+
 _REFLECT = {CompareOp.LT: CompareOp.GT, CompareOp.GT: CompareOp.LT,
             CompareOp.LTE: CompareOp.GTE, CompareOp.GTE: CompareOp.LTE,
             CompareOp.EQ: CompareOp.EQ, CompareOp.NEQ: CompareOp.NEQ}
@@ -202,15 +222,35 @@ class StringLanes:
                 pools.append(strs[~none])
         uniq = np.unique(np.concatenate(pools)) if pools else \
             np.zeros(0, "U1")
+        # Ranks must follow Java's UTF-16 code-unit order, not numpy's
+        # code-point order; the two diverge only when supplementary-plane
+        # characters are present, so re-rank the (small) unique pool by
+        # utf-16-be bytes in that rare case only.
+        resort = len(uniq) > 0 and (
+            has_supplementary(uniq) or
+            any(any(ord(c) > 0xFFFF for c in v) for v in self.consts))
+        if resort:
+            keys16 = utf16_keys(uniq)
+            order = np.argsort(keys16)
+            rank16 = np.empty(len(uniq), np.float32)
+            rank16[order] = np.arange(len(uniq), dtype=np.float32)
+            uniq16 = list(keys16[order])
         for a, (strs, none) in per_attr.items():
-            codes = np.searchsorted(uniq, strs).astype(np.float32)
+            idx = np.searchsorted(uniq, strs)
+            codes = rank16[idx] if resort else idx.astype(np.float32)
             codes[none] = -1.0
             lane = np.full(n_pad, -1.0, np.float32)
             lane[:n] = codes
             cols[f"__strcode_{a}"] = lane
         for i, v in enumerate(self.consts):
-            lo = float(np.searchsorted(uniq, v, side="left"))
-            hi = float(np.searchsorted(uniq, v, side="right"))
+            if resort:
+                import bisect
+                v16 = v.encode("utf-16-be")
+                lo = float(bisect.bisect_left(uniq16, v16))
+                hi = float(bisect.bisect_right(uniq16, v16))
+            else:
+                lo = float(np.searchsorted(uniq, v, side="left"))
+                hi = float(np.searchsorted(uniq, v, side="right"))
             cols[f"__strc{i}_lo"] = np.full(n_pad, lo, np.float32)
             cols[f"__strc{i}_hi"] = np.full(n_pad, hi, np.float32)
         return cols

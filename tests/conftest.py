@@ -91,8 +91,14 @@ def pytest_sessionfinish(session, exitstatus):
     if os.path.exists(floor_path):
         with open(floor_path) as f:
             floor = json.load(f)
-    if floor and not set(floor) <= _COLLECTED_FILES:
-        return              # partial run: don't clobber full-run telemetry
+    # partial run (e.g. -k filters): don't clobber full-run telemetry.
+    # Guard on EXECUTED suites (a -k run still collects every file before
+    # deselection, review r5) and require each to have executed at least
+    # its floor's worth of tests
+    if floor and not (set(floor) <= _COLLECTED_FILES and
+                      all(counts.get(s, {}).get("tests", 0) >= need
+                          for s, need in floor.items())):
+        return
     out = os.path.join(_here, "docs", "device_hits.json")
     with open(out, "w") as f:
         json.dump(counts, f, indent=1, sort_keys=True)

@@ -1,0 +1,265 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md):
+
+1. LONG payloads beyond ±2^62 (or whose hi word collides with the null
+   sentinel) must raise a data error on the device window path, not
+   silently wrap / decode as null.
+2. String ORDER comparisons follow Java String.compareTo (UTF-16 code
+   unit order), which diverges from Python/numpy code-point order when
+   supplementary-plane characters are present — device and host must
+   agree with each other AND with the reference order.
+3. Concurrent StreamJunction.flush() calls must not interleave barrier
+   copies across workers (each used to stall ~600 s); persist() from a
+   junction worker's own callback must not deadlock.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import (InMemoryPersistenceStore, QueryCallback,
+                        SiddhiManager, StreamCallback)
+
+CSE = "define stream cse (symbol string, price float, volume long);\n"
+
+
+def _collect(rt, qname="q"):
+    log = []
+    rt.add_callback(qname, QueryCallback(
+        lambda ts, cur, exp: log.extend(
+            tuple(e.data) for e in (cur or []))))
+    return log
+
+
+# ---------------------------------------------------------------- LONG guard
+
+@pytest.mark.parametrize("bad", [2 ** 62, -(2 ** 62), 2 ** 63 - 1,
+                                 -(2 ** 62) + (2 ** 31) - 1])
+def test_dwin_long_out_of_range_raises(bad):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "@app:playback @app:engine('device') " + CSE +
+        "@info(name='q') from cse#window.length(3) "
+        "select symbol, volume insert into out;")
+    errors = []
+    rt.app_ctx.exception_listeners.append(errors.append)
+    log = _collect(rt)
+    rt.start()
+    h = rt.get_input_handler("cse")
+    h.send_batch({"symbol": np.asarray(["A"], object),
+                  "price": np.asarray([1.0], np.float32),
+                  "volume": np.asarray([bad], np.int64)},
+                 timestamps=np.asarray([1000], np.int64))
+    rt.shutdown()
+    # the chunk is a data error: dropped at the @OnError boundary, never
+    # emitted with a wrapped/nulled payload
+    assert not log
+    assert errors, "out-of-range LONG must surface a runtime data error"
+    assert "LONG" in str(errors[0])
+
+
+def test_dwin_long_pm_2_61_exact():
+    """Values just inside the guard round-trip exactly."""
+    # exact range is [-2^62 + 2^31, 2^62): hi must fit int32 and miss
+    # the INT_NONE sentinel (hi == -2^31)
+    good = [2 ** 61, -(2 ** 61), 2 ** 62 - 1, -(2 ** 62) + 2 ** 31]
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "@app:playback @app:engine('device') " + CSE +
+        "@info(name='q') from cse#window.lengthBatch(4) "
+        "select symbol, volume insert into out;")
+    log = _collect(rt)
+    rt.start()
+    h = rt.get_input_handler("cse")
+    h.send_batch({"symbol": np.asarray(list("ABCD"), object),
+                  "price": np.zeros(4, np.float32),
+                  "volume": np.asarray(good, np.int64)},
+                 timestamps=np.arange(1000, 1004, dtype=np.int64))
+    rt.shutdown()
+    assert [row[1] for row in log] == good
+
+
+# ------------------------------------------------------- UTF-16 string order
+
+SUPP = "\U00010000"          # surrogates D800 DC00 — UTF-16 < U+E000
+BMP = "\ue000"               # code point < U+10000
+
+
+@pytest.mark.parametrize("engine", ["host", "device"])
+def test_string_order_utf16_code_units(engine):
+    """Java: SUPP < BMP (surrogate 0xD800 < 0xE000); Python code points
+    say the opposite.  Both backends must produce the Java order."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        f"@app:playback @app:engine('{engine}') " + CSE +
+        f"@info(name='q') from cse[symbol > '{BMP}'] "
+        "select symbol insert into out;")
+    log = _collect(rt)
+    rt.start()
+    h = rt.get_input_handler("cse")
+    h.send_batch({"symbol": np.asarray([SUPP, BMP, "\ufffd", "a"], object),
+                  "price": np.zeros(4, np.float32),
+                  "volume": np.arange(4, dtype=np.int64)},
+                 timestamps=np.arange(1000, 1004, dtype=np.int64))
+    rt.shutdown()
+    # 'a' (0x61) < U+E000; U+FFFD > U+E000 in both orders.  SUPP must
+    # NOT match (UTF-16 order), though code-point order says it would.
+    assert sorted(r[0] for r in log) == ["\ufffd"]
+
+
+@pytest.mark.parametrize("engine", ["host", "device"])
+def test_string_var_vs_var_utf16(engine):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        f"@app:playback @app:engine('{engine}') " +
+        "define stream s (a string, b string);\n"
+        "@info(name='q') from s[a < b] select a, b insert into out;")
+    log = _collect(rt)
+    rt.start()
+    h = rt.get_input_handler("s")
+    h.send_batch({"a": np.asarray([SUPP, BMP], object),
+                  "b": np.asarray([BMP, SUPP], object)},
+                 timestamps=np.asarray([1000, 1001], np.int64))
+    rt.shutdown()
+    # UTF-16: SUPP < BMP, so only the first row matches
+    assert [r for r in log] == [(SUPP, BMP)]
+
+
+@pytest.mark.parametrize("engine", ["host", "device"])
+def test_pattern_string_order_utf16(engine):
+    """Device NFA path (derived_lane): a pattern whose string ORDER
+    predicate involves a supplementary-plane constant must follow UTF-16
+    code-unit order, matching the host oracle."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        f"@app:playback @app:engine('{engine}') " + CSE +
+        f"@info(name='q') from e1=cse[symbol > '{BMP}'] -> "
+        "e2=cse[price > 0.0] "
+        "select e1.symbol as s1, e2.symbol as s2 insert into out;")
+    log = _collect(rt)
+    rt.start()
+    h = rt.get_input_handler("cse")
+    # SUPP must NOT arm e1 (UTF-16: SUPP < BMP); U+FFFD must
+    h.send_batch({"symbol": np.asarray([SUPP, "\ufffd", "x"], object),
+                  "price": np.asarray([0.0, 0.0, 1.0], np.float32),
+                  "volume": np.arange(3, dtype=np.int64)},
+                 timestamps=np.asarray([1000, 1001, 1002], np.int64))
+    rt.shutdown()
+    assert log == [("\ufffd", "x")]
+
+
+@pytest.mark.parametrize("engine", ["host", "device"])
+def test_duplicate_select_names_rejected(engine):
+    """Reference SelectorParser throws DuplicateAttributeException;
+    columnar output would silently overwrite the earlier column."""
+    from siddhi_tpu.utils.errors import SiddhiAppCreationError
+    m = SiddhiManager()
+    with pytest.raises(SiddhiAppCreationError, match="[Dd]uplicate"):
+        m.create_siddhi_app_runtime(
+            f"@app:playback @app:engine('{engine}') " + CSE +
+            "@info(name='q') from e1=cse[price > 0.0] -> "
+            "e2=cse[price > 1.0] "
+            "select e1.symbol, e2.symbol insert into out;")
+
+
+# ------------------------------------------------------------ flush hygiene
+
+def test_concurrent_flush_no_stall():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "define stream s (v int);\n"
+        "@async(buffer.size='64', workers='2')\n"
+        "define stream inner (v int);\n"
+        "@info(name='q') from s select v insert into inner;\n"
+        "@info(name='q2') from inner select v insert into out;")
+    rt.start()
+    j = rt.junctions["inner"]
+    errs = []
+
+    def hammer():
+        try:
+            for _ in range(25):
+                j.flush()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    alive = [t for t in threads if t.is_alive()]
+    rt.shutdown()
+    assert not errs and not alive, (errs, alive)
+
+
+def test_external_persist_races_worker_persist():
+    """An external persist() holding the snapshot lock must not deadlock
+    with a persist() issued from a junction worker callback (the worker
+    would never consume its flush-barrier copy while blocked on the
+    lock)."""
+    m = SiddhiManager()
+    m.set_persistence_store(InMemoryPersistenceStore())
+    rt = m.create_siddhi_app_runtime(
+        "@async(workers='1')\n"
+        "define stream s (v int);\n"
+        "@info(name='q') from s select v insert into out;")
+    errs = []
+    rt.app_ctx.exception_listeners.append(errs.append)
+    done = threading.Event()
+
+    def cb(events):
+        rt.persist()
+        done.set()
+
+    rt.add_callback("out", StreamCallback(cb))
+    rt.start()
+    ext_done = threading.Event()
+
+    def external():
+        for _ in range(10):
+            rt.persist()
+        ext_done.set()
+
+    t = threading.Thread(target=external)
+    t.start()
+    rt.get_input_handler("s").send([1])
+    assert done.wait(timeout=60.0), "worker-callback persist deadlocked"
+    assert ext_done.wait(timeout=60.0), "external persist deadlocked"
+    t.join(timeout=10.0)
+    rt.shutdown()
+    # the junction flush must not log AttributeErrors for synchronous
+    # device runtimes that have no pipelined work to retire
+    assert not errs, errs
+
+
+def test_engine_device_rejects_host_only_window_projection():
+    """engine('device') stays strict for plain-projection queries over a
+    window kind with no device kernel (no silent host fallback)."""
+    from siddhi_tpu.utils.errors import SiddhiAppCreationError
+    m = SiddhiManager()
+    with pytest.raises(SiddhiAppCreationError):
+        m.create_siddhi_app_runtime(
+            "@app:engine('device') define stream s (v int);\n"
+            "@info(name='q') from s#window.sort(5, v) "
+            "select v insert into out;")
+
+
+def test_persist_from_worker_callback_no_deadlock():
+    m = SiddhiManager()
+    m.set_persistence_store(InMemoryPersistenceStore())
+    rt = m.create_siddhi_app_runtime(
+        "@async(workers='1')\n"
+        "define stream s (v int);\n"
+        "@info(name='q') from s select v insert into out;")
+    done = threading.Event()
+
+    def cb(events):
+        rt.persist()          # from the junction worker thread itself
+        done.set()
+
+    rt.add_callback("out", StreamCallback(cb))
+    rt.start()
+    rt.get_input_handler("s").send([1])
+    assert done.wait(timeout=60.0), \
+        "persist() from a worker callback deadlocked"
+    rt.shutdown()

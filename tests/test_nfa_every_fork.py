@@ -131,28 +131,24 @@ def test_leading_min0_empty_match():
     assert out == [(1000, (None, pytest.approx(8.1)))]
 
 
-def test_leading_min0_sequence_nonevery_falls_back():
-    # part of the sequence leading-kleene family (host-only, review r4)
+def test_leading_min0_sequence_nonevery_compiles():
+    """Round 5: the SEQUENCE leading-kleene family compiles (r4 pin
+    retired) — non-every min-0 is a single virgin that dies forever on
+    its first unproductive event."""
     app = A + """@info(name='q')
     from e1=A[v < 3.0]<0:2>, e2=A[v > 5.0]
     select e1[0].v as a, e2.v as b insert into Out;"""
-    rows = gen(12, n=40)
-    host = run(app, rows, engine="host", expect_backend="host")
-    auto = run(app, rows, expect_backend="host")
-    assert auto == host
+    parity(app, gen(12, n=40))
 
 
-def test_leading_min0_every_sequence_falls_back():
-    """every + SEQUENCE + leading min-0: the oracle's shared start
-    partial can be blocked from the successor's pending list while live
-    in the count's — host-only (recorded reason); parity still holds."""
+def test_leading_min0_every_sequence_compiles():
+    """Round 5: every + SEQUENCE + leading min-0 on device — the virgin
+    closer-block after a freeze and the same-event close+append seed
+    (oracle every-clone) are modeled in-kernel."""
     app = A + """@info(name='q')
     from every e1=A[v < 3.0]<0:2>, e2=A[v > 5.0]
     select e1[0].v as a, e2.v as b insert into Out;"""
-    rows = gen(12, n=60)
-    host = run(app, rows, engine="host", expect_backend="host")
-    auto = run(app, rows, expect_backend="host")
-    assert auto == host
+    parity(app, gen(12, n=60))
 
 
 def test_leading_min0_within():
@@ -255,16 +251,28 @@ def test_indexed_kleene_selects():
     parity(app, gen(30, n=80))
 
 
-def test_leading_kleene_sequence_falls_back():
-    """Review r4: the sequence leading-accumulator family diverges from
-    the oracle on adversarial data (every AND non-every) — whole family
-    host-only, parity by fallback."""
+def test_leading_kleene_sequence_device_parity():
+    """Round 5 (r4 pin retired): min>=2 leading kleene in a SEQUENCE is a
+    DEAD shape — the per-event barrier kills sub-min accumulators before
+    CountPost can re-add them, so neither engine ever matches; the device
+    compiles it to a never-arming chain (NfaSpec.dead_start)."""
     for head in ("every e1=A[v < 9.0]<2:6>", "e1=A[v < 9.0]<2:6>"):
         app = A + f"""@info(name='q')
         from {head}, e2=A[v > 8.0]
         select e1[1].v as b, e2.v as g insert into Out;"""
         for seed in (13, 29):
             rows = gen(seed, n=80)
-            host = run(app, rows, engine="host", expect_backend="host")
-            auto = run(app, rows, expect_backend="host")
-            assert auto == host
+            assert parity(app, rows) == []
+
+
+def test_leading_kleene_sequence_overlapping_conditions():
+    """Adversarial single-stream shapes where one event can both append
+    and close — the reversed per-event unit order (closer first) and the
+    every-clone seed must match the oracle."""
+    for head in ("every e1=A[v < 6.0]*", "every e1=A[v < 6.0]+",
+                 "every e1=A[v < 6.0]<0:1>", "e1=A[v < 6.0]?"):
+        app = A + f"""@info(name='q')
+        from {head}, e2=A[v > 4.0]
+        select e1[0].v as a, e1[1].v as b, e2.v as g insert into Out;"""
+        for seed in (13, 29):
+            parity(app, gen(seed, n=60))

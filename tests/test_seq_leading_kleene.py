@@ -1,0 +1,189 @@
+"""Round-5 SEQUENCE leading/mid kleene device algebra: randomized parity
+against the host oracle for the family the r4 review pinned host-only,
+now modeled in-kernel (ops/nfa.py):
+
+- dead-start (min >= 2 leading kleene never matches — barrier algebra),
+- min-1 single-live-chain occupancy with pre-event cnt_prev,
+- min-0 virgin closer-block after a freeze (seq_froze carry lane),
+- every-clone seed on same-event close+append,
+- single-admission arm blocking (CountPost re-add owns the new-list),
+- self-indexed e[last] refs in kleene CONDITIONS with __cnt null-law
+  gates (reference ExpressionParser.java:1366 self-shifted last index).
+"""
+import numpy as np
+import pytest
+
+from siddhi_tpu import QueryCallback, SiddhiManager
+
+A = "define stream A (v float, w float);\n"
+
+
+def run(app, rows, engine=None, expect_backend=None):
+    m = SiddhiManager()
+    pre = "@app:playback " + (f"@app:engine('{engine}') " if engine else "")
+    rt = m.create_siddhi_app_runtime(pre + app)
+    got = []
+    rt.add_callback("q", QueryCallback(
+        lambda ts, cur, exp: got.extend(
+            (ts, tuple(e.data)) for e in (cur or []))))
+    rt.start()
+    h = rt.get_input_handler("A")
+    for row, ts in rows:
+        h.send(row, timestamp=ts)
+    backend = rt.query_runtimes["q"].backend
+    if expect_backend:
+        assert backend == expect_backend, rt.query_runtimes["q"].backend_reason
+    rt.shutdown()
+    return got
+
+
+def parity(app, rows):
+    dev = run(app, rows, expect_backend="device")
+    host = run(app, rows, engine="host", expect_backend="host")
+    assert dev == host, f"device {dev[:6]}... vs host {host[:6]}..."
+    return dev
+
+
+def gen(seed, n=60, vmax=10.0, step=200):
+    rng = np.random.default_rng(seed)
+    ts = 1_000_000
+    rows = []
+    for _ in range(n):
+        ts += int(rng.integers(1, step))
+        rows.append(([float(np.float32(rng.uniform(0, vmax))),
+                      float(np.float32(rng.uniform(0, vmax)))], ts))
+    return rows
+
+
+HEADS = ["every e1=A[v < 6.0]*", "e1=A[v < 6.0]*",
+         "every e1=A[v < 6.0]+", "e1=A[v < 6.0]+",
+         "every e1=A[v < 6.0]?", "e1=A[v < 6.0]?",
+         "every e1=A[v < 6.0]<0:3>", "every e1=A[v < 6.0]<0:1>",
+         "every e1=A[v < 6.0]<1:2>", "e1=A[v < 6.0]<1:3>"]
+
+
+@pytest.mark.parametrize("head", HEADS)
+def test_leading_kleene_overlapping_close(head):
+    """Single-stream: events in (4, 6) both append and close — exercises
+    the reversed unit order, the seed, and the closer-block."""
+    app = A + f"""@info(name='q')
+    from {head}, e2=A[v > 4.0]
+    select e1[0].v as a, e1[1].v as b, e2.v as g insert into Out;"""
+    for seed in (13, 29, 7):
+        parity(app, gen(seed))
+
+
+@pytest.mark.parametrize("head", ["every e1=A[v < 9.0]<2:6>",
+                                  "e1=A[v < 9.0]<2:6>",
+                                  "every e1=A[v < 9.0]<3:4>"])
+def test_leading_kleene_dead_start(head):
+    """min >= 2 leading kleene in SEQUENCE: zero matches ever."""
+    app = A + f"""@info(name='q')
+    from {head}, e2=A[v > 1.0]
+    select e1[1].v as b, e2.v as g insert into Out;"""
+    for seed in (13, 29):
+        assert parity(app, gen(seed, n=80)) == []
+
+
+@pytest.mark.parametrize("seed", [3, 17, 23, 31])
+def test_mid_kleene_self_last_rising(seed):
+    """The conformance rising-run shape: self e2[last] in the kleene's
+    own condition + cross e2[last] in the closer."""
+    app = A + """@info(name='q')
+    from every e1=A[v > 2.0],
+         e2=A[(e2[last].v is null and v >= e1.v) or
+              ((not (e2[last].v is null)) and v >= e2[last].v)]+,
+         e3=A[v < e2[last].v]
+    select e1.v as a, e2[0].v as b, e2[1].v as c, e2[last].v as d,
+           e3.v as g insert into Out;"""
+    parity(app, gen(seed, n=80))
+
+
+@pytest.mark.parametrize("seed", [5, 19])
+def test_mid_kleene_self_last_unguarded(seed):
+    """Unguarded self-last compare: the null law (empty chain compares
+    false) must ride the __cnt gate, not the zero-filled lane."""
+    app = A + """@info(name='q')
+    from every e1=A[v > 2.0], e2=A[v >= e2[last].v or v >= e1.v]+,
+         e3=A[v < e2[last].v]
+    select e1.v as a, e2[0].v as b, e2[last].v as d, e3.v as g
+    insert into Out;"""
+    parity(app, gen(seed, n=80))
+
+
+@pytest.mark.parametrize("seed", [11, 37])
+def test_mid_kleene_bounded_self_last(seed):
+    """Bounded mid kleene with a self-last condition: freeze-at-max plus
+    the single-admission arm block."""
+    app = A + """@info(name='q')
+    from every e1=A[v > 5.0], e2=A[v < 5.0 and (e2[last].v is null or
+         v >= e2[last].v - 2.0)]<1:3>, e3=A[v > 8.0]
+    select e1.v as a, e2[0].v as b, e3.v as g insert into Out;"""
+    parity(app, gen(seed, n=80))
+
+
+@pytest.mark.parametrize("seed", [13, 29])
+def test_leading_kleene_self_last_condition(seed):
+    """Self e[last] inside the LEADING kleene's own condition: each
+    re-arm is a fresh empty chain, so the arm (and the every-clone seed)
+    must evaluate the condition in a VIRGIN capture context, not slot 0's
+    stale banks (review r5)."""
+    app = A + """@info(name='q')
+    from every e1=A[e1[last].v is null or v > e1[last].v]<1:3>,
+         e2=A[v > 6.0]
+    select e1[0].v as a, e2.v as g insert into Out;"""
+    parity(app, gen(seed, n=60))
+
+
+@pytest.mark.parametrize("seed", [13, 29])
+def test_leading_min0_self_last_condition(seed):
+    app = A + """@info(name='q')
+    from every e1=A[(e1[last].v is null and v < 5.0) or
+                    ((not (e1[last].v is null)) and v > e1[last].v)]*,
+         e2=A[v > 6.0]
+    select e1[0].v as a, e1[1].v as b, e2.v as g insert into Out;"""
+    parity(app, gen(seed, n=60))
+
+
+def test_mid_kleene_min2_dead_in_sequence():
+    """A mid-chain <2:n> kleene also never reaches min in a SEQUENCE (the
+    barrier kills sub-min accumulators) — both engines emit nothing."""
+    app = A + """@info(name='q')
+    from every e1=A[v > 8.0], e2=A[v < 5.0]<2:3>, e3=A[v > 8.0]
+    select e1.v as a, e2[0].v as b, e3.v as g insert into Out;"""
+    rows = [([9.0, 0.0], 1000), ([1.0, 0.0], 1010), ([2.0, 0.0], 1020),
+            ([9.5, 0.0], 1030)]
+    assert parity(app, rows) == []
+
+
+def test_leading_kleene_two_stream_cross_ref():
+    """The conformance shape of test_seq_4/5/6: two streams, e1[0] read
+    by the closer's condition, min-0 chain."""
+    app = ("define stream S1 (sym string, p float);\n"
+           "define stream S2 (sym string, p float);\n"
+           """@info(name='q')
+           from every e1=S2[p > 20.0]*, e2=S1[p > e1[0].p]
+           select e1[0].p as a, e1[1].p as b, e2.p as g
+           insert into Out;""")
+    m_rows = [("S1", 59.6, 1000), ("S2", 55.6, 1100), ("S2", 55.7, 1200),
+              ("S1", 57.6, 1300), ("S2", 58.0, 1400), ("S1", 58.5, 1500)]
+
+    def go(engine):
+        m = SiddhiManager()
+        pre = "@app:playback " + (f"@app:engine('{engine}') " if engine
+                                  else "")
+        rt = m.create_siddhi_app_runtime(pre + app)
+        got = []
+        rt.add_callback("q", QueryCallback(
+            lambda ts, cur, exp: got.extend(tuple(e.data)
+                                            for e in (cur or []))))
+        rt.start()
+        for sid, p, ts in m_rows:
+            rt.get_input_handler(sid).send([sid, float(p)], timestamp=ts)
+        b = rt.query_runtimes["q"].backend
+        rt.shutdown()
+        return b, got
+    bd, dev = go(None)
+    bh, host = go("host")
+    assert bd == "device" and bh == "host"
+    assert dev == host and dev
