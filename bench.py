@@ -76,6 +76,7 @@ T_PER_BLOCK = 64          # events per partition lane per block (throughput).
                           # (model in docs/perf_notes.md)
 T_LAT_BLOCK = 4           # smaller latency-phase micro-batches
 THRU_BLOCKS = 32          # async-dispatch throughput phase
+ENGINE_REPEATS = 5        # engine phases report median of N repeats
 LAT_BLOCKS = 200          # per-block-synchronous latency phase
 N_SLOTS = 8               # provably ≥ max occupancy 5 — see module docstring
 MATCH_RING = 32           # per-pattern per-block payload slots: sized so
@@ -484,7 +485,7 @@ select e1.price as p1, e2.price as p2 insert into Out;
 end;
 """
 
-    def run(columnar):
+    def run(columnar, repeats=ENGINE_REPEATS):
         m = SiddhiManager()
         rt = m.create_siddhi_app_runtime(APP)
         matched = [0]
@@ -511,25 +512,116 @@ end;
         h.send_batch(cols, timestamps=ts)          # warmup / compile
         rt.flush()
         matched[0] = 0          # count only the timed chunks' matches
-        t0 = time.perf_counter()
+        # median of >= 5 in-process repeats: engine-phase numbers through
+        # the tunnel swing +-30% run-to-run, a single draw is not a
+        # product claim (VERDICT r4 weak #2)
+        rates = []
         base = 1_000_000 + CHUNK * 2
-        for ci in range(CHUNKS):
-            cols, ts = chunk(base + ci * CHUNK * 2)
-            h.send_batch(cols, timestamps=ts)
-        rt.flush()                                  # all matches delivered
-        dt = time.perf_counter() - t0
+        for rep in range(repeats):
+            t0 = time.perf_counter()
+            for ci in range(CHUNKS):
+                cols, ts = chunk(base + (rep * CHUNKS + ci) * CHUNK * 2)
+                h.send_batch(cols, timestamps=ts)
+            rt.flush()                              # all matches delivered
+            rates.append(CHUNK * CHUNKS / (time.perf_counter() - t0))
         rt.shutdown()
         gc.collect()
-        return CHUNK * CHUNKS / dt, matched[0]
+        return (float(np.median(rates)), float(np.max(rates)),
+                matched[0])
 
-    rate_ev, m_ev = run(columnar=False)
-    rate_col, m_col = run(columnar=True)
+    rate_ev, best_ev, m_ev = run(columnar=False)
+    rate_col, best_col, m_col = run(columnar=True)
     assert m_ev == m_col, (m_ev, m_col)
     return {"engine_events_per_sec": rate_ev,
+            "engine_events_per_sec_best": best_ev,
             "engine_columnar_events_per_sec": rate_col,
+            "engine_columnar_events_per_sec_best": best_col,
+            "engine_repeats": ENGINE_REPEATS,
             "engine_matches_delivered": m_ev,
             "engine_keys": N_KEYS, "engine_chunk": CHUNK,
             "engine_chunks": CHUNKS}
+
+
+def _engine_agg_phase(query_body, prefix, config_desc, n_keys=1024,
+                      chunk_n=65_536, chunks=4):
+    """Shared engine-phase scaffold: SiddhiManager + @Async junction +
+    columnar callbacks, warmup, then ENGINE_REPEATS timed repeats
+    (median + best reported — tunnel numbers swing run-to-run)."""
+    import gc
+    from siddhi_tpu import SiddhiManager, StreamCallback
+
+    APP = f"""@app:playback
+@Async(buffer.size='64', batch.size.max='{chunk_n}')
+define stream S (sym string, price float, kind int);
+partition with (sym of S) begin
+@info(name='q')
+{query_body}
+end;
+"""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(APP)
+    got = [0]
+    cb = StreamCallback()
+    cb.receive_chunk = lambda ch: got.__setitem__(0, got[0] + len(ch))
+    rt.add_callback("Out", cb)
+    rt.start()
+    h = rt.get_input_handler("S")
+    rng = np.random.default_rng(0)
+    syms = np.asarray([f"k{i}" for i in range(n_keys)], object)
+
+    def chunk(t0):
+        return ({"sym": syms[np.arange(chunk_n) % n_keys],
+                 "price": rng.uniform(0, 100, chunk_n).astype(np.float32),
+                 "kind": rng.integers(0, 2, chunk_n).astype(np.int64)},
+                t0 + np.arange(chunk_n, dtype=np.int64) * 2)
+
+    cols, ts = chunk(1_000_000)
+    h.send_batch(cols, timestamps=ts)              # warmup / compile
+    rt.flush()
+    got[0] = 0
+    rates = []
+    base = 1_000_000 + chunk_n * 2
+    for rep in range(ENGINE_REPEATS):
+        t0 = time.perf_counter()
+        for ci in range(chunks):
+            cols, ts = chunk(base + (rep * chunks + ci) * chunk_n * 2)
+            h.send_batch(cols, timestamps=ts)
+        rt.flush()
+        rates.append(chunk_n * chunks / (time.perf_counter() - t0))
+    rt.shutdown()
+    gc.collect()
+    return {f"{prefix}_events_per_sec": float(np.median(rates)),
+            f"{prefix}_events_per_sec_best": float(np.max(rates)),
+            f"{prefix}_outputs": got[0],
+            f"{prefix}_config": (f"{n_keys} keys, {config_desc}, "
+                                 f"{chunks} chunks of {chunk_n}, "
+                                 f"median of {ENGINE_REPEATS}")}
+
+
+def bench_engine_wagg():
+    """Windowed-agg ENGINE row (VERDICT r4 #2 'done' criterion): keyed
+    length-window aggregation through the public API — @Async junction →
+    pipelined DeviceWindowedAggRuntime (round-5 plan/pipeline.py) → per-
+    event running outputs → columnar callbacks.  r4's dwin/gagg/wagg
+    ingest was synchronous per chunk (one ~100-300 ms egress round-trip
+    each); the in-flight queue overlaps them."""
+    return _engine_agg_phase(
+        "from S#window.length(64)\n"
+        "select sym, avg(price) as ap, count() as c group by sym "
+        "insert into Out;",
+        "engine_wagg", "length(64) avg+count")
+
+
+def bench_engine_absent():
+    """Absent-pattern ENGINE row (VERDICT r4 weak #3: the absent family
+    was pinned to the synchronous path and never measured).  Round 5
+    pipelines it: the earliest pending deadline rides the egress tail, so
+    host TIMER scheduling reads nothing extra."""
+    return _engine_agg_phase(
+        "from every e1=S[kind == 0 and price > 97.0] -> "
+        "not S[kind == 1 and price > e1.price] for 3 sec\n"
+        "select e1.price as p1 insert into Out;",
+        "engine_absent", "alert-rate arm + trailing `not ... for 3 sec`")
 
 
 def bench_oracle():
@@ -591,6 +683,10 @@ def main():
             print(json.dumps(bench_latsweep()))
         elif phase == "engine":
             print(json.dumps(bench_engine()))
+        elif phase == "engine_wagg":
+            print(json.dumps(bench_engine_wagg()))
+        elif phase == "engine_absent":
+            print(json.dumps(bench_engine_absent()))
         return
 
     import jax
@@ -599,6 +695,8 @@ def main():
     lat = _run_phase("lat")
     sweep = _run_phase("latsweep")["sweep"]
     eng = _run_phase("engine")
+    eng_wagg = _run_phase("engine_wagg")
+    eng_absent = _run_phase("engine_absent")
     tpu_rate = thru["thru_rate"]
     p99_ms, p50_ms = lat["p99_ms"], lat["p50_ms"]
     matches, payloads, sample = (thru["matches"], thru["payloads"],
@@ -637,7 +735,16 @@ def main():
                                f"{eng['engine_chunks']} chunks of "
                                f"{eng['engine_chunk']}, @Async pipelined, "
                                "full payload delivery, host match parity "
-                               "asserted in tests"),
+                               "asserted in tests, median of "
+                               f"{eng.get('engine_repeats', 1)} repeats"),
+        "engine_path_events_per_sec_best": round(
+            eng.get("engine_events_per_sec_best", 0.0), 1),
+        **{k: (round(v, 1) if isinstance(v, float) else v)
+           for k, v in eng_wagg.items()},
+        **{k: (round(v, 1) if isinstance(v, float) else v)
+           for k, v in eng_absent.items()},
+        "jvm_baseline": "unavailable in image (no JVM): vs_baseline is "
+                        "the python host oracle, NOT JVM siddhi-core",
         "p99_match_latency_ms": round(p99_ms, 2),
         "p50_match_latency_ms": round(p50_ms, 2),
         "compute_only_block_ms_median": round(

@@ -72,12 +72,16 @@ class ProcessStreamReceiver:
         self.app_ctx = app_ctx
 
     def flush(self):
-        """Retire pipelined device work held by the head (if any) under
-        the query lock — junction idle/drain hook."""
-        f = getattr(self.first, "flush", None)
-        if f is not None:
-            with self.lock:
-                f()
+        """Retire pipelined device work held anywhere in the processor
+        chain (device ingress heads, mid-chain device windows) under the
+        query lock — junction idle/drain hook."""
+        p = self.first
+        while p is not None:
+            f = getattr(p, "flush", None)
+            if f is not None:
+                with self.lock:
+                    f()
+            p = getattr(p, "next", None)
 
     def receive_chunk(self, chunk: EventChunk):
         dbg = getattr(self.app_ctx, "debugger", None) if self.app_ctx else None
@@ -265,9 +269,15 @@ class QueryRuntime:
                 raise SiddhiAppCreationError(
                     f"device window path: {label} has no device kernel")
             return None
+        from ..plan.pipeline import resolve_depth
+        try:
+            depth = resolve_depth(app.app, [app.junction_of(definition.id)])
+        except Exception:      # noqa: BLE001 — inner/fault stream ids
+            depth = 0
         try:
             wp = DeviceWindowProcessor(app.app_ctx, definition, kind,
-                                       h.params, compiler.compile)
+                                       h.params, compiler.compile,
+                                       pipeline_depth=depth)
         except SiddhiAppCreationError:
             if mode == "device":
                 raise

@@ -68,7 +68,7 @@ class DeviceWindowProcessor(WindowProcessor):
     requires_scheduler = True            # per-kind below
 
     def __init__(self, app_ctx, definition, kind: str, params: List,
-                 compile_expr):
+                 compile_expr, pipeline_depth: int = 0):
         super().__init__(app_ctx, definition.attribute_names)
         self.kind = kind
         self.definition = definition
@@ -154,6 +154,12 @@ class DeviceWindowProcessor(WindowProcessor):
         self.window_end: Optional[int] = None
         self._fill_host = 0               # pre-step fill (interleave c0)
         self._exp_fill_host = 0
+        self._fill_disp = 0               # dispatch-side fill (lengthBatch)
+        # ingest pipelining (round 5, plan/pipeline.py): the query
+        # runtime's chain flush + timer/state paths drain _inflight
+        from collections import deque
+        self._inflight: "deque" = deque()
+        self.pipeline_depth = pipeline_depth
 
     # ------------------------------------------------------------ encode
 
@@ -191,6 +197,9 @@ class DeviceWindowProcessor(WindowProcessor):
         off = ts64 - self._base
         lim = int(TS_NONE) - max(self.window_ms, 1) - 1
         if len(off) and int(off.max()) > lim:
+            # rebase shifts the carried ring timestamps: retire in-flight
+            # work first so every queued step shares one base
+            self.flush()
             delta = int(off.min())
             ring = np.asarray(self.carry["ring_ts"])
             ring = np.where(ring == int(TS_NONE), ring,
@@ -338,11 +347,13 @@ class DeviceWindowProcessor(WindowProcessor):
 
     # ------------------------------------------------------------ step
 
-    def _run_step(self, chunk: Optional[EventChunk], now_val: int,
-                  directive: Optional[np.ndarray], n_done: int = 0):
-        """Dispatch one kernel step (chunk may be None for timer steps);
-        returns decoded egress (rows split into parts) after handling ring
-        growth (grow-and-replay)."""
+    def _dispatch_step(self, chunk: Optional[EventChunk], now_val: int,
+                       directive: Optional[np.ndarray],
+                       n_done: int = 0) -> dict:
+        """Encode + dispatch one kernel step without reading the egress
+        (chunk may be None for timer steps); returns a work dict for
+        `_read_work` — the pipelined ingest keeps a few in flight so the
+        D2H round-trip overlaps later dispatches (plan/pipeline.py)."""
         self._ensure_carry()
         if chunk is not None and not chunk.is_empty:
             if self.ts_expr is not None:
@@ -361,7 +372,6 @@ class DeviceWindowProcessor(WindowProcessor):
             ev_i = np.zeros((1, 1, I), np.int32)
             ts_off = np.zeros((1, 1), np.int32)
             valid = np.zeros((1, 1), bool)
-            ring_ts = np.zeros(0, np.int64)
         if self.kind in _BATCH_KINDS:
             now_arr = np.asarray([n_done], np.int32)
         elif self.kind == "externalTime":
@@ -376,24 +386,47 @@ class DeviceWindowProcessor(WindowProcessor):
                  else 0], np.int32)
         if directive is None:
             directive = np.zeros((1, T), np.int32)
-        # grow the ring pre-emptively when the chunk alone could overflow
-        while self._fill_host + T > self.capacity:
-            self._grow(self.capacity * 2)
+        # a chunk larger than the ring overflows unconditionally: grow
+        # up-front (rarer overflows are caught exactly by the kernel's
+        # overflow flag → rewind-and-replay at retirement)
+        if T > self.capacity:
+            self.flush()
+            while self._fill_host + T > self.capacity:
+                self._grow(self.capacity * 2)
+        work = {"inputs": (ev_f, ev_i, ts_off, valid, now_arr, directive),
+                "T": T, "base": self._base}
+        self._step_work(work)
+        return work
+
+    def _step_work(self, work: dict) -> None:
+        """(Re)run a work item's kernel step on the current carry."""
+        ev_f, ev_i, ts_off, valid, now_arr, directive = work["inputs"]
+        work["pre"] = dict(self.carry)
+        cap = 2 * self.capacity + work["T"]
+        step = self._step_for(work["T"])
+        self.carry, buf = step(self.carry, jnp.asarray(ev_f),
+                               jnp.asarray(ev_i), jnp.asarray(ts_off),
+                               jnp.asarray(valid), jnp.asarray(now_arr),
+                               jnp.asarray(directive), cap)
+        try:
+            buf.copy_to_host_async()
+        except Exception:       # backends without async copy
+            pass
+        work["buf"] = buf
+
+    def _read_work(self, work: dict):
+        """Block on a work item's egress; on ring overflow rewind to ITS
+        pre-carry, grow, and re-step until clean (the caller has already
+        drained any later in-flight work).  Updates the host fill mirrors
+        and splits the egress rows."""
         while True:
-            pre = dict(self.carry)
-            cap = 2 * self.capacity + T
-            step = self._step_for(T)
-            self.carry, buf = step(self.carry, jnp.asarray(ev_f),
-                                   jnp.asarray(ev_i), jnp.asarray(ts_off),
-                                   jnp.asarray(valid),
-                                   jnp.asarray(now_arr),
-                                   jnp.asarray(directive), cap)
-            buf = np.asarray(buf)
+            buf = np.asarray(work["buf"])
             tail = buf[-1]
             if int(tail[4]) == 0:         # no overflow
                 break
-            self.carry = pre
+            self.carry = work["pre"]
             self._grow(self.capacity * 2)
+            self._step_work(work)
         count = int(tail[0])
         self._fill_host = int(tail[1])
         self._exp_fill_host = int(tail[2])
@@ -403,6 +436,13 @@ class DeviceWindowProcessor(WindowProcessor):
         rows_i = rows[:, 4 + F:]
         return (rows[:, 0], rows[:, 1], rows[:, 2], rows[:, 3],
                 rows_f, rows_i, int(tail[3]))
+
+    def _run_step(self, chunk: Optional[EventChunk], now_val: int,
+                  directive: Optional[np.ndarray], n_done: int = 0):
+        """Synchronous dispatch + read (timer steps and non-pipelined
+        callers).  The caller must have flushed in-flight work first."""
+        return self._read_work(self._dispatch_step(chunk, now_val,
+                                                   directive, n_done))
 
     def _grow(self, new_cap: int):
         c = {k: np.asarray(v) for k, v in self.carry.items()}
@@ -423,16 +463,74 @@ class DeviceWindowProcessor(WindowProcessor):
 
     def on_data(self, chunk: EventChunk):
         now = int(chunk.timestamps[-1])
-        fill_pre = self._fill_host
         if self.kind in ("time", "delay", "timeLength"):
             self.app_ctx.scheduler.notify_at(now + self.window_ms,
                                              self._on_timer)
         if self.kind in _BATCH_KINDS:
-            self._batch_step(chunk, now)
+            work = self._batch_dispatch(chunk, now)
+        else:
+            work = self._dispatch_step(chunk, now, None)
+            work["emit"] = ("slide", chunk, None, None)
+        self._submit(work)
+
+    # ------------------------------------------------------------ pipeline
+
+    def _submit(self, work: dict) -> None:
+        self._inflight.append(work)
+        while len(self._inflight) > self.pipeline_depth:
+            self._retire_work(self._inflight.popleft())
+
+    def flush(self):
+        """Retire every in-flight chunk — called on junction idle/drain,
+        before timer steps, and before any state read.  Runs under the
+        query lock (the junction's receiver flush path holds it)."""
+        while self._inflight:
+            self._retire_work(self._inflight.popleft())
+
+    def _retire_work(self, work: dict) -> None:
+        buf = np.asarray(work["buf"])
+        if int(buf[-1][4]) != 0:
+            # ring overflow: later in-flight steps ran on the overflowed
+            # carry — rewind to this work's pre-carry, grow, replay all
+            # in order (exact: the kernel's overflow flag marks any step
+            # that lost a live entry)
+            pending = [work] + list(self._inflight)
+            self._inflight.clear()
+            self.carry = work["pre"]
+            self._grow(self.capacity * 2)
+            for w in pending:
+                self._step_work(w)
+                fill_pre = self._fill_host
+                exp_pre = self._exp_fill_host
+                parts = self._read_work(w)
+                self._emit_work(w, parts, fill_pre, exp_pre)
             return
-        (_idx, evt, cause, ts_off, rf, ri, _mn) = self._run_step(
-            chunk, now, None)
-        base = self._base or 0
+        fill_pre = self._fill_host
+        exp_pre = self._exp_fill_host
+        parts = self._read_work(work)
+        self._emit_work(work, parts, fill_pre, exp_pre)
+
+    def _emit_work(self, work: dict, parts, fill_pre: int,
+                   exp_fill_pre: int) -> None:
+        mode, chunk, n_done, flush_ts = work["emit"]
+        (_idx, evt, cause, ts_off, rf, ri, _mn) = parts
+        if mode == "slide":
+            self._emit_slide(chunk, work, evt, cause, ts_off, rf, ri,
+                             fill_pre)
+        else:
+            if self.kind == "lengthBatch":
+                # flush ts = each batch's last member arrival ts
+                base = work["base"] or 0
+                flush_ts = list(flush_ts)
+                for f in range(n_done):
+                    sel = (cause == C_BATCH) & (evt == f)
+                    flush_ts.append(int(ts_off[sel][-1]) + base)
+            self._emit_flushes(n_done, flush_ts, evt, cause, ts_off,
+                               rf, ri, exp_fill_pre)
+
+    def _emit_slide(self, chunk, work, evt, cause, ts_off, rf, ri,
+                    fill_pre: int) -> None:
+        base = work["base"] or 0
         if self.kind == "length":
             exp_ts = chunk.timestamps[np.minimum(evt, len(chunk) - 1)]
             expired = self._rows_to_chunk(rf, ri, exp_ts, EXPIRED)
@@ -482,14 +580,19 @@ class DeviceWindowProcessor(WindowProcessor):
                 outs.append(chunk.slice(i, i + 1).with_types(CURRENT))
             self.send_next(EventChunk.concat(outs))
 
-    def _batch_step(self, chunk: EventChunk, now: int):
+    def _batch_dispatch(self, chunk: EventChunk, now: int) -> dict:
+        """Host-side flush arithmetic + kernel dispatch for the batch
+        kinds.  The flush count (n_done) is computed from host mirrors
+        (`_fill_disp` for lengthBatch, next_emit / window_end for the
+        time kinds) so dispatch never reads the device."""
         T = len(chunk)
         flush_ts: List[int] = []
         directive = None
         n_done = 0
         if self.kind == "lengthBatch":
-            total = self._fill_host + T
+            total = self._fill_disp + T
             n_done = total // self.length
+            self._fill_disp = total % self.length
         elif self.kind == "timeBatch":
             if self.next_emit is None:
                 base = self.start_time if self.start_time is not None \
@@ -522,18 +625,9 @@ class DeviceWindowProcessor(WindowProcessor):
             n_done = 1
             flush_ts = [now]
 
-        exp_fill_pre = self._exp_fill_host
-        (_idx, evt, cause, ts_off, rf, ri, _mn) = self._run_step(
-            chunk, now, directive, n_done=n_done)
-        base = self._base or 0
-
-        if self.kind == "lengthBatch":
-            # flush ts = each batch's last member arrival ts
-            for f in range(n_done):
-                sel = (cause == C_BATCH) & (evt == f)
-                flush_ts.append(int(ts_off[sel][-1]) + base)
-        self._emit_flushes(n_done, flush_ts, evt, cause, ts_off, rf, ri,
-                           exp_fill_pre)
+        work = self._dispatch_step(chunk, now, directive, n_done=n_done)
+        work["emit"] = ("batch", chunk, n_done, flush_ts)
+        return work
 
     def _emit_flushes(self, n_done, flush_ts, evt, cause, ts_off, rf, ri,
                       exp_fill_pre):
@@ -596,6 +690,7 @@ class DeviceWindowProcessor(WindowProcessor):
         if self.kind in ("length", "lengthBatch", "batch",
                          "externalTime", "externalTimeBatch"):
             return
+        self.flush()       # timer steps read/advance the live carry
         if self.kind == "timeBatch":
             if self.next_emit is None:
                 return
@@ -633,6 +728,7 @@ class DeviceWindowProcessor(WindowProcessor):
     def find_chunk(self) -> Optional[EventChunk]:
         """Materialize the device ring for join probes / store queries —
         rare control-plane reads, so a full D2H here is fine."""
+        self.flush()
         self._ensure_carry()
         fill = self._fill_host
         if fill == 0:
@@ -644,6 +740,7 @@ class DeviceWindowProcessor(WindowProcessor):
         return self._rows_to_chunk(rf, ri, ts, CURRENT)
 
     def current_state(self):
+        self.flush()
         self._ensure_carry()
         return {"dwin": {k: np.asarray(v) for k, v in self.carry.items()},
                 "base": self._base, "capacity": self.capacity,
@@ -658,11 +755,13 @@ class DeviceWindowProcessor(WindowProcessor):
             raise SiddhiAppRuntimeException(
                 "device window path: snapshot was taken by the host "
                 "window processor")
+        self.flush()
         self.capacity = state["capacity"]
         self._steps = {}
         self.carry = {k: jnp.asarray(v) for k, v in state["dwin"].items()}
         self._base = state["base"]
         self._fill_host = state["fill"]
+        self._fill_disp = state["fill"]
         self._exp_fill_host = state["exp_fill"]
         self.next_emit = state["next_emit"]
         self.window_end = state["window_end"]

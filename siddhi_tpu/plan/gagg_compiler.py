@@ -60,6 +60,11 @@ def _reject(msg: str):
     raise SiddhiAppCreationError("device grouped-agg path: " + msg)
 
 
+class GaggOverflow(Exception):
+    """A still-in-window time-ring entry was evicted during a step —
+    decode() signals the caller to rewind, grow, and replay."""
+
+
 class _Value:
     """One distinct aggregate argument expression → one V lane."""
 
@@ -136,6 +141,9 @@ class CompiledGroupedAgg:
             _reject(f"only #window.length / #window.time / "
                     f"#window.externalTime / no window compile "
                     f"(got #{wh.name})")
+        # set by the pipelined runtime: retires in-flight work before a
+        # timestamp rebase mutates the ring (plan/pipeline.py)
+        self.flush_hook = None
         definition = app.stream_definitions.get(s.stream_id)
         if definition is None:
             _reject(f"no stream '{s.stream_id}'")
@@ -378,21 +386,33 @@ class CompiledGroupedAgg:
         src = (np.asarray(data.columns[self.ts_attr], np.int64)
                if self.ts_attr else
                np.asarray(data.timestamps, np.int64))
-        offs, self._ts_base, new_ring = rebase_offsets(
+        offs, base, new_ring = rebase_offsets(
             src, ok, self._ts_base, self.window_ms,
             self.carry.ring_ts, TS_EMPTY)
         if new_ring is not self.carry.ring_ts:
+            # rebase shifts the carried ring: retire in-flight work first
+            # so every queued step (and any overflow replay) shares one
+            # timestamp base, then recompute against the settled carry
+            if self.flush_hook is not None:
+                self.flush_hook()
+            offs, base, new_ring = rebase_offsets(
+                src, ok, self._ts_base, self.window_ms,
+                self.carry.ring_ts, TS_EMPTY)
             self.carry = self.carry._replace(ring_ts=new_ring)
+        self._ts_base = base
         plane = np.zeros(shape, np.int32)
         plane[lanes32, row] = offs
         return plane
 
     # ------------------------------------------------------------ execute
 
-    def process(self, lanes: np.ndarray, data) -> Optional[Dict[str, Any]]:
-        """data: EventChunk of CURRENT events, lanes: per-event lane index.
-        Returns columnar outputs for the accepted events (None if none):
-        {"mask": accepted [n], <out name>: [n_accepted]}."""
+    def dispatch(self, lanes: np.ndarray, data) -> Optional[Dict[str, Any]]:
+        """data: EventChunk of CURRENT events, lanes: per-event lane
+        index.  Host-side encode + ONE kernel dispatch; returns a work
+        dict whose un-read device handles `decode` consumes later
+        (pipelined ingest), or None when no event passes the filters.
+        Data errors that are host-detectable (2^31 integer lanes) raise
+        HERE, before any carry mutation."""
         from ..native_ext import assign_rows
         n = len(data)
         ctx = EvalCtx(data.columns, data.timestamps, n)
@@ -440,33 +460,59 @@ class CompiledGroupedAgg:
         i_plane[lanes32, row] = vals_i
         g_plane[lanes32, row] = gids
         ok_plane[lanes32, row] = ok
-        pre_carry = self.carry
+        work: Dict[str, Any] = {"data": data, "ok": ok,
+                                "lanes32": lanes32, "row": row}
         if self.window_kind == "time":
-            ts_plane = self._ts_offsets(data, lanes32, row, ok,
-                                        (P, T))
-            while True:
-                prev = self.carry
-                self.carry, outs = self._step(prev, f_plane, i_plane,
-                                              g_plane, ts_plane, ok_plane)
-                if not bool(np.asarray(self.carry.overflow).any()):
-                    break
-                # a still-in-window entry was evicted: results would
-                # undercount — grow the ring and replay from the
-                # pre-block carry (exact, like ops/windowed_agg)
-                self.carry = prev
-                if self.window * 2 > MAX_WINDOW + 1:
-                    # check BEFORE growing: the compaction + fresh kernel
-                    # build would be wasted work right before the raise
-                    raise SiddhiAppRuntimeException(
-                        "device grouped-agg path: time window needs more "
-                        "than 2^15 live entries (exact int-sum bound) — "
-                        "re-plan with @app:engine('host')")
-                self._grow_time_capacity(self.window * 2)
+            ts_plane = self._ts_offsets(data, lanes32, row, ok, (P, T))
+            work["planes"] = (f_plane, i_plane, g_plane, ts_plane,
+                              ok_plane)
         else:
-            self.carry, outs = self._step(self.carry, f_plane, i_plane,
-                                          g_plane, ok_plane)
+            work["planes"] = (f_plane, i_plane, g_plane, ok_plane)
+        self.redispatch(work)
+        return work
+
+    def redispatch(self, work: Dict[str, Any]) -> None:
+        """(Re)run a work item's kernel step on the CURRENT carry —
+        used at dispatch and when replaying in-flight chunks after a
+        ring growth rewind."""
+        work["pre_carry"] = self.carry
+        self.carry, outs = self._step(self.carry, *work["planes"])
+        for o in outs:
+            try:
+                o.copy_to_host_async()
+            except Exception:   # backends without async copy
+                break
+        work["outs"] = outs
+        work["post_carry"] = self.carry
+
+    def grow_time_window(self) -> None:
+        """Double the time-window ring (the caller has already rewound
+        self.carry to the failing chunk's pre-carry)."""
+        if self.window * 2 > MAX_WINDOW + 1:
+            # check BEFORE growing: the compaction + fresh kernel build
+            # would be wasted work right before the raise
+            raise SiddhiAppRuntimeException(
+                "device grouped-agg path: time window needs more "
+                "than 2^15 live entries (exact int-sum bound) — "
+                "re-plan with @app:engine('host')")
+        self._grow_time_capacity(self.window * 2)
+
+    def decode(self, work: Dict[str, Any]) -> Dict[str, Any]:
+        """Block on a work item's device handles and decode the per-event
+        outputs.  Raises GaggOverflow when a still-in-window time-ring
+        entry was evicted (results would undercount) — the caller rewinds
+        to work["pre_carry"], grows, and replays this and every later
+        in-flight chunk.  Raises SiddhiAppRuntimeException on the exact
+        integer-sum bound — the caller rewinds likewise (the reference's
+        @OnError continuation must not see the chunk half-applied)."""
+        data, ok = work["data"], work["ok"]
+        lanes32, row = work["lanes32"], work["row"]
+        if self.window_kind == "time" and \
+                bool(np.asarray(work["post_carry"].overflow).any()):
+            raise GaggOverflow()
         (fhi, flo, ihi, ilo, cnt, w_mnf, w_mxf, w_mni, w_mxi,
-         a_mnf, a_mxf, a_mni, a_mxi) = [np.asarray(o) for o in outs]
+         a_mnf, a_mxf, a_mni, a_mxi) = [np.asarray(o)
+                                        for o in work["outs"]]
         sel_l, sel_r = lanes32[ok], row[ok]
 
         def pick(a):
@@ -474,13 +520,6 @@ class CompiledGroupedAgg:
         counts = pick(cnt).astype(np.int64)
         if self._int_sum_needed and self.window == 0 and \
                 int(counts.max(initial=0)) >= INT_GROUP_MAX:
-            # running (no-window) hi/lo sums are exact only below 2^15
-            # live entries per group (i32 partial-sum bound).  Restore
-            # the pre-block carry BEFORE raising so @OnError continuation
-            # sees consistent state (ADVICE r3: the error must not leave
-            # the dropped chunk half-applied)
-            if self.window_kind != "time":
-                self.carry = pre_carry
             raise SiddhiAppRuntimeException(
                 "device grouped-agg path: a group accumulated >= 2^15 "
                 "events; exact running integer sums exceed the i32 "

@@ -678,6 +678,7 @@ class CompiledPatternNFA:
             n_last=tuple(n_last), idx_banks=tuple(idx_banks),
             lastk_banks=tuple(lastk_banks), m_src=tuple(m_src))
         self.has_absent = any(u.kind == "absent" for u in self.units)
+        self.last_min_deadline: Optional[int] = None
         from ..parallel.mesh import auto_mesh, round_up_partitions
         self.mesh = auto_mesh() if isinstance(mesh, str) and mesh == "auto" \
             else mesh
@@ -1257,7 +1258,7 @@ class CompiledPatternNFA:
         if not hasattr(self, "_egress_cap"):
             self._egress_cap = 1024
 
-        def pack(mask, caps, ts, enter, seq, dropped, cap):
+        def pack(mask, caps, ts, enter, seq, dropped, dl_st, dl, cap):
             flat = mask.reshape(-1)
             (idx,) = jnp.nonzero(flat, size=cap, fill_value=-1)
             safe = jnp.maximum(idx, 0)
@@ -1269,19 +1270,35 @@ class CompiledPatternNFA:
             tail = jnp.zeros((1, 4 + R * C), jnp.int32)
             tail = tail.at[0, 0].set(jnp.sum(flat.astype(jnp.int32)))
             tail = tail.at[0, 1].set(jnp.sum(dropped))
+            if dl is not None:
+                # earliest live absent-state deadline rides the egress
+                # tail (free column): the pipelined engine schedules its
+                # host TIMER off the retired chunk's carry with NO extra
+                # device read (VERDICT r4 #2)
+                S = len(self.spec.units)
+                absent = jnp.asarray(
+                    [u.kind == "absent" for u in self.spec.units] +
+                    [False], bool)
+                waiting = absent[jnp.clip(dl_st, 0, S)] & (dl_st >= 0)
+                dmin = jnp.min(jnp.where(waiting, dl,
+                                         jnp.int32(2 ** 31 - 1)))
+                tail = tail.at[0, 2].set(dmin)
             return jnp.concatenate([rows, tail], axis=0)
 
         if not hasattr(self, "_egress_jit"):
-            self._egress_jit = jax.jit(pack, static_argnums=6)
+            self._egress_jit = jax.jit(pack, static_argnums=8)
         dropped = self.carry["dropped"]
+        dl_st = self.carry["slot_state"] if self.has_absent else None
+        dl = self.carry.get("deadline") if self.has_absent else None
         buf = self._egress_jit(mask, caps, ts, enter, seq, dropped,
-                               self._egress_cap)
+                               dl_st, dl, self._egress_cap)
         try:
             buf.copy_to_host_async()
         except Exception:       # backends without async copy: retire blocks
             pass
         return {"buf": buf, "cap": self._egress_cap, "outs": outs,
-                "dropped": dropped, "tk": (T, K)}
+                "dropped": dropped, "dl_st": dl_st, "dl": dl,
+                "dl_base": self.base_ts, "tk": (T, K)}
 
     def egress_retire(self, handle):
         """Phase 2: block on the transfer, re-pack at a doubled cap if the
@@ -1299,9 +1316,15 @@ class CompiledPatternNFA:
             self._egress_cap = max(self._egress_cap, cap)
             mask, caps, ts, enter, seq = handle["outs"]
             buf = np.asarray(self._egress_jit(
-                mask, caps, ts, enter, seq, handle["dropped"], cap))
+                mask, caps, ts, enter, seq, handle["dropped"],
+                handle["dl_st"], handle["dl"], cap))
             count = int(buf[-1, 0])
             self.last_dropped_total = int(buf[-1, 1])
+        if self.has_absent:
+            dmin = int(buf[-1, 2])
+            self.last_min_deadline = (
+                None if dmin == 2 ** 31 - 1
+                else dmin + (handle["dl_base"] or 0))
         return buf[:count], handle["tk"]
 
     def _compact_egress(self, mask, caps, ts, enter, seq):

@@ -1,0 +1,70 @@
+"""Shared ingest pipelining for device runtimes.
+
+The engine's ingest hot loop pays a device→host read per chunk (~100-300
+ms through a remote-tunnel TPU) to decode kernel egress.  Round 4
+overlapped that round-trip with later dispatches on the pattern path
+only; this base extends the same in-flight machinery to every device
+runtime (filter / grouped-agg / windowed-agg / device-window), ≙ the
+ingest/compute overlap of the reference's @Async disruptor junction
+(stream/StreamJunction.java:280-316).
+
+Contract for subclasses:
+  - call ``_init_pipeline(app, stream_ids)`` after ``self.qr`` is set;
+  - dispatch device work in ``ingest`` and hand the un-read handles to
+    ``_submit(work)``;
+  - implement ``_retire(work)`` — block on the handles, decode, emit
+    (data errors raised there surface at the caller's @OnError
+    boundary: a later ingest's submit or a junction flush);
+  - any operation that mutates shared device state out-of-band (lane
+    growth, snapshot, restore, timer steps) must ``flush()`` first.
+
+Depth resolution matches the pattern path: deferred delivery is only
+transparent when the sender is already decoupled, so pipelining
+auto-enables iff every input junction is @Async (flushes ride the
+worker's idle/drain hooks); ``@app:pipeline('D')`` forces a depth.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, Iterable
+
+from ..query_api.annotation import find_annotation
+
+DEFAULT_DEPTH = 4
+
+
+def resolve_depth(app, junctions: Iterable[Any]) -> int:
+    ann = find_annotation(app.annotations, "app:pipeline") or \
+        find_annotation(app.annotations, "pipeline")
+    if ann is not None:
+        pos = ann.positional()
+        return int(pos[0] if pos else ann.get("depth", str(DEFAULT_DEPTH)))
+    if all(j.is_async for j in junctions):
+        return DEFAULT_DEPTH
+    return 0
+
+
+class PipelinedDeviceIngest:
+    """In-flight chunk queue: dispatch now, read/decode ``depth`` chunks
+    later (FIFO, so emission order is preserved)."""
+
+    def _init_pipeline(self, app, stream_ids: Iterable[str]) -> None:
+        self._inflight: "deque" = deque()
+        self.pipeline_depth = resolve_depth(
+            app.app, [app.junction_of(sid) for sid in stream_ids])
+
+    def _submit(self, work: Dict[str, Any]) -> None:
+        self._inflight.append(work)
+        while len(self._inflight) > self.pipeline_depth:
+            self._retire(self._inflight.popleft())
+
+    def flush(self) -> None:
+        """Retire every in-flight chunk: called on idle/drain by the
+        async junction and before any state read.  Takes the query lock
+        (re-entrant) — state reads can race the junction worker."""
+        with self.qr.lock:
+            while self._inflight:
+                self._retire(self._inflight.popleft())
+
+    def _retire(self, work: Dict[str, Any]) -> None:
+        raise NotImplementedError

@@ -27,8 +27,10 @@ from ..query_api import StateInputStream, find_annotation
 from ..query_api.definition import Attribute, AttrType, StreamDefinition
 from ..query_api.expression import Variable
 from ..query_api.query import OutputEventsFor
-from ..utils.errors import SiddhiAppCreationError
+from ..utils.errors import (SiddhiAppCreationError,
+                            SiddhiAppRuntimeException)
 from .nfa_compiler import CompiledPatternNFA
+from .pipeline import PipelinedDeviceIngest
 
 ENGINE_ENV = "SIDDHI_TPU_ENGINE"
 DEFAULT_SLOTS = 8
@@ -193,26 +195,19 @@ class DevicePatternRuntime:
             qr.receivers[stream_id] = recv
 
         # ingest pipelining: keep up to `depth` chunks in flight so the
-        # egress read round-trip overlaps later dispatches.  Deferred
-        # delivery is only transparent when the sender is already
-        # decoupled, so it auto-enables iff every input junction is @Async
-        # (flushes ride the worker's idle/drain hooks); @app:pipeline('D')
-        # forces a depth either way.  Absent patterns stay synchronous:
-        # their timer scheduling reads NFA state after every chunk.
+        # egress read round-trip overlaps later dispatches
+        # (plan/pipeline.py shares the depth contract).  Absent patterns
+        # pipeline too (round 5): the earliest pending deadline rides the
+        # egress tail, so the host TIMER is scheduled off the retired
+        # (chunk-delayed) carry with no extra device read — in-kernel
+        # deadline passes keep deadline-vs-event ordering exact for
+        # deadlines that expire during later chunks, and idle/drain
+        # flushes bound the wall-clock tail
+        from .pipeline import resolve_depth
         self._inflight: "deque" = deque()
-        ann = find_annotation(app.app.annotations, "app:pipeline") or \
-            find_annotation(app.app.annotations, "pipeline")
-        if ann is not None:
-            pos = ann.positional()
-            self.pipeline_depth = int(pos[0] if pos
-                                      else ann.get("depth", "4"))
-        elif all(app.junction_of(sid).is_async
-                 for sid in self.nfa.stream_codes):
-            self.pipeline_depth = 4
-        else:
-            self.pipeline_depth = 0
-        if self.nfa.has_absent:
-            self.pipeline_depth = 0
+        self.pipeline_depth = resolve_depth(
+            app.app, [app.junction_of(sid)
+                      for sid in self.nfa.stream_codes])
 
     # ------------------------------------------------------------ ingest
 
@@ -290,8 +285,6 @@ class DevicePatternRuntime:
         # stream/StreamJunction.java:280-316)
         while len(self._inflight) > self.pipeline_depth:
             self._retire_one()
-        if self.nfa.has_absent:
-            self._schedule_absent()
 
     def _retire_one(self) -> None:
         """Block on the oldest in-flight chunk, handle slot-ring overflow
@@ -321,9 +314,15 @@ class DevicePatternRuntime:
                     self.nfa.base_ts = pre_base
                     self.nfa.grow_slots(self.nfa.spec.n_slots * 2)
                 self._emit_columns(pids, ts, cols)
+            if self.nfa.has_absent:
+                self._schedule_absent(self.nfa.last_min_deadline)
             return
         self._dropped_seen = max(dropped, self._dropped_seen)
         self._emit_columns(pids, ts, cols)
+        if self.nfa.has_absent:
+            # schedule off the retired chunk's carry — the deadline rode
+            # the egress tail, no extra device read (see egress_dispatch)
+            self._schedule_absent(self.nfa.last_min_deadline)
 
     def flush(self) -> None:
         """Retire every in-flight chunk (pipelined mode): called on idle/
@@ -361,11 +360,13 @@ class DevicePatternRuntime:
 
     # -------------------------------------------------- absent-state timers
 
-    def _schedule_absent(self) -> None:
+    def _schedule_absent(self, dl: Optional[int] = "read") -> None:
         """Arm a host TIMER at the earliest pending `not … for t` deadline
         (≙ AbsentStreamPreStateProcessor scheduling wakeups via
-        util/Scheduler.java)."""
-        dl = self.nfa.min_pending_deadline()
+        util/Scheduler.java).  Retirement passes the egress-borne value;
+        start/restore/timer paths read the live carry."""
+        if dl == "read":
+            dl = self.nfa.min_pending_deadline()
         if dl is None or dl == self._scheduled_deadline or self._shutdown:
             return
         self._scheduled_deadline = dl
@@ -426,11 +427,12 @@ class DevicePatternRuntime:
             self._schedule_absent()
 
 
-class DeviceWindowedAggRuntime:
+class DeviceWindowedAggRuntime(PipelinedDeviceIngest):
     """Partitioned length-window aggregation on the sliding-window kernel
     (ops/windowed_agg.py): partition keys become group lanes of one ring
     slab (BASELINE config 2 — the reference's per-key window buffers +
-    per-group aggregator maps, QuerySelector.java:171)."""
+    per-group aggregator maps, QuerySelector.java:171).  Ingest is
+    pipelined (round 5, plan/pipeline.py)."""
 
     backend = "device"
 
@@ -543,11 +545,18 @@ class DeviceWindowedAggRuntime:
             app.latency_tracker_for(qr.name), qr.name, app.app_ctx)
         app.junction_of(self.cwa.stream_id).subscribe(recv)
         qr.receivers[self.cwa.stream_id] = recv
+        self._init_pipeline(app, [self.cwa.stream_id])
 
     # ------------------------------------------------------------ ingest
 
+    def _grow(self, cap: int) -> None:
+        # lane growth re-shapes the [P, ...] blocks: retire in-flight
+        # work first so replay never mixes widths
+        self.flush()
+        self.cwa.grow(cap)
+
     def ingest(self, stream_code: int, stream_id: str, chunk) -> None:
-        from ..core.event import CURRENT, EventChunk
+        from ..core.event import CURRENT
         from ..ops.nfa import pack_blocks
         data = chunk.only(CURRENT)
         if data.is_empty:
@@ -561,7 +570,7 @@ class DeviceWindowedAggRuntime:
                 return
         n = len(data)
         lanes = map_keys_to_lanes(self.key_lanes, keys,
-                                  self.cwa.n_partitions, self.cwa.grow)
+                                  self.cwa.n_partitions, self._grow)
         P = self.cwa.n_partitions
         cols = {a.name: np.asarray(data.columns[a.name])
                 for a in self.cwa.input_definition.attributes
@@ -582,6 +591,19 @@ class DeviceWindowedAggRuntime:
             ts64[lanes, rows] = src
             block["__ts64"] = ts64
         outs = self.cwa.process_block(block)
+        for o in outs:
+            try:
+                o.copy_to_host_async()
+            except Exception:   # backends without async copy
+                break
+        self._submit({"outs": outs, "data": data, "lanes": lanes,
+                      "rows": rows})
+
+    def _retire(self, work) -> None:
+        from ..core.event import EventChunk
+        outs, data = work["outs"], work["data"]
+        lanes, rows = work["lanes"], work["rows"]
+        n = len(data)
         sums = np.asarray(outs[0])
         counts = np.asarray(outs[1])
         mins = np.asarray(outs[2]) if len(outs) > 2 else None
@@ -628,22 +650,31 @@ class DeviceWindowedAggRuntime:
 
     # ------------------------------------------------------------ snapshot
 
+    def shutdown(self) -> None:
+        self.flush()
+
     def current_state(self) -> dict:
-        return {"cwa": self.cwa.current_state(),
-                "key_lanes": dict(self.key_lanes)}
+        with self.qr.lock:
+            self.flush()
+            return {"cwa": self.cwa.current_state(),
+                    "key_lanes": dict(self.key_lanes)}
 
     def restore_state(self, state: dict) -> None:
-        self.cwa.restore_state(state["cwa"])
-        self.key_lanes = dict(state["key_lanes"])
+        with self.qr.lock:
+            self.flush()
+            self.cwa.restore_state(state["cwa"])
+            self.key_lanes = dict(state["key_lanes"])
 
 
-class DeviceGroupedAggRuntime:
+class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
     """Aggregation query on the grouped/running device kernel
     (plan/gagg_compiler.CompiledGroupedAgg → ops/grouped_agg): group-by
     keys finer than (or different from) the partition key, no-window
     running aggregates, minForever/maxForever, and exact INT/LONG sums.
     Keyed mode maps partition keys to lanes (like DevicePatternRuntime);
-    unkeyed mode runs one lane."""
+    unkeyed mode runs one lane.  Ingest is pipelined (round 5): each
+    chunk's kernel step dispatches immediately, the egress read + decode
+    retires up to `pipeline_depth` chunks later (plan/pipeline.py)."""
 
     backend = "device"
 
@@ -706,11 +737,19 @@ class DeviceGroupedAggRuntime:
         app.junction_of(self.cga.stream_id, sis.is_inner,
                         sis.is_fault).subscribe(recv)
         qr.receivers[self.cga.stream_id] = recv
+        self._init_pipeline(app, [self.cga.stream_id])
+        self.cga.flush_hook = self.flush
 
     # ------------------------------------------------------------ ingest
 
+    def _grow_lanes(self, cap: int) -> None:
+        # lane growth re-shapes the [P, ...] planes: retire in-flight
+        # work first so replay never mixes widths
+        self.flush()
+        self.cga.grow_lanes(cap)
+
     def ingest(self, stream_code: int, stream_id: str, chunk) -> None:
-        from ..core.event import CURRENT, EventChunk
+        from ..core.event import CURRENT
         data = chunk.only(CURRENT)
         if data.is_empty:
             return
@@ -724,12 +763,63 @@ class DeviceGroupedAggRuntime:
                     return
             lanes = map_keys_to_lanes(self.key_lanes, keys,
                                       self.cga.n_lanes,
-                                      self.cga.grow_lanes)
+                                      self._grow_lanes)
         else:
             lanes = np.zeros(len(data), np.int64)
-        res = self.cga.process(lanes, data)
-        if res is None:
+        work = self.cga.dispatch(lanes, data)
+        if work is None:
             return
+        self._submit(work)
+
+    def _retire(self, work) -> None:
+        from .gagg_compiler import GaggOverflow
+        try:
+            res = self.cga.decode(work)
+        except GaggOverflow:
+            # a still-in-window time-ring entry was evicted: rewind to
+            # this chunk's pre-carry, grow the ring, replay it and every
+            # later in-flight chunk (exact — no undercounted windows)
+            pending = [work] + list(self._inflight)
+            self._inflight.clear()
+            self.cga.carry = work["pre_carry"]
+            self.cga.grow_time_window()
+            for w in pending:
+                while True:
+                    self.cga.redispatch(w)
+                    try:
+                        res = self.cga.decode(w)
+                        break
+                    except GaggOverflow:
+                        self.cga.carry = w["pre_carry"]
+                        self.cga.grow_time_window()
+                self._emit(w, res)
+            return
+        except SiddhiAppRuntimeException:
+            # data error (exact-sum bound, running-agg configs only — a
+            # time window never trips it, so the two handlers are
+            # mutually exclusive by config): drop the chunk — rewind its
+            # carry, replay the LATER chunks (they are independent), and
+            # re-raise at the @OnError boundary.  A replayed chunk that
+            # trips the bound AGAIN (the rewind moved it closer to the
+            # limit) is un-applied and dropped the same way, never left
+            # half-applied
+            rest = list(self._inflight)
+            self._inflight.clear()
+            self.cga.carry = work["pre_carry"]
+            for w in rest:
+                self.cga.redispatch(w)
+                try:
+                    res = self.cga.decode(w)
+                except SiddhiAppRuntimeException:
+                    self.cga.carry = w["pre_carry"]
+                    continue
+                self._emit(w, res)
+            raise
+        self._emit(work, res)
+
+    def _emit(self, work, res) -> None:
+        from ..core.event import EventChunk
+        data = work["data"]
         ok = res.pop("mask")
         names = [o[0] for o in self.cga.outputs]
         cols: Dict[str, np.ndarray] = {}
@@ -751,23 +841,29 @@ class DeviceGroupedAggRuntime:
         pass
 
     def shutdown(self) -> None:
-        pass
+        self.flush()
 
     # ------------------------------------------------------------ snapshot
 
     def current_state(self) -> dict:
-        return {"cga": self.cga.current_state(),
-                "key_lanes": dict(self.key_lanes)}
+        with self.qr.lock:
+            self.flush()
+            return {"cga": self.cga.current_state(),
+                    "key_lanes": dict(self.key_lanes)}
 
     def restore_state(self, state: dict) -> None:
-        self.cga.restore_state(state["cga"])
-        self.key_lanes = dict(state["key_lanes"])
+        with self.qr.lock:
+            self.flush()
+            self.cga.restore_state(state["cga"])
+            self.key_lanes = dict(state["key_lanes"])
 
 
-class DeviceFilterRuntime:
+class DeviceFilterRuntime(PipelinedDeviceIngest):
     """Stateless filter/project query as one jitted column program — the
     device replacement for the reference's per-event expression-tree DFS
-    (FilterProcessor.java:55-67 + QuerySelector attribute processors)."""
+    (FilterProcessor.java:55-67 + QuerySelector attribute processors).
+    Ingest is pipelined (round 5, plan/pipeline.py): stateless, so the
+    deferred mask read needs no replay machinery at all."""
 
     backend = "device"
 
@@ -942,12 +1038,12 @@ class DeviceFilterRuntime:
         app.junction_of(sis.stream_id, sis.is_inner,
                         sis.is_fault).subscribe(recv)
         qr.receivers[sis.stream_id] = recv
+        self._init_pipeline(app, [sis.stream_id])
 
     # ------------------------------------------------------------ ingest
 
     def ingest(self, stream_code: int, stream_id: str, chunk) -> None:
         import jax.numpy as jnp
-        from ..core.event import TIMER, RESET, EventChunk
         n = len(chunk)
         if n == 0:
             return
@@ -971,7 +1067,17 @@ class DeviceFilterRuntime:
         valid = np.zeros(n_pad, bool)
         valid[:n] = True
         ok, outs = self._program(cols, jnp.asarray(ts), jnp.asarray(valid))
-        ok = np.asarray(ok)[:n]
+        for o in [ok] + list(outs):
+            try:
+                o.copy_to_host_async()
+            except Exception:   # backends without async copy
+                break
+        self._submit({"ok": ok, "outs": outs, "chunk": chunk, "n": n})
+
+    def _retire(self, work) -> None:
+        from ..core.event import TIMER, RESET, EventChunk
+        chunk, n, outs = work["chunk"], work["n"], work["outs"]
+        ok = np.asarray(work["ok"])[:n]
         # TIMER/RESET rows always pass (host FilterProcessor parity)
         ok = ok | (chunk.types == TIMER) | (chunk.types == RESET)
         if not ok.any():
@@ -1004,7 +1110,11 @@ class DeviceFilterRuntime:
     def start(self) -> None:
         pass
 
+    def shutdown(self) -> None:
+        self.flush()
+
     def current_state(self):
+        self.flush()
         return None
 
     def restore_state(self, state):
