@@ -287,40 +287,17 @@ class JoinRuntime:
                 types.setdefault((s.stream_id, a.name), a.type)
                 types.setdefault((None, a.name), a.type)
 
-        def is_str_var(e):
-            from ..query_api.expression import Variable
-            return isinstance(e, Variable) and \
-                types.get((e.stream_id, e.attribute)) == AttrType.STRING
-
-        # STRING attributes ride dictionary-code lanes (one shared dict →
-        # code equality ⟺ string equality), so they are legal ONLY as
-        # both sides of an ==/!= compare.  Anything else — order compares
-        # (codes carry no order), string constants, functions — rejects.
-        self._str_join_attrs = set()
-
-        def scan(e):
-            from ..query_api.expression import Compare, CompareOp
-            if isinstance(e, Compare):
-                ls, rs = is_str_var(e.left), is_str_var(e.right)
-                if ls or rs:
-                    if not (ls and rs) or e.op not in (CompareOp.EQ,
-                                                       CompareOp.NEQ):
-                        raise ValueError(
-                            "string attributes join only via ==/!= "
-                            "against string attributes on the device")
-                    self._str_join_attrs.add(e.left.attribute)
-                    self._str_join_attrs.add(e.right.attribute)
-                    return
-            for x in expr_children(e):
-                scan(x)
-            if is_str_var(e):
-                raise ValueError(
-                    f"string attribute '{e.attribute}' outside an ==/!= "
-                    f"compare")
+        # STRING compares (equality AND order, var-vs-var/var-vs-const)
+        # and exact DOUBLE compares rewrite onto per-probe lanes —
+        # order-preserving rank codes / monotone 64-bit keys split into
+        # i32 pairs (round 5, plan/join_lanes.py)
+        from ..plan.join_lanes import JoinLanes, JoinRewriteError
+        jl = JoinLanes(types)
         try:
-            scan(jis.on)
-        except ValueError as ve:
+            dev_cond = jl.rewrite(jis.on)
+        except JoinRewriteError as ve:
             return _fail(str(ve))
+        self._jlanes = jl
 
         # INT/LONG variables are range-guarded per column (2^24), but
         # arithmetic ON them (L.id * R.id) can leave the exact range even
@@ -337,60 +314,52 @@ class JoinRuntime:
             return _fail("arithmetic on INT/LONG attributes can leave the "
                          "f32 exact-integer range")
 
-        def f32_unsafe_const(e) -> bool:
-            # a float constant that is not exactly representable in f32
-            # rounds on the device lanes, so borderline compares (notably
-            # FLOAT-attr equality vs a double literal like 50.1) could
-            # match where the host's float64 promotion never does —
-            # mirror of the DOUBLE-attribute guard below
-            from ..query_api.expression import Constant as _C
-            if isinstance(e, _C) and isinstance(e.value, float) and \
-                    float(np.float32(e.value)) != e.value:
-                return True
-            return any(f32_unsafe_const(x) for x in expr_children(e))
-        if f32_unsafe_const(jis.on):
-            return _fail("a float constant in the on-condition is not "
-                         "exactly representable in float32")
         for v in variables_of(jis.on):
             t = types.get((v.stream_id, v.attribute))
             if t is None:
                 continue            # resolution errors surface on host
-            if t == AttrType.DOUBLE:
-                return _fail(f"DOUBLE attribute '{v.attribute}' needs the "
-                             f"host's float64 compare")
             if t == AttrType.OBJECT:
                 return _fail(f"non-numeric attribute '{v.attribute}'")
-            if t == AttrType.STRING and \
-                    v.attribute not in self._str_join_attrs:
-                return _fail(f"string attribute '{v.attribute}' outside "
-                             f"an ==/!= compare")
         try:
             import jax
             import jax.numpy as jnp
-            # device scope: validated string attrs re-typed as LONG (they
-            # arrive as dictionary-code lanes), everything else mirrored
-            # from the joined scope's wiring
+            # device scope: numeric attrs mirror the joined scope's
+            # wiring; string/double attrs never reach the program raw —
+            # the rewritten condition reads their per-probe lanes (exact
+            # i32 columns)
+            lane_map = jl.lane_map()
             dev_scope = Scope()
             seen_u: set = set()
             for s in (self.left, self.right):
-                for a in s.definition.attributes:
-                    t = AttrType.LONG if (
-                        a.type == AttrType.STRING and
-                        a.name in self._str_join_attrs) else a.type
-
-                    def g(ctx, _r=s.ref, _a=a.name):
+                side_attrs = {a.name for a in s.definition.attributes}
+                entries = [(a.name, a.type)
+                           for a in s.definition.attributes
+                           if a.type not in (AttrType.STRING,
+                                             AttrType.DOUBLE,
+                                             AttrType.OBJECT)]
+                entries += [(lane, AttrType.INT)
+                            for (lane, src) in lane_map
+                            if src is None or src in side_attrs]
+                for name, t in entries:
+                    def g(ctx, _r=s.ref, _a=name):
                         return ctx.qualified[(_r, 0)][_a]
-                    dev_scope.add(s.ref, a.name, t, g)
+                    dev_scope.add(s.ref, name, t, g)
                     if s.stream_id != s.ref:
-                        dev_scope.add(s.stream_id, a.name, t, g)
-                    if a.name not in seen_u:
-                        seen_u.add(a.name)
-                        dev_scope.add(None, a.name, t, g)
-            dev_on = _EC(dev_scope, jnp).compile(jis.on)
+                        dev_scope.add(s.stream_id, name, t, g)
+                    if name not in seen_u:
+                        seen_u.add(name)
+                        dev_scope.add(None, name, t, g)
+            dev_on = _EC(dev_scope, jnp).compile(dev_cond)
 
             refs = []
             for s in (self.left, self.right):
-                names = [a.name for a in s.definition.attributes]
+                side_attrs = {a.name for a in s.definition.attributes}
+                names = [a.name for a in s.definition.attributes
+                         if a.type not in (AttrType.STRING,
+                                           AttrType.DOUBLE,
+                                           AttrType.OBJECT)]
+                names += [lane for (lane, src) in lane_map
+                          if src is None or src in side_attrs]
                 keys = [s.ref] + ([s.stream_id]
                                   if s.stream_id != s.ref else [])
                 refs.append((keys, names))
@@ -428,15 +397,13 @@ class JoinRuntime:
             for (keys, names), s in ((refs[0], self.left),
                                      (refs[1], self.right)):
                 warm[s.side] = {
-                    a.name: jnp.zeros((1,), jnp.float32)
-                    for a in s.definition.attributes
-                    if a.type not in (AttrType.STRING, AttrType.OBJECT)
-                    or a.name in self._str_join_attrs}
+                    nm: jnp.zeros((1,), jnp.int32 if nm.startswith("__")
+                                  else jnp.float32)
+                    for nm in names}
             self._probe_jit(warm["left"], warm["right"],
                             jnp.zeros((1,), bool), jnp.zeros((1,), bool),
                             4)
             self.device_probe = probe
-            self._str_codes: Dict = {}
             # condition-referenced attrs per definition: a referenced
             # column that arrives object-typed (outer-join nulls upstream)
             # must force the host mask, not vanish from the feed
@@ -455,38 +422,28 @@ class JoinRuntime:
         order via the device probe, or None when a runtime guard (int
         2^24 exactness) demands the host path."""
         import jax.numpy as jnp
+        from ..query_api.definition import AttrType
         left_first = side.side == "left"
         chunks = {"left": data if left_first else buf,
                   "right": buf if left_first else data}
+        skip = {}
+        for s in (self.left, self.right):
+            skip[s.side] = {a.name for a in s.definition.attributes
+                            if a.type in (AttrType.STRING, AttrType.DOUBLE,
+                                          AttrType.OBJECT)}
         cols = {}
         for sd, c in chunks.items():
             cc = {}
             for a in c.names:
+                if a in skip[sd]:
+                    continue           # lanes carry strings/doubles
                 col = c.columns[a]
                 if col.dtype == object:
-                    if a not in self._str_join_attrs:
-                        if a in self._cond_attrs:
-                            # a numeric column promoted to object (nulls
-                            # from an upstream outer join): host mask owns
-                            # null-compare semantics
-                            return None
-                        continue
-                    # string ==/!= rides shared dictionary-code lanes;
-                    # nulls guard to the host mask (reference law:
-                    # null == null is FALSE — code 0 == 0 would be true)
-                    enc = np.empty(len(col), np.float32)
-                    codes = self._str_codes
-                    for i, v in enumerate(col):
-                        if v is None:
-                            return None
-                        code = codes.get(v)
-                        if code is None:
-                            code = len(codes) + 1
-                            if code > (1 << 24):
-                                return None     # dictionary exhausted
-                            codes[v] = code
-                        enc[i] = code
-                    cc[a] = jnp.asarray(enc)
+                    if a in self._cond_attrs:
+                        # a numeric column promoted to object (nulls
+                        # from an upstream outer join): host mask owns
+                        # null-compare semantics
+                        return None
                     continue
                 if (sd, a) in getattr(self, "_int24", ()) and len(col) \
                         and np.abs(np.asarray(col, np.int64)).max() >= \
@@ -494,6 +451,15 @@ class JoinRuntime:
                     return None     # would round on f32 lanes
                 cc[a] = jnp.asarray(np.asarray(col, np.float32))
             cols[sd] = cc
+        if self._jlanes.any:
+            enc = self._jlanes.encode(
+                chunks["left"].columns, len(chunks["left"]),
+                chunks["right"].columns, len(chunks["right"]))
+            if enc is None:
+                return None     # null strings / NaN doubles → host mask
+            for sd, lanes in (("left", enc[0]), ("right", enc[1])):
+                for name, arr in lanes.items():
+                    cols[sd][name] = jnp.asarray(arr)
         nl, nr = len(chunks["left"]), len(chunks["right"])
         # pow2 padding caps retraces at log(max shape) per axis — sliding
         # buffers grow one event at a time, and an XLA compile per

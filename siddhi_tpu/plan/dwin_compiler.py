@@ -482,10 +482,15 @@ class DeviceWindowProcessor(WindowProcessor):
 
     def flush(self):
         """Retire every in-flight chunk — called on junction idle/drain,
-        before timer steps, and before any state read.  Runs under the
-        query lock (the junction's receiver flush path holds it)."""
-        while self._inflight:
-            self._retire_work(self._inflight.popleft())
+        before timer steps, and before any state read.  Takes the OWNING
+        query's lock (RLock, re-entrant for the junction worker): cross-
+        query callers — a named-window join's find_chunk, store queries,
+        snapshots — run on other queries' threads and would otherwise
+        race the worker's _submit (review r5)."""
+        def run():
+            while self._inflight:
+                self._retire_work(self._inflight.popleft())
+        self._locked(run)
 
     def _retire_work(self, work: dict) -> None:
         buf = np.asarray(work["buf"])

@@ -581,14 +581,25 @@ class ExprCompiler:
                     return np.asarray([None if x is None else g(str(x))
                                        for x in flat], dt)
                 return CompiledExpr(fn, t)
-            if low == "contains":
+            str2_map = {
+                "contains": lambda x, y: y in x,
+                "startswith": lambda x, y: x.startswith(y),
+                "endswith": lambda x, y: x.endswith(y),
+                "equalsignorecase": lambda x, y: x.lower() == y.lower(),
+            }
+            if low in str2_map:
+                g = str2_map[low]
                 a, b = args
-                def fn(ctx):
+
+                def fn(ctx, _g=g):
                     va = np.asarray(a.fn(ctx), object)
                     vb = b.fn(ctx)
-                    vb_arr = np.broadcast_to(np.asarray(vb, object), va.shape)
-                    return np.asarray([str(y) in str(x)
-                                       for x, y in zip(va, vb_arr)], bool)
+                    vb_arr = np.broadcast_to(np.asarray(vb, object),
+                                             va.shape)
+                    return np.asarray(
+                        [False if x is None or y is None
+                         else _g(str(x), str(y))
+                         for x, y in zip(va, vb_arr)], bool)
                 return CompiledExpr(fn, AttrType.BOOL)
         return None
 

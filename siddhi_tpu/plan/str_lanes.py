@@ -72,6 +72,13 @@ class StringLanes:
         self.str_attrs = str_attrs
         self.used: List[str] = []            # attrs needing code lanes
         self.consts: List[str] = []          # constant values, lane order
+        # compare-class string FUNCTIONS lower onto per-chunk numeric
+        # lanes (round 5): (kind, attr, const-arg) in lane order.
+        # length → f32 value lane (null = -1 sentinel, guarded per
+        # enclosing Compare); contains/startsWith/endsWith/
+        # equalsIgnoreCase → 0/1 lane (null = 0)
+        self.fn_lanes: List[tuple] = []
+        self._guard_lanes: Set[str] = set()  # length lanes needing >= 0
         self.any = False
 
     # ------------------------------------------------------------ naming
@@ -92,7 +99,44 @@ class StringLanes:
         names = [f"__strcode_{a}" for a in self.used]
         for i in range(len(self.consts)):
             names += [f"__strc{i}_lo", f"__strc{i}_hi"]
+        names += [f"__strfn{i}" for i in range(len(self.fn_lanes))]
         return names
+
+    def _fn_lane(self, kind: str, attr: str, arg) -> str:
+        key = (kind, attr, arg)
+        if key not in self.fn_lanes:
+            self.fn_lanes.append(key)
+        self.any = True
+        return f"__strfn{self.fn_lanes.index(key)}"
+
+    def _try_fn(self, e: AttributeFunction):
+        """Compare-class string function → per-chunk lane rewrite, or
+        None when the shape has no lane form."""
+        if (e.namespace or "").lower() != "str":
+            return None
+        nm = e.name.lower()
+        args = e.args
+        if nm == "length" and len(args) == 1 and \
+                self._is_str_var(args[0]) and args[0].stream_index is None:
+            lane = self._fn_lane("length", args[0].attribute, None)
+            self._guard_lanes.add(lane)
+            return Variable(attribute=lane)
+        if nm in ("contains", "startswith", "endswith",
+                  "equalsignorecase") and len(args) == 2 and \
+                self._is_str_var(args[0]) and \
+                args[0].stream_index is None and \
+                isinstance(args[1], Constant) and \
+                isinstance(args[1].value, str):
+            lane = self._fn_lane(nm, args[0].attribute, args[1].value)
+            return Compare(Variable(attribute=lane), CompareOp.GTE,
+                           _num(1.0))
+        return None
+
+    def _scan_guards(self, e, acc: Set[str]):
+        if isinstance(e, Variable) and e.attribute in self._guard_lanes:
+            acc.add(e.attribute)
+        for c in expr_children(e):
+            self._scan_guards(c, acc)
 
     # ------------------------------------------------------------ rewrite
 
@@ -157,8 +201,16 @@ class StringLanes:
             if ls or rs or lc or rc:
                 raise StringRewriteError(
                     "string comparison against a non-string/computed side")
-            return Compare(self.rewrite(e.left), e.op,
-                           self.rewrite(e.right))
+            out = Compare(self.rewrite(e.left), e.op,
+                          self.rewrite(e.right))
+            # length lanes encode null as -1: any comparison touching one
+            # is null-guarded (the reference null law — every op false)
+            guards: Set[str] = set()
+            self._scan_guards(out, guards)
+            for g in sorted(guards):
+                out = And(out, Compare(Variable(attribute=g),
+                                       CompareOp.GTE, _num(0.0)))
+            return out
         if isinstance(e, IsNull):
             # `symbol is null` parses as IsNull(stream_id='symbol') — a
             # bare identifier is stream-or-attribute; in a single-stream
@@ -177,6 +229,12 @@ class StringLanes:
         if isinstance(e, Or):
             return Or(self.rewrite(e.left), self.rewrite(e.right))
         if isinstance(e, Not):
+            # boolean function lanes are two-valued with null → 0, which
+            # matches the HOST executors exactly (str:contains(null) is
+            # false, so `not …` is true on both engines).  The string-
+            # function extension is outside the reference core, so the
+            # two-valued null behavior is this engine's defined contract
+            # (host and device agree by construction).
             return Not(self.rewrite(e.expr))
         if isinstance(e, MathExpr):
             return MathExpr(e.op, self.rewrite(e.left),
@@ -190,10 +248,16 @@ class StringLanes:
             raise StringRewriteError(
                 f"string attribute '{e.attribute}' outside a comparison")
         if isinstance(e, AttributeFunction):
+            lowered = self._try_fn(e)
+            if lowered is not None:
+                return lowered
             if self._contains_str(e):
                 raise StringRewriteError(
                     "string arguments to functions have no code lanes")
-            return e
+            # numeric functions may nest lane-rewritable args
+            return AttributeFunction(
+                namespace=e.namespace, name=e.name,
+                args=tuple(self.rewrite(a) for a in e.args))
         return e
 
     def _contains_str(self, e) -> bool:
@@ -253,4 +317,28 @@ class StringLanes:
                 hi = float(np.searchsorted(uniq, v, side="right"))
             cols[f"__strc{i}_lo"] = np.full(n_pad, lo, np.float32)
             cols[f"__strc{i}_hi"] = np.full(n_pad, hi, np.float32)
+        for i, (kind, attr, arg) in enumerate(self.fn_lanes):
+            col = columns.get(attr)
+            obj = (np.asarray(col, object) if col is not None
+                   else np.full(n, None, object))
+            vals = np.zeros(n, np.float32)
+            for j, x in enumerate(obj):
+                if x is None:
+                    vals[j] = -1.0 if kind == "length" else 0.0
+                    continue
+                s = str(x)
+                if kind == "length":
+                    vals[j] = float(len(s))
+                elif kind == "contains":
+                    vals[j] = 1.0 if arg in s else 0.0
+                elif kind == "startswith":
+                    vals[j] = 1.0 if s.startswith(arg) else 0.0
+                elif kind == "endswith":
+                    vals[j] = 1.0 if s.endswith(arg) else 0.0
+                else:               # equalsignorecase
+                    vals[j] = 1.0 if s.lower() == arg.lower() else 0.0
+            lane = np.full(n_pad, -1.0 if kind == "length" else 0.0,
+                           np.float32)
+            lane[:n] = vals
+            cols[f"__strfn{i}"] = lane
         return cols

@@ -72,6 +72,11 @@ def run_once(app: str, sends, callback_query: Optional[str],
         rt.app_ctx.timestamp_generator.observe_event_time(advance_to)
         rt.app_ctx.scheduler.advance_to(advance_to)
     backends = {name: q.backend for name, q in rt.query_runtimes.items()}
+    # partitioned queries live in partition runtimes: keyed device mode
+    # (device_query_runtimes) or host clones
+    for pr in rt.partition_runtimes:
+        for name, q in getattr(pr, "device_query_runtimes", {}).items():
+            backends[name] = q.backend
     rt.shutdown()
     return got, removed, backends
 

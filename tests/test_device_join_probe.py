@@ -122,16 +122,26 @@ def test_indexed_equality_join_stays_host_hash():
     assert out == [(1, 10.0)]
 
 
-def test_double_attrs_fall_back():
+def test_double_attrs_device_exact():
+    """Round 5: DOUBLE compares ride monotone 64-bit keys split into two
+    exact i32 lanes (plan/join_lanes.py) — no f32 rounding; parity incl.
+    values that differ only below f32 precision, and -0.0 == 0.0."""
     app = """
         define stream L (id int, price double);
         define stream R (id int, threshold double);
         @info(name='q')
-        from L#window.length(3) join R#window.length(3)
+        from L#window.length(8) join R#window.length(8)
             on L.price > R.threshold
         select L.id as lid, R.id as rid insert into Out;"""
-    b, reason, _ = run_app(app, [("L", [1, 5.0], 1_000_000)])
-    assert b == "host" and "DOUBLE" in (reason or "")
+    eps = 1e-12
+    sends = [("L", [1, 5.0], 1_000_000),
+             ("R", [2, 5.0 - eps], 1_000_100),    # just below: matches
+             ("R", [3, 5.0], 1_000_200),          # equal: no match
+             ("R", [4, 5.0 + eps], 1_000_300),    # just above: no match
+             ("L", [7, 50.1], 1_000_600),
+             ("R", [8, 50.099999999999994], 1_000_700)]
+    out = assert_parity(app, sends)
+    assert (1, 2) in out and (7, 8) in out and (1, 4) not in out
 
 
 def test_big_int_ids_guard_to_host_mask():
@@ -181,7 +191,9 @@ def test_string_equality_join_device():
     assert ("IBM", 50.0, 5) in out and ("WSO2", 60.0, 7) in out
 
 
-def test_string_order_compare_falls_back():
+def test_string_order_compare_device():
+    """Round 5: string ORDER compares ride per-probe union rank lanes
+    (plan/join_lanes.py) — parity for var-vs-var order joins."""
     app = """
         define stream L (symbol string, price float);
         define stream R (symbol string, qty int);
@@ -189,9 +201,11 @@ def test_string_order_compare_falls_back():
         from L#window.length(3) join R#window.length(3)
             on L.symbol > R.symbol
         select L.price as p, R.qty as q insert into Out;"""
-    b, reason, _ = run_app(app, [("L", ["b", 1.0], 1_000_000),
-                                 ("R", ["a", 2], 1_000_100)])
-    assert b == "host" and "==/!=" in (reason or "")
+    sends = [("L", ["b", 1.0], 1_000_000), ("R", ["a", 2], 1_000_100),
+             ("R", ["c", 3], 1_000_200), ("L", ["aa", 4.0], 1_000_300),
+             ("L", ["ca", 5.0], 1_000_400)]
+    out = assert_parity(app, sends)
+    assert (1.0, 2) in out and (4.0, 2) in out and (5.0, 3) in out
 
 
 def test_string_join_with_nulls_guards_to_host_mask():
@@ -212,25 +226,22 @@ def test_string_join_with_nulls_guards_to_host_mask():
     assert (3.0, 4) in out and (1.0, 2) not in out
 
 
-def test_f32_unsafe_float_literal_routes_to_host():
-    """ADVICE r3: a float constant not exactly representable in float32
-    (e.g. 50.1) could flip borderline compares on device lanes — the
-    probe must stay host for such conditions, and compile for exactly-
-    representable ones (50.5)."""
-    from siddhi_tpu import SiddhiManager
-    base = """
+def test_f32_unsafe_float_literal_keys_exactly():
+    """Round 5 (supersedes the ADVICE r3 host pin): a float constant not
+    exactly representable in float32 (50.1) now compiles via the exact
+    64-bit key lanes — the borderline FLOAT-vs-literal compare matches
+    the host float64 promotion exactly."""
+    app = """
     define stream L (sym string, price float);
     define stream R (sym string, price float);
     @info(name='q')
     from L#window.length(10) join R#window.length(10)
-        on L.price > R.price and R.price == {lit}
+        on L.price > R.price and R.price < 50.1
     select L.sym as ls, R.sym as rs insert into Out;
     """
-    m = SiddhiManager()
-    rt = m.create_siddhi_app_runtime(base.format(lit="50.1"))
-    assert rt.query_runtimes["q"].backend == "host"
-    rt.shutdown()
-    m2 = SiddhiManager()
-    rt2 = m2.create_siddhi_app_runtime(base.format(lit="50.5"))
-    assert rt2.query_runtimes["q"].backend == "device"
-    rt2.shutdown()
+    sends = [("L", ["l1", 60.0], 1_000_000),
+             ("R", ["r1", float(np.float32(50.1))], 1_000_100),
+             ("R", ["r2", 50.25], 1_000_200)]
+    out = assert_parity(app, sends)
+    # np.float32(50.1) = 50.099998... < 50.1 → r1 joins; 50.25 doesn't
+    assert ("l1", "r1") in out and ("l1", "r2") not in out

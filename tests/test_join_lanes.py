@@ -1,0 +1,156 @@
+"""Round-5 join-probe lanes (plan/join_lanes.py) + string-function
+filter lanes (plan/str_lanes.py): randomized device-vs-host parity.
+
+- STRING order/equality joins ride per-probe union rank lanes;
+- DOUBLE compares ride monotone 64-bit keys split into exact i32 pairs;
+- compare-class string functions (str:length/contains/startsWith/
+  endsWith/equalsIgnoreCase) lower onto per-chunk numeric lanes in the
+  device filter path.
+"""
+import numpy as np
+import pytest
+
+from siddhi_tpu import QueryCallback, SiddhiManager, StreamCallback
+
+
+def run_join(app, sends, engine=None):
+    m = SiddhiManager()
+    pre = "@app:playback " + (f"@app:engine('{engine}') " if engine else "")
+    rt = m.create_siddhi_app_runtime(pre + app)
+    out = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: out.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    for sid, row, ts in sends:
+        rt.get_input_handler(sid).send(row, timestamp=ts)
+    qr = rt.query_runtimes["q"]
+    backend = "device" if qr.backend == "device" else "host"
+    rt.shutdown()
+    return backend, out
+
+
+def join_parity(app, sends):
+    bd, dev = run_join(app, sends)
+    bh, host = run_join(app, sends, engine="host")
+    assert bd == "device" and bh == "host"
+    assert dev == host, f"dev={dev[:5]} host={host[:5]}"
+    return dev
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_join_double_order_fuzz(seed):
+    app = """
+    define stream L (id int, v double);
+    define stream R (id int, v double);
+    @info(name='q')
+    from L#window.length(6) join R#window.length(6)
+        on L.v > R.v and R.v > 2.0000001
+    select L.id as lid, R.id as rid insert into Out;"""
+    rng = np.random.default_rng(seed)
+    sends, t = [], 1_000_000
+    for i in range(40):
+        side = "L" if rng.integers(0, 2) else "R"
+        # values with sub-f32 structure: many collide after f32 rounding
+        v = float(rng.integers(0, 8)) + float(rng.uniform(0, 1e-6))
+        sends.append((side, [i, v], t))
+        t += 50
+    assert join_parity(app, sends)
+
+
+@pytest.mark.parametrize("seed", [5, 13])
+def test_join_string_order_fuzz(seed):
+    app = """
+    define stream L (s string, id int);
+    define stream R (s string, id int);
+    @info(name='q')
+    from L#window.length(5) join R#window.length(5)
+        on L.s > R.s and L.s != 'qq'
+    select L.id as lid, R.id as rid insert into Out;"""
+    rng = np.random.default_rng(seed)
+    words = ["a", "ab", "b", "ba", "qq", "z", "", "aa"]
+    sends, t = [], 1_000_000
+    for i in range(40):
+        side = "L" if rng.integers(0, 2) else "R"
+        sends.append((side, [words[int(rng.integers(0, len(words)))], i], t))
+        t += 50
+    assert join_parity(app, sends)
+
+
+def test_join_string_const_thresholds():
+    app = """
+    define stream L (s string, id int);
+    define stream R (s string, id int);
+    @info(name='q')
+    from L#window.length(5) join R#window.length(5)
+        on L.s == R.s and R.s >= 'b'
+    select L.id as lid, R.id as rid insert into Out;"""
+    sends = [("L", ["b", 1], 1_000_000), ("R", ["b", 2], 1_000_100),
+             ("L", ["a", 3], 1_000_200), ("R", ["a", 4], 1_000_300),
+             ("R", ["c", 5], 1_000_400), ("L", ["c", 6], 1_000_500)]
+    out = join_parity(app, sends)
+    assert (1, 2) in out and (6, 5) in out and (3, 4) not in out
+
+
+def test_join_double_nan_routes_to_host_mask():
+    """NaN compares are three-valued (always false) — a NaN column guards
+    that probe to the host mask; results identical either way."""
+    app = """
+    define stream L (id int, v double);
+    define stream R (id int, v double);
+    @info(name='q')
+    from L#window.length(4) join R#window.length(4)
+        on L.v > R.v
+    select L.id as lid, R.id as rid insert into Out;"""
+    sends = [("L", [1, float("nan")], 1_000_000),
+             ("R", [2, 1.0], 1_000_100),
+             ("L", [3, 5.0], 1_000_200)]
+    out = join_parity(app, sends)
+    assert (3, 2) in out and (1, 2) not in out
+
+
+# ------------------------------------------------------- string fn lanes
+
+def run_filter(app, rows, engine=None):
+    m = SiddhiManager()
+    pre = "@app:playback " + (f"@app:engine('{engine}') " if engine else "")
+    rt = m.create_siddhi_app_runtime(pre + app)
+    got = []
+    rt.add_callback("q", QueryCallback(lambda ts, cur, exp: got.extend(
+        tuple(e.data) for e in (cur or []))))
+    rt.start()
+    h = rt.get_input_handler("S")
+    t = 1_000_000
+    for row in rows:
+        h.send(row, timestamp=t)
+        t += 100
+    backend = rt.query_runtimes["q"].backend
+    rt.shutdown()
+    return backend, got
+
+
+ROWS = [["alpha", 1.0], ["Beta", 2.0], ["gamma-x", 3.0], [None, 4.0],
+        ["", 5.0], ["ALPHA", 6.0]]
+
+
+@pytest.mark.parametrize("cond,expect_device", [
+    ("str:length(s) > 4", True),
+    ("str:length(s) == 5", True),
+    ("str:length(s) != 5", True),          # null → false (guarded lane)
+    ("str:contains(s, 'a')", True),
+    ("str:startsWith(s, 'a')", True),
+    ("str:endsWith(s, 'x')", True),
+    ("str:equalsIgnoreCase(s, 'alpha')", True),
+    ("str:length(s) + v > 6.0", True),
+    # negated: null → fn false → `not` true, on BOTH engines (two-valued
+    # contract; the string extension is outside the reference core)
+    ("not str:contains(s, 'a')", True),
+])
+def test_string_fn_filter_parity(cond, expect_device):
+    app = ("define stream S (s string, v float);\n"
+           f"@info(name='q') from S[{cond}] "
+           "select s, v insert into Out;")
+    bd, dev = run_filter(app, ROWS)
+    bh, host = run_filter(app, ROWS, engine="host")
+    assert bh == "host"
+    assert bd == ("device" if expect_device else "host")
+    assert dev == host, f"{cond}: dev={dev} host={host}"
