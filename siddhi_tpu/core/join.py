@@ -404,6 +404,14 @@ class JoinRuntime:
                             jnp.zeros((1,), bool), jnp.zeros((1,), bool),
                             4)
             self.device_probe = probe
+            # build-time constants of the probe hot path: raw columns the
+            # lane encode replaces (strings/doubles) or that never feed
+            # the program (objects)
+            self._probe_skip = {
+                s.side: {a.name for a in s.definition.attributes
+                         if a.type in (AttrType.STRING, AttrType.DOUBLE,
+                                       AttrType.OBJECT)}
+                for s in (self.left, self.right)}
             # condition-referenced attrs per definition: a referenced
             # column that arrives object-typed (outer-join nulls upstream)
             # must force the host mask, not vanish from the feed
@@ -422,15 +430,10 @@ class JoinRuntime:
         order via the device probe, or None when a runtime guard (int
         2^24 exactness) demands the host path."""
         import jax.numpy as jnp
-        from ..query_api.definition import AttrType
         left_first = side.side == "left"
         chunks = {"left": data if left_first else buf,
                   "right": buf if left_first else data}
-        skip = {}
-        for s in (self.left, self.right):
-            skip[s.side] = {a.name for a in s.definition.attributes
-                            if a.type in (AttrType.STRING, AttrType.DOUBLE,
-                                          AttrType.OBJECT)}
+        skip = self._probe_skip
         cols = {}
         for sd, c in chunks.items():
             cc = {}

@@ -33,7 +33,7 @@ from ..query_api.definition import AttrType
 from ..query_api.expression import (And, Compare, CompareOp, Constant,
                                     Expression, Not, Or, Variable,
                                     expr_children)
-from .str_lanes import _REFLECT, has_supplementary, utf16_keys
+from .str_lanes import _REFLECT, rank_encode
 
 
 class JoinRewriteError(ValueError):
@@ -296,29 +296,11 @@ class JoinLanes:
                     pool.append(strs)
             uniq = np.unique(np.concatenate(pool)) if pool else \
                 np.zeros(0, "U1")
-            resort = len(uniq) > 0 and (
-                has_supplementary(uniq) or
-                any(any(ord(c) > 0xFFFF for c in v)
-                    for v in self.str_consts))
-            if resort:
-                keys16 = utf16_keys(uniq)
-                order = np.argsort(keys16)
-                rank16 = np.empty(len(uniq), np.int32)
-                rank16[order] = np.arange(len(uniq), dtype=np.int32)
-                uniq16 = list(keys16[order])
+            codes_of, bounds_of = rank_encode(uniq, self.str_consts)
             for lanes, a, strs in per:
-                idx = np.searchsorted(uniq, strs)
-                codes = rank16[idx] if resort else idx.astype(np.int32)
-                lanes[f"__scode_{a}"] = codes
+                lanes[f"__scode_{a}"] = codes_of(strs).astype(np.int32)
             for i, v in enumerate(self.str_consts):
-                if resort:
-                    import bisect
-                    v16 = v.encode("utf-16-be")
-                    lo = bisect.bisect_left(uniq16, v16)
-                    hi = bisect.bisect_right(uniq16, v16)
-                else:
-                    lo = int(np.searchsorted(uniq, v, side="left"))
-                    hi = int(np.searchsorted(uniq, v, side="right"))
+                lo, hi = bounds_of(v)
                 # threshold lanes broadcast on BOTH sides (the rewrite
                 # anchors them to the compared variable's side)
                 for lanes, n in ((lanes_l, nl), (lanes_r, nr)):

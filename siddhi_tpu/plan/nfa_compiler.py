@@ -385,6 +385,12 @@ class CompiledPatternNFA:
         self.attr_names: List[str] = []
         self.attr_types: Dict[str, AttrType] = {}
         self.real_types: Dict[str, AttrType] = {}
+        # INT/LONG capture exactness (round 5): selected integer attrs
+        # get three companion event lanes (hi 22 / mid 21 / lo 21 bits of
+        # the sign-biased value — each exact in f32) that ride the same
+        # capture banks; decode reassembles the exact int64.  Maps
+        # companion lane name → source attr.
+        self.int_exact_src: Dict[str, str] = {}
         str_attrs: set = set()
         for u in self.units:
             for side in u.sides:
@@ -425,6 +431,7 @@ class CompiledPatternNFA:
                               if s.row in self.nullable_rows}
 
         # ---- scan filters + select for cross-state references
+        self._cond_capture_attrs: set = set()
         needed_f: List[set] = [set() for _ in rows]
         needed_l: List[set] = [set() for _ in rows]
         needed_idx: List[dict] = [{} for _ in rows]     # k -> attrs
@@ -497,6 +504,7 @@ class CompiledPatternNFA:
                         f"'{var.stream_id}.{var.attribute}' is not numeric")
             (needed_f if which_of(var, side.row) == "f" else
              needed_l)[side.row].add(var.attribute)
+            self._cond_capture_attrs.add(var.attribute)
 
         for ui, u in enumerate(self.units):
             for side in u.sides:
@@ -534,19 +542,33 @@ class CompiledPatternNFA:
                 _reject(f"selected attribute "
                         f"'{e.stream_id}.{e.attribute}' is not numeric")
             w = which_of(e, side.row, select_ctx=True)
-            if w == "f":
-                needed_f[side.row].add(e.attribute)
-            elif w == "l":
-                needed_l[side.row].add(e.attribute)
-            elif w.startswith("i"):
-                needed_idx[side.row].setdefault(int(w[1:]),
-                                                set()).add(e.attribute)
-            else:
-                needed_lastk[side.row].setdefault(int(w[1:]),
-                                                  set()).add(e.attribute)
-                # last-j shifts source from the LAST bank: its attrs must
-                # ride there too
-                needed_l[side.row].add(e.attribute)
+            sel_attrs = [e.attribute]
+            if self.attr_types.get(e.attribute) in (AttrType.INT,
+                                                    AttrType.LONG) and \
+                    e.attribute not in self.encoded_attrs:
+                # exact integer payload: three companion lanes ride the
+                # same bank as the base attr (see int_exact_src)
+                for part in ("hi", "md", "lo"):
+                    comp = f"__ex{part}_{e.attribute}"
+                    if comp not in self.attr_types:
+                        self.attr_names.append(comp)
+                        self.attr_types[comp] = AttrType.INT
+                        self.int_exact_src[comp] = e.attribute
+                    sel_attrs.append(comp)
+            for a in sel_attrs:
+                if w == "f":
+                    needed_f[side.row].add(a)
+                elif w == "l":
+                    needed_l[side.row].add(a)
+                elif w.startswith("i"):
+                    needed_idx[side.row].setdefault(int(w[1:]),
+                                                    set()).add(a)
+                else:
+                    needed_lastk[side.row].setdefault(int(w[1:]),
+                                                      set()).add(a)
+                    # last-j shifts source from the LAST bank: its attrs
+                    # must ride there too
+                    needed_l[side.row].add(a)
             if any(o[0] == oa.rename for o in self.select_outputs):
                 # reference DuplicateAttributeException (SelectorParser)
                 _reject(f"duplicate output attribute '{oa.rename}' in "
@@ -688,20 +710,20 @@ class CompiledPatternNFA:
         self._step = self._jit_step()
         self.base_ts: Optional[int] = None
 
-        # capture lanes ride float32: INT/LONG values above 2**24 round
-        # silently
+        # Select-side INT/LONG payloads are exact (companion lanes, round
+        # 5).  CONDITIONS still compare f32 event/capture scalars, so an
+        # integer attr referenced cross-state in a condition keeps a
+        # narrowed warning.
         import warnings
-        warned = set()
-        for (_r, a, _w) in self.cap_lane:
+        for a in sorted(self._cond_capture_attrs):
             if a in self.encoded_attrs:
                 continue       # dictionary codes are capped at 2^24
-            if self.attr_types.get(a) in (AttrType.INT, AttrType.LONG) and \
-                    a not in warned:
-                warned.add(a)
+            if self.attr_types.get(a) in (AttrType.INT, AttrType.LONG):
                 warnings.warn(
                     f"TPU NFA path: {self.attr_types[a].name} attribute "
-                    f"'{a}' rides a float32 capture lane; values above "
-                    f"2**24 lose precision on decode", stacklevel=2)
+                    f"'{a}' is compared in a CONDITION on float32 lanes; "
+                    f"condition compares round above 2**24 (match "
+                    f"payloads stay exact)", stacklevel=2)
 
     # -------------------------------------------- string dictionary coding
 
@@ -873,6 +895,34 @@ class CompiledPatternNFA:
             v = v.item() if hasattr(v, "item") else v
             out[i] = 0 if v is None else self._encode_str(v)
         return out
+
+    def int_exact_lane(self, comp: str, col) -> np.ndarray:
+        """Companion lane for exact INT/LONG capture payloads: the sign-
+        biased uint64 value split into hi (22) / mid (21) / lo (21) bit
+        fields — each exact in a float32 lane."""
+        obj = np.asarray(col)
+        if obj.dtype == object:
+            v = np.asarray([0 if x is None else int(x) for x in obj],
+                           np.int64)
+        else:
+            v = np.asarray(obj, np.int64)
+        u = v.astype(np.uint64) ^ np.uint64(1 << 63)
+        part = comp[4:6]                      # "hi" | "md" | "lo"
+        if part == "hi":
+            out = u >> np.uint64(42)
+        elif part == "md":
+            out = (u >> np.uint64(21)) & np.uint64(0x1FFFFF)
+        else:
+            out = u & np.uint64(0x1FFFFF)
+        return out.astype(np.float32)
+
+    @staticmethod
+    def _int_exact_join(hi, md, lo):
+        """Reassemble the exact int64 from the three companion lanes."""
+        u = (np.asarray(hi, np.uint64) << np.uint64(42)) | \
+            (np.asarray(md, np.uint64) << np.uint64(21)) | \
+            np.asarray(lo, np.uint64)
+        return (u ^ np.uint64(1 << 63)).astype(np.int64)
 
     def output_type(self, attr: str) -> AttrType:
         """The user-facing type of a selected attribute (encoded lanes
@@ -1367,7 +1417,14 @@ class CompiledPatternNFA:
             v = float(caps_row[row, lane])
             at = self.attr_types.get(attr)
             if at in (AttrType.INT, AttrType.LONG):
-                v = int(round(v))
+                hik = (row, f"__exhi_{attr}", which)
+                if hik in self.cap_lane:
+                    v = int(self._int_exact_join(
+                        *[round(float(caps_row[row, self.cap_lane[
+                            (row, f"__ex{p}_{attr}", which)]]))
+                          for p in ("hi", "md", "lo")]))
+                else:
+                    v = int(round(v))
             if attr in self.encoded_attrs:
                 v = self.str_decoder[v - 1] if v >= 1 else None
             vals[name] = v
@@ -1425,7 +1482,15 @@ class CompiledPatternNFA:
                 cols[name] = out
                 continue
             if at in (AttrType.INT, AttrType.LONG):
-                v = np.rint(v).astype(np.int64)
+                hik = (row, f"__exhi_{attr}", which)
+                if hik in self.cap_lane:
+                    # exact payload: reassemble from companion lanes
+                    g = lambda p: np.rint(caps_f[
+                        :, row,
+                        self.cap_lane[(row, f"__ex{p}_{attr}", which)]])
+                    v = self._int_exact_join(g("hi"), g("md"), g("lo"))
+                else:
+                    v = np.rint(v).astype(np.int64)
             col = v.astype(dtype_for(self.output_type(attr)))
             if null_mask is not None:
                 out = col.astype(object)
@@ -1499,6 +1564,8 @@ class CompiledPatternNFA:
         for a in self.attr_names:
             if a in self.derived and a not in columns:
                 c = self.derived_lane(a, columns[self.derived[a][0]])
+            elif a in self.int_exact_src and a not in columns:
+                c = self.int_exact_lane(a, columns[self.int_exact_src[a]])
             else:
                 c = columns[a]
                 if a in self.encoded_attrs:

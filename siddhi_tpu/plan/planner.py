@@ -264,6 +264,14 @@ class DevicePatternRuntime:
                              if data.columns.get(src) is not None
                              else np.full(n, None, object))
                 continue
+            if a in self.nfa.int_exact_src:
+                # exact integer companion lane: split from the RAW column
+                # (the base f32 cast below would round above 2^24)
+                src = self.nfa.int_exact_src[a]
+                raw = data.columns.get(src)
+                cols[a] = self.nfa.int_exact_lane(
+                    a, raw if raw is not None else np.zeros(n, np.int64))
+                continue
             col = data.columns.get(a)
             if a in self.nfa.encoded_attrs:
                 # raw string column — the NFA dictionary-encodes it

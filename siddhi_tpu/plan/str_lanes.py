@@ -55,6 +55,38 @@ def utf16_keys(strs) -> np.ndarray:
     return np.asarray([str(s).encode("utf-16-be") for s in strs], object)
 
 
+def rank_encode(uniq: np.ndarray, consts):
+    """Shared union-rank machinery for per-chunk/per-probe string code
+    lanes (used by the filter path here and the join probe,
+    plan/join_lanes.py — ONE source of truth for the UTF-16 ordering
+    rules).  Returns (codes_of, bounds_of): codes_of maps an array of
+    strings (each present in `uniq`) to int ranks in Java compareTo
+    order; bounds_of maps a constant to its [lo, hi) rank bounds."""
+    resort = len(uniq) > 0 and (
+        has_supplementary(uniq) or
+        any(any(ord(c) > 0xFFFF for c in v) for v in consts))
+    if resort:
+        keys16 = utf16_keys(uniq)
+        order = np.argsort(keys16)
+        rank16 = np.empty(len(uniq), np.int64)
+        rank16[order] = np.arange(len(uniq), dtype=np.int64)
+        uniq16 = list(keys16[order])
+
+    def codes_of(strs: np.ndarray) -> np.ndarray:
+        idx = np.searchsorted(uniq, strs)
+        return rank16[idx] if resort else idx
+
+    def bounds_of(v: str):
+        if resort:
+            import bisect
+            v16 = v.encode("utf-16-be")
+            return (bisect.bisect_left(uniq16, v16),
+                    bisect.bisect_right(uniq16, v16))
+        return (int(np.searchsorted(uniq, v, side="left")),
+                int(np.searchsorted(uniq, v, side="right")))
+    return codes_of, bounds_of
+
+
 _REFLECT = {CompareOp.LT: CompareOp.GT, CompareOp.GT: CompareOp.LT,
             CompareOp.LTE: CompareOp.GTE, CompareOp.GTE: CompareOp.LTE,
             CompareOp.EQ: CompareOp.EQ, CompareOp.NEQ: CompareOp.NEQ}
@@ -286,37 +318,17 @@ class StringLanes:
                 pools.append(strs[~none])
         uniq = np.unique(np.concatenate(pools)) if pools else \
             np.zeros(0, "U1")
-        # Ranks must follow Java's UTF-16 code-unit order, not numpy's
-        # code-point order; the two diverge only when supplementary-plane
-        # characters are present, so re-rank the (small) unique pool by
-        # utf-16-be bytes in that rare case only.
-        resort = len(uniq) > 0 and (
-            has_supplementary(uniq) or
-            any(any(ord(c) > 0xFFFF for c in v) for v in self.consts))
-        if resort:
-            keys16 = utf16_keys(uniq)
-            order = np.argsort(keys16)
-            rank16 = np.empty(len(uniq), np.float32)
-            rank16[order] = np.arange(len(uniq), dtype=np.float32)
-            uniq16 = list(keys16[order])
+        codes_of, bounds_of = rank_encode(uniq, self.consts)
         for a, (strs, none) in per_attr.items():
-            idx = np.searchsorted(uniq, strs)
-            codes = rank16[idx] if resort else idx.astype(np.float32)
+            codes = codes_of(strs).astype(np.float32)
             codes[none] = -1.0
             lane = np.full(n_pad, -1.0, np.float32)
             lane[:n] = codes
             cols[f"__strcode_{a}"] = lane
         for i, v in enumerate(self.consts):
-            if resort:
-                import bisect
-                v16 = v.encode("utf-16-be")
-                lo = float(bisect.bisect_left(uniq16, v16))
-                hi = float(bisect.bisect_right(uniq16, v16))
-            else:
-                lo = float(np.searchsorted(uniq, v, side="left"))
-                hi = float(np.searchsorted(uniq, v, side="right"))
-            cols[f"__strc{i}_lo"] = np.full(n_pad, lo, np.float32)
-            cols[f"__strc{i}_hi"] = np.full(n_pad, hi, np.float32)
+            lo, hi = bounds_of(v)
+            cols[f"__strc{i}_lo"] = np.full(n_pad, float(lo), np.float32)
+            cols[f"__strc{i}_hi"] = np.full(n_pad, float(hi), np.float32)
         for i, (kind, attr, arg) in enumerate(self.fn_lanes):
             col = columns.get(attr)
             obj = (np.asarray(col, object) if col is not None

@@ -1,0 +1,98 @@
+"""Round-5 exact INT/LONG NFA capture payloads: selected integer attrs
+ride three companion event lanes (hi 22 / mid 21 / lo 21 bits of the
+sign-biased value — each exact in float32) through the same capture
+banks, and decode reassembles the exact int64.  Retires the r4 plan-time
+2^24 warning for payloads (conditions keep a narrowed warning).
+Reference: event/stream/StreamEvent.java typed payload segments."""
+import warnings
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import QueryCallback, SiddhiManager
+
+S = "define stream S (sym string, vol long, q int, n int);\n"
+
+
+def run(app, rows, engine=None):
+    m = SiddhiManager()
+    pre = "@app:playback " + (f"@app:engine('{engine}') " if engine else "")
+    rt = m.create_siddhi_app_runtime(pre + app)
+    got = []
+    rt.add_callback("q", QueryCallback(lambda ts, cur, exp: got.extend(
+        tuple(e.data) for e in (cur or []))))
+    rt.start()
+    h = rt.get_input_handler("S")
+    t = 1_000_000
+    for row in rows:
+        h.send(row, timestamp=t)
+        t += 100
+    backend = rt.query_runtimes["q"].backend
+    rt.shutdown()
+    return backend, got
+
+
+def parity(app, rows):
+    bd, dev = run(app, rows)
+    bh, host = run(app, rows, engine="host")
+    assert bd == "device" and bh == "host"
+    assert dev == host, f"dev={dev[:4]} host={host[:4]}"
+    return dev
+
+
+BIG = [(1 << 53) + 12345, -(1 << 40) - 7, (1 << 62) + 999,
+       -(1 << 62) - 1, 2 ** 63 - 1, -(2 ** 63), 0, -1, 16_777_217]
+
+
+def test_long_capture_exact_beyond_2_24():
+    app = S + """@info(name='q')
+    from every e1=S[n == 0] -> e2=S[n == 1]
+    select e1.vol as v1, e2.vol as v2 insert into Out;"""
+    rows = []
+    for i in range(0, len(BIG) - 1, 2):
+        rows.append(["a", BIG[i], 100 + i, 0])
+        rows.append(["a", BIG[i + 1], 100 + i, 1])
+    out = parity(app, rows)
+    assert out and all(isinstance(v, (int, np.integer)) for r in out
+                       for v in r)
+    assert out[0] == (BIG[0], BIG[1])
+
+
+def test_int_capture_exact():
+    app = S + """@info(name='q')
+    from every e1=S[n == 0] -> e2=S[n == 1]
+    select e1.q as a, e2.q as b insert into Out;"""
+    big_i = 2 ** 31 - 1
+    rows = [["a", 1, big_i, 0], ["a", 1, -(2 ** 31), 1],
+            ["a", 1, 16_777_217, 0], ["a", 1, 16_777_219, 1]]
+    out = parity(app, rows)
+    assert (big_i, -(2 ** 31)) in out and (16_777_217, 16_777_219) in out
+
+
+def test_kleene_last_bank_exact():
+    """Companion lanes ride the kleene last/index banks too."""
+    app = S + """@info(name='q')
+    from every e1=S[n == 0]<1:3> -> e2=S[n == 1]
+    select e1[0].vol as a, e1[last].vol as b, e2.vol as g
+    insert into Out;"""
+    v1, v2, v3 = (1 << 52) + 3, (1 << 52) + 4, (1 << 52) + 5
+    rows = [["a", v1, 0, 0], ["a", v2, 0, 0], ["a", v3, 0, 1]]
+    out = parity(app, rows)
+    assert (v1, v2, v3) in out
+
+
+def test_payload_warning_retired_condition_warning_kept():
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        run(S + """@info(name='q')
+        from every e1=S[n == 0] -> e2=S[n == 1]
+        select e1.vol as v1 insert into Out;""", [["a", 1, 1, 0]])
+    assert not [x for x in w if "NFA" in str(x.message)], \
+        "payload-only integer selects must not warn"
+    with warnings.catch_warnings(record=True) as w2:
+        warnings.simplefilter("always")
+        run(S + """@info(name='q')
+        from every e1=S[n == 0] -> e2=S[vol > e1.vol]
+        select e1.sym as s1 insert into Out;""", [["a", 1, 1, 0]])
+    assert [x for x in w2 if "CONDITION" in str(x.message)], \
+        "cross-state integer CONDITION compares keep the f32 warning"
