@@ -38,11 +38,18 @@ def auto_mesh(axis: str = "p") -> Optional[Mesh]:
     has it — the engine-integrated replacement for the reference's per-key
     clone scaling (partition/PartitionRuntime.java:255-308).
 
-    `SIDDHI_TPU_MESH=off` forces single-device (operator escape hatch)."""
+    `SIDDHI_TPU_MESH=off` forces single-device (operator escape hatch).
+
+    Under jax.distributed (multi-host), the engine-default mesh is the
+    LOCAL device set: SiddhiManager engines are shared-nothing per host
+    (parallel/multihost.py routes keys between them), and a global mesh
+    would demand lock-step dispatch across processes.  Explicit global
+    meshes remain available (parallel/distributed.py)."""
     import os
     if os.environ.get("SIDDHI_TPU_MESH", "auto").lower() == "off":
         return None
-    devs = jax.devices()
+    devs = jax.local_devices() if jax.process_count() > 1 \
+        else jax.devices()
     if len(devs) <= 1:
         return None
     return partition_mesh(devs, axis)

@@ -1,0 +1,173 @@
+"""Multi-host execution of SiddhiManager apps (round 5, VERDICT r4 #5).
+
+Design: SHARED-NOTHING key sharding — the reference's own distributed
+model (its distributed sinks ship events between engines with a
+``@distribution(strategy='partitioned')`` policy, core/source_sink.py;
+the JVM engine itself is single-node, SURVEY §5.8).  Every process runs
+the SAME ``@app:engine('device')`` partitioned app through the public
+SiddhiManager API under ``jax.distributed``; a hash of the app's
+partition key routes each event to exactly one owning process, so the
+planner-built KEYED device runtime — key→lane mapping, @Async flush
+barriers, pipelined ingest, grow-and-replay — executes with
+``jax.process_count() > 1`` on every host, over that host's LOCAL
+devices.
+
+Why shared-nothing rather than one global-mesh program: a global mesh
+requires LOCK-STEP dispatch (every process must issue the identical jit
+call sequence, so one busy key range would stall the cluster), and slot
+growth would need a collective re-shard.  With host-local engines,
+growth is a local matter (each process grows its own slab — no
+collective, no rejection), ingest cadence is independent per host, and
+the only cross-host traffic is the fused stats all-reduce below plus
+whatever a fronting router moves.  The raw global-mesh SPMD path remains
+available as ``parallel.distributed.DistributedPatternBank``.
+
+Cross-host collective: ``global_stats()`` all-reduces per-host counters
+over DCN through one tiny jitted psum on the GLOBAL mesh — the same
+collective the bank path fuses into its step.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from .distributed import init_distributed, process_info
+
+
+def partition_key_attrs(app) -> Dict[str, str]:
+    """stream id → partition key attribute (``partition with (attr of
+    Stream)``) — the router's shard key.  Two partitions keying the SAME
+    stream on DIFFERENT attributes cannot share one shard route: every
+    process would need every event, defeating the shared-nothing split —
+    reject loudly instead of silently dropping matches."""
+    from ..query_api.query import Partition, ValuePartitionType
+    from ..query_api.expression import Variable
+    from ..utils.errors import SiddhiAppCreationError
+    out: Dict[str, str] = {}
+    for el in app.execution_elements:
+        if not isinstance(el, Partition):
+            continue
+        for pt in el.partition_types:
+            if isinstance(pt, ValuePartitionType) and \
+                    isinstance(pt.expression, Variable):
+                attr = pt.expression.attribute
+                prev = out.get(pt.stream_id)
+                if prev is not None and prev != attr:
+                    raise SiddhiAppCreationError(
+                        f"multi-host routing: stream '{pt.stream_id}' is "
+                        f"partitioned by both '{prev}' and '{attr}' — "
+                        "one shard key per stream is required")
+                out[pt.stream_id] = attr
+    return out
+
+
+_FNV_MASK = (1 << 64) - 1
+
+
+def owner_of(key, num_processes: int) -> int:
+    """Stable key → owning process (FNV-1a over the repr, so every host
+    computes the same answer with no coordination)."""
+    h = 0xCBF29CE484222325
+    for b in repr(key).encode():
+        h = ((h ^ b) * 0x100000001B3) & _FNV_MASK
+    return h % num_processes
+
+
+class MultiHostAppRuntime:
+    """One process's slice of a multi-host SiddhiManager deployment.
+
+    ``send_batch`` accepts the GLOBAL stream (as a router would see it)
+    and forwards only the rows whose partition key this process owns —
+    asserting that the union of all processes' outputs equals a
+    single-process run is the cross-host parity contract
+    (tests/test_multihost.py)."""
+
+    def __init__(self, app_string: str,
+                 coordinator: Optional[str] = None,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None):
+        from ..compiler import SiddhiCompiler
+        from ..core.runtime import SiddhiManager
+        init_distributed(coordinator, num_processes, process_id)
+        self.pid, self.nproc = process_info()
+        self.app = SiddhiCompiler.parse(app_string)
+        self.key_attrs = partition_key_attrs(self.app)
+        self.manager = SiddhiManager()
+        self.runtime = self.manager.create_siddhi_app_runtime(app_string)
+        self._stats_jit = None
+
+    # ------------------------------------------------------------ routing
+
+    def owns(self, key) -> bool:
+        return owner_of(key, self.nproc) == self.pid
+
+    def send_batch(self, stream_id: str, columns: Dict[str, np.ndarray],
+                   timestamps: np.ndarray) -> int:
+        """Route the global batch: keep only this process's keys; returns
+        the number of rows ingested locally."""
+        key_attr = self.key_attrs.get(stream_id)
+        if key_attr is None:
+            keep = np.ones(len(timestamps), bool)     # broadcast stream
+        else:
+            keys = columns[key_attr]
+            keep = np.asarray([self.owns(k) for k in keys], bool)
+        n = int(keep.sum())
+        if n:
+            self.runtime.get_input_handler(stream_id).send_batch(
+                {k: np.asarray(v)[keep] for k, v in columns.items()},
+                timestamps=np.asarray(timestamps)[keep])
+        return n
+
+    # ------------------------------------------------------------ control
+
+    def start(self):
+        self.runtime.start()
+
+    def flush(self):
+        self.runtime.flush()
+
+    def shutdown(self):
+        self.runtime.shutdown()
+
+    def add_callback(self, target: str, cb):
+        self.runtime.add_callback(target, cb)
+
+    # ------------------------------------------------------------ stats
+
+    _DIGIT = 1 << 20        # 3 base-2^20 digits: int32 lanes stay exact
+    #                         (digit sums < 2^20 * hosts) without x64 —
+    #                         JAX canonicalizes i64→i32 by default, so a
+    #                         single int lane would wrap past 2^31
+
+    def global_stats(self, **local_counters: int) -> Dict[str, int]:
+        """All-reduce per-host counters over the GLOBAL device set — the
+        framework's cross-host collective (XLA lowers the sum over the
+        process-sharded axis to an all-reduce over DCN).  Exact for
+        counters below 2^60 on up to 2^11 hosts (three base-2^20 digits
+        summed in int32)."""
+        import jax
+
+        names = sorted(local_counters)
+        if self._stats_jit is None:
+            import jax.numpy as jnp
+            from jax.sharding import (Mesh, NamedSharding,
+                                      PartitionSpec as P)
+            mesh = Mesh(np.asarray(jax.devices()), ("h",))
+            self._stats_sh = NamedSharding(mesh, P("h"))
+            self._stats_jit = jax.jit(
+                lambda v: jnp.sum(v, axis=0),
+                out_shardings=NamedSharding(mesh, P()))
+        n_local = len(jax.local_devices())
+        D = self._DIGIT
+        # [n_local, n_names, 3] — device 0's row carries the digits, the
+        # rest zeros → global sum == sum over hosts
+        vec = np.zeros((n_local, len(names), 3), np.int32)
+        for j, n in enumerate(names):
+            v = int(local_counters[n])
+            vec[0, j] = [v % D, (v // D) % D, v // (D * D)]
+        g = jax.make_array_from_process_local_data(self._stats_sh, vec)
+        digits = np.asarray(self._stats_jit(g))
+        return {n: int(digits[j, 0]) + int(digits[j, 1]) * D +
+                int(digits[j, 2]) * D * D
+                for j, n in enumerate(names)}

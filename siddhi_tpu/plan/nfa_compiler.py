@@ -1288,6 +1288,13 @@ class CompiledPatternNFA:
 
     def process_block(self, block: Dict[str, np.ndarray]):
         """Run one [P, T] packed block; returns raw match buffers."""
+        if self.mesh is not None and jax.process_count() > 1:
+            # multiprocess jit refuses to auto-shard numpy inputs even on
+            # an all-local mesh — device_put the block explicitly
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            ax = tuple(self.mesh.axis_names)[0]
+            sh = NamedSharding(self.mesh, P(ax, None))
+            block = {k: jax.device_put(v, sh) for k, v in block.items()}
         self.carry, (mask, caps, ts, enter, seq) = self._step(self.carry,
                                                              block)
         return mask, caps, ts, enter, seq

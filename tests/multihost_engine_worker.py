@@ -1,0 +1,86 @@
+"""Worker for the PUBLIC-API multi-host test: one process of a
+jax.distributed cluster running a partitioned @app:engine('device')
+SiddhiManager app through parallel.multihost.MultiHostAppRuntime.
+
+Each process generates the SAME deterministic global stream; the wrapper
+routes each event to its key's owning process, so the planner-built
+KEYED device runtime (key→lane slab + @Async pipelined ingest + flush
+barriers + grow-and-replay) executes with jax.process_count() > 1 over
+this host's local devices.  Writes local match payloads + the DCN-
+reduced global stats as JSON.
+
+Usage: multihost_engine_worker.py <coordinator> <num_procs> <pid> <out>
+"""
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=4")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np  # noqa: E402
+
+from siddhi_tpu import StreamCallback  # noqa: E402
+from siddhi_tpu.parallel.multihost import MultiHostAppRuntime  # noqa: E402
+
+APP = """@app:playback
+@Async(buffer.size='64', batch.size.max='4096')
+define stream S (sym string, price float, kind int);
+partition with (sym of S) begin
+@info(name='q')
+from every e1=S[kind == 0] -> e2=S[kind == 1 and price > e1.price]
+    within 10 sec
+select e1.price as p1, e2.price as p2 insert into Out;
+end;
+"""
+
+N_KEYS = 48          # > the slab's starting lane count → forces growth
+CHUNK = 1024
+CHUNKS = 3
+
+
+def global_chunk(ci: int):
+    rng = np.random.default_rng(777 + ci)
+    syms = np.asarray([f"k{i % N_KEYS}" for i in range(CHUNK)], object)
+    cols = {"sym": syms,
+            "price": rng.uniform(0, 100, CHUNK).astype(np.float32),
+            "kind": rng.integers(0, 2, CHUNK).astype(np.int64)}
+    ts = 1_000_000 + ci * CHUNK * 3 + np.arange(CHUNK, dtype=np.int64) * 3
+    return cols, ts
+
+
+def main():
+    coord, nproc, pid, out_path = sys.argv[1:5]
+    mh = MultiHostAppRuntime(APP, coord, int(nproc), int(pid))
+    assert jax.process_count() == int(nproc), jax.process_count()
+    got = []
+    cb = StreamCallback(lambda evs: got.extend(
+        (round(float(e.data[0]), 3), round(float(e.data[1]), 3))
+        for e in evs))
+    mh.add_callback("Out", cb)
+    mh.start()
+    sent = 0
+    for ci in range(CHUNKS):
+        cols, ts = global_chunk(ci)
+        sent += mh.send_batch("S", cols, ts)
+    mh.flush()
+    stats = mh.global_stats(matches=len(got), ingested=sent)
+    backend = None
+    for pr in mh.runtime.partition_runtimes:
+        for qr in getattr(pr, "device_query_runtimes", {}).values():
+            backend = qr.backend
+    mh.shutdown()
+    with open(out_path, "w") as f:
+        json.dump({"pid": int(pid), "local_matches": sorted(got),
+                   "ingested": sent, "stats": stats,
+                   "backend": backend}, f)
+
+
+if __name__ == "__main__":
+    main()

