@@ -51,12 +51,18 @@ C_TIME, C_LEN, C_BATCH, C_EXPBATCH, C_DELAY = 1, 2, 3, 4, 5
 
 class DwinSpec(NamedTuple):
     kind: str            # length|time|externalTime|timeLength|delay|
-    #                      lengthBatch|timeBatch|externalTimeBatch|batch
+    #                      lengthBatch|timeBatch|externalTimeBatch|batch|
+    #                      sort|session
     capacity: int        # ring capacity W (grow-and-replay on overflow)
     n_f: int             # f32 payload lanes
     n_i: int             # i32 payload lanes
-    window_ms: int       # time span (0 for pure length kinds)
+    window_ms: int       # time span (0 for pure length kinds); session gap
     length: int          # count bound (0 for pure time kinds)
+    sort_keys: tuple = ()  # sort kind: ((bank 0=f/1=i, lane, asc), ...) —
+    #                        lex compare order; LONG attrs ride two (hi,
+    #                        lo) entries whose lex order IS int64 order
+    skey_lane: int = -1  # session kind: i32 lane holding the dict-encoded
+    #                      session key (keyless apps encode one code)
 
 
 def make_dwin_carry(spec: DwinSpec, n_lanes: int) -> Dict[str, np.ndarray]:
@@ -161,6 +167,79 @@ def build_dwin_step(spec: DwinSpec):
         j = jnp.arange(M)[None, :]
         is_carry = j < W
         new_carry = dict(carry)
+
+        if kind == "sort":
+            # Keep the bottom-N by (sort key, arrival rank); each
+            # overflowing arrival evicts the current lex-max (reference
+            # SortWindowProcessor.java).  Greedy max-eviction telescopes:
+            # the set after event t is bottom_N(pool through t), so entry
+            # x is evicted at the FIRST t where >= N lex-smaller entries
+            # have arrived — the N-th smallest arrival step among x's
+            # lex-predecessors (an [M, M] order statistic; dwin rings are
+            # single-lane and modest, the quadratic mask is cheap).
+            n = spec.length
+            less = jnp.zeros((P, M, M), bool)
+            eq = jnp.ones((P, M, M), bool)
+            for (bank, lane, asc) in spec.sort_keys:
+                v = pf[:, :, lane] if bank == 0 else pi[:, :, lane]
+                a = v[:, :, None]           # x
+                b = v[:, None, :]           # y
+                lt = (b < a) if asc else (b > a)
+                less = less | (eq & lt)
+                eq = eq & (b == a)
+            # tie: equal keys keep buffer order — the NEWEST (largest
+            # rank) is evicted first, so older counts as smaller
+            less = less | (eq & (rank[:, None, :] < rank[:, :, None]))
+            less = less & live[:, None, :]
+            arr = jnp.where(is_carry, -1, rank - fill[:, None])  # [P, M]
+            BIG = jnp.int32(2 ** 30)
+            a_mask = jnp.where(less, arr[:, None, :], BIG)
+            a_sorted = jnp.sort(a_mask, axis=2)
+            idx = min(n - 1, M - 1)
+            tN = a_sorted[:, :, idx]
+            evict_t = jnp.maximum(tN, arr)
+            evicted = live & (tN < BIG) & (evict_t < nv[:, None]) if \
+                n - 1 < M else jnp.zeros((P, M), bool)
+            cause = jnp.full((P, M), C_LEN, jnp.int32)
+            keep = live & ~evicted
+            sf, si, sts, nfill, ovf = _new_ring(pf, pi, pts, keep, rank,
+                                                W, F, I)
+            new_carry.update(ring_f=sf, ring_i=si, ring_ts=sts,
+                             fill=nfill)
+            buf = _pack_egress(evicted, j, evict_t, cause, pts, pf, pi,
+                               (jnp.max(nfill), jnp.int32(0), TS_NONE,
+                                jnp.max(ovf.astype(jnp.int32))), cap)
+            return new_carry, buf
+
+        if kind == "session":
+            # Per-key gap sessions (reference SessionWindowProcessor):
+            # the host expires due sessions BEFORE appending the chunk
+            # (its _expire_sessions(now) runs first, so same-key chunk
+            # events start a FRESH session).  A carried entry's session
+            # is due when its key's last activity + gap <= now; evicted
+            # rows carry (last + gap) in the evict_t column as the
+            # EXPIRED emission timestamp offsets.
+            key = pi[:, :, spec.skey_lane]
+            carry_live = live & is_carry
+            same = (key[:, None, :] == key[:, :, None]) & \
+                carry_live[:, None, :]
+            NEG = jnp.int32(-(2 ** 30))
+            last = jnp.max(jnp.where(same, pts[:, None, :], NEG), axis=2)
+            expired = carry_live & (last + spec.window_ms <= now[:, None])
+            evict_ts = last + spec.window_ms
+            cause = jnp.full((P, M), C_TIME, jnp.int32)
+            keep = live & ~expired
+            sf, si, sts, nfill, ovf = _new_ring(pf, pi, pts, keep, rank,
+                                                W, F, I)
+            new_carry.update(ring_f=sf, ring_i=si, ring_ts=sts,
+                             fill=nfill)
+            # min live ts drives the host's next gap timer
+            live_min = jnp.min(jnp.where(
+                jnp.arange(W)[None, :] < nfill[:, None], sts, TS_NONE))
+            buf = _pack_egress(expired, j, evict_ts, cause, pts, pf, pi,
+                               (jnp.max(nfill), jnp.int32(0), live_min,
+                                jnp.max(ovf.astype(jnp.int32))), cap)
+            return new_carry, buf
 
         if kind in ("length", "time", "externalTime", "timeLength",
                     "delay"):

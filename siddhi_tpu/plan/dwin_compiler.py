@@ -39,7 +39,7 @@ from ..utils.errors import (SiddhiAppCreationError,
 
 DEVICE_KINDS = ("length", "lengthBatch", "time", "timeBatch",
                 "externalTime", "externalTimeBatch", "timeLength",
-                "delay", "batch")
+                "delay", "batch", "sort", "session")
 _BATCH_KINDS = ("lengthBatch", "timeBatch", "externalTimeBatch", "batch")
 W_START = 16
 LONG_BASE = np.int64(1) << 31
@@ -81,7 +81,7 @@ class DeviceWindowProcessor(WindowProcessor):
         self.ts_expr = None
         need = {"length": 1, "lengthBatch": 1, "time": 1, "timeBatch": 1,
                 "delay": 1, "externalTime": 2, "externalTimeBatch": 2,
-                "timeLength": 2, "batch": 0}[kind]
+                "timeLength": 2, "batch": 0, "sort": 2, "session": 1}[kind]
         if len(params) < need:
             _reject(f"#window.{kind} needs {need} parameter(s)")
         if kind == "length" or kind == "lengthBatch":
@@ -104,6 +104,38 @@ class DeviceWindowProcessor(WindowProcessor):
         elif kind == "timeLength":
             self.window_ms = _const_ms(params[0])
             self.length = _const_ms(params[1])
+        elif kind == "sort":
+            # sort(n, attr [, 'asc'|'desc', attr2, ...]) — round 5
+            self.length = _const_ms(params[0])
+            if self.length <= 0:
+                _reject("sort length must be positive")
+            self.sort_attrs: List[Tuple[str, bool]] = []
+            i = 1
+            while i < len(params):
+                p = params[i]
+                if not isinstance(p, Variable):
+                    _reject("sort keys must be plain attributes")
+                asc = True
+                if i + 1 < len(params) and \
+                        isinstance(params[i + 1], Constant) and \
+                        isinstance(params[i + 1].value, str):
+                    asc = params[i + 1].value.lower() != "desc"
+                    i += 1
+                self.sort_attrs.append((p.attribute, asc))
+                i += 1
+            if not self.sort_attrs:
+                _reject("sort needs at least one key attribute")
+        elif kind == "session":
+            # session(gap [, key_attr]) — round 5; allowedLatency (the
+            # late-event merge window) stays host
+            self.window_ms = _const_ms(params[0])
+            self.session_key: Optional[str] = None
+            if len(params) > 1:
+                if not isinstance(params[1], Variable):
+                    _reject("session key must be a plain attribute")
+                self.session_key = params[1].attribute
+            if len(params) > 2:
+                _reject("session allowedLatency is host-only")
         # batch(): no params
 
         # ---- payload lane assignment
@@ -143,6 +175,33 @@ class DeviceWindowProcessor(WindowProcessor):
             # two extra i32 lanes
             self._arr_lanes = (ni, ni + 1)
             ni += 2
+        self._skey_lane = -1
+        if kind == "session":
+            # dict-encoded session key rides an extra i32 lane (keyless
+            # sessions share one code)
+            self._skey_lane = ni
+            ni += 1
+            self._skey_enc: Dict = {}
+        self._sort_keys: Tuple = ()
+        if kind == "sort":
+            keys = []
+            for attr, asc in self.sort_attrs:
+                t = self.attr_types.get(attr)
+                if t is None:
+                    _reject(f"sort key '{attr}' is not a stream attribute")
+                if attr in self.f_lanes:
+                    keys.append((0, self.f_lanes[attr], asc))
+                elif t in (AttrType.INT, AttrType.BOOL):
+                    keys.append((1, self.i_lanes[attr][0], asc))
+                elif t == AttrType.LONG:
+                    # (hi, lo) lex order IS int64 order (lo in [0, 2^31))
+                    hi, lo = self.i_lanes[attr]
+                    keys.append((1, hi, asc))
+                    keys.append((1, lo, asc))
+                else:
+                    _reject(f"sort key '{attr}' ({t.name}) has no ordered "
+                            "device lane (STRING/DOUBLE sort stays host)")
+            self._sort_keys = tuple(keys)
         self.n_f, self.n_i = nf, ni
 
         self.capacity = max(W_START, 2 * self.length or 0)
@@ -163,20 +222,22 @@ class DeviceWindowProcessor(WindowProcessor):
 
     # ------------------------------------------------------------ encode
 
+    def _spec(self) -> DwinSpec:
+        return DwinSpec(self.kind, self.capacity, self.n_f, self.n_i,
+                        self.window_ms, self.length,
+                        sort_keys=self._sort_keys,
+                        skey_lane=self._skey_lane)
+
     def _ensure_carry(self):
         if self.carry is None:
-            spec = DwinSpec(self.kind, self.capacity, self.n_f, self.n_i,
-                            self.window_ms, self.length)
             self.carry = {k: jnp.asarray(v) for k, v in
-                          make_dwin_carry(spec, 1).items()}
+                          make_dwin_carry(self._spec(), 1).items()}
 
     def _step_for(self, T: int):
         key = (self.capacity, T)
         fn = self._steps.get(key)
         if fn is None:
-            spec = DwinSpec(self.kind, self.capacity, self.n_f, self.n_i,
-                            self.window_ms, self.length)
-            fn = jax.jit(build_dwin_step(spec), static_argnums=7)
+            fn = jax.jit(build_dwin_step(self._spec()), static_argnums=7)
             self._steps[key] = fn
         return fn
 
@@ -285,8 +346,25 @@ class DeviceWindowProcessor(WindowProcessor):
             lo = arr - hi * LONG_BASE
             ev_i[0, :, self._arr_lanes[0]] = hi.astype(np.int32)
             ev_i[0, :, self._arr_lanes[1]] = lo.astype(np.int32)
+        if self.kind == "session":
+            if self.session_key is None:
+                ev_i[0, :, self._skey_lane] = 1
+            else:
+                col = chunk.columns.get(self.session_key)
+                vals = (np.asarray(col, object) if col is not None
+                        else np.full(T, None, object))
+                ev_i[0, :, self._skey_lane] = [
+                    self._skey_code(v) for v in vals]
         ts_off = self._offsets(ring_ts64)
         return ev_f, ev_i, ts_off.reshape(1, T)
+
+    def _skey_code(self, v) -> int:
+        v = v.item() if hasattr(v, "item") else v
+        c = self._skey_enc.get(v)
+        if c is None:
+            c = len(self._skey_enc) + 1
+            self._skey_enc[v] = c
+        return c
 
     # ------------------------------------------------------------ decode
 
@@ -463,7 +541,7 @@ class DeviceWindowProcessor(WindowProcessor):
 
     def on_data(self, chunk: EventChunk):
         now = int(chunk.timestamps[-1])
-        if self.kind in ("time", "delay", "timeLength"):
+        if self.kind in ("time", "delay", "timeLength", "session"):
             self.app_ctx.scheduler.notify_at(now + self.window_ms,
                                              self._on_timer)
         if self.kind in _BATCH_KINDS:
@@ -548,6 +626,29 @@ class DeviceWindowProcessor(WindowProcessor):
                 EXPIRED)
             out = chunk.with_types(CURRENT)
             if len(expired):
+                out = EventChunk.concat([expired, out])
+            self.send_next(out)
+        elif self.kind == "sort":
+            # one eviction per overflowing arrival: order by the
+            # triggering event, then interleave like length (reference
+            # SortWindowProcessor emits the evicted extremum right after
+            # the arrival that displaced it)
+            order = np.argsort(evt, kind="stable")
+            exp_ts = chunk.timestamps[np.minimum(evt[order],
+                                                 len(chunk) - 1)]
+            expired = self._rows_to_chunk(rf[order], ri[order], exp_ts,
+                                          EXPIRED)
+            c0 = max(0, self.length - fill_pre)
+            self.send_next(_interleave(expired, chunk.with_types(CURRENT),
+                                       c0))
+        elif self.kind == "session":
+            # due sessions emit BEFORE the chunk (the host expires first,
+            # so same-key chunk events start a fresh session), grouped in
+            # session-first-arrival order; the EXPIRED timestamp is
+            # last-activity + gap (the kernel's evict column)
+            out = chunk.with_types(CURRENT)
+            if len(rf):
+                expired = self._session_expired_chunk(evt, rf, ri, base)
                 out = EventChunk.concat([expired, out])
             self.send_next(out)
         elif self.kind == "delay":
@@ -691,11 +792,35 @@ class DeviceWindowProcessor(WindowProcessor):
 
     _last_min_live: Optional[int] = None
 
+    def _session_expired_chunk(self, evt, rf, ri, base) -> EventChunk:
+        """Expired-session rows → chunk, grouped in session-first-arrival
+        order (the host's dict-insertion iteration); EXPIRED ts =
+        last-activity + gap (the kernel's evict column)."""
+        keys = ri[:, self._skey_lane]
+        first: Dict[int, int] = {}
+        for i, k in enumerate(keys):
+            first.setdefault(int(k), i)
+        order = np.argsort([first[int(k)] for k in keys], kind="stable")
+        return self._rows_to_chunk(
+            rf[order], ri[order],
+            evt[order].astype(np.int64) + base, EXPIRED)
+
     def on_timer_event(self, ts: int):
-        if self.kind in ("length", "lengthBatch", "batch",
+        if self.kind in ("length", "lengthBatch", "batch", "sort",
                          "externalTime", "externalTimeBatch"):
             return
         self.flush()       # timer steps read/advance the live carry
+        if self.kind == "session":
+            if self._fill_host == 0:
+                return
+            (_i, evt, _c, _to, rf, ri, mn) = self._run_step(None, ts,
+                                                            None)
+            base = self._base or 0
+            self._last_min_live = mn + base if mn != int(TS_NONE) else None
+            if len(rf):
+                self.send_next(self._session_expired_chunk(evt, rf, ri,
+                                                           base))
+            return
         if self.kind == "timeBatch":
             if self.next_emit is None:
                 return
@@ -753,7 +878,9 @@ class DeviceWindowProcessor(WindowProcessor):
                 "next_emit": self.next_emit,
                 "window_end": self.window_end,
                 "strs": {a: list(dec) for a, (_e, dec)
-                         in self.str_attrs.items()}}
+                         in self.str_attrs.items()},
+                "skey": (list(self._skey_enc.items())
+                         if self._skey_lane >= 0 else None)}
 
     def restore_state(self, state):
         if "dwin" not in state:           # snapshot from a host window
@@ -773,3 +900,5 @@ class DeviceWindowProcessor(WindowProcessor):
         for a, dec in state["strs"].items():
             self.str_attrs[a] = ({v: i + 1 for i, v in enumerate(dec)},
                                  list(dec))
+        if state.get("skey") is not None:
+            self._skey_enc = dict(state["skey"])

@@ -23,6 +23,12 @@ KIND_QUERIES = {
     "timeLength": "#window.timeLength(1 sec, 4)",
     "delay": "#window.delay(300)",
     "batch": "#window.batch()",
+    # round 5: device sort (multi-key incl. LONG hi/lo lex + desc) and
+    # per-key gap sessions
+    "sort": "#window.sort(3, price)",
+    "sort_desc_multi": "#window.sort(4, volume, 'desc', price)",
+    "session": "#window.session(700)",
+    "session_keyed": "#window.session(700, symbol)",
 }
 
 
