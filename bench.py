@@ -654,6 +654,30 @@ def bench_oracle():
     return n / elapsed
 
 
+def _kernel_profile_summary() -> dict:
+    """Per-kernel profile of THIS phase process (calls, compiles,
+    dispatch-time fractions, bytes moved) — recorded next to the
+    throughput numbers so a BENCH_*.json round captures WHY a number
+    moved ("NFA step retraced 40x"), not just that it did."""
+    from siddhi_tpu.core.profiling import profiler
+    snap = profiler().snapshot()
+    total = sum(k["dispatch_time_s"] for k in snap.values())
+    for k in snap.values():
+        k["dispatch_time_fraction"] = round(
+            k["dispatch_time_s"] / total, 4) if total else 0.0
+        for f in ("dispatch_time_s", "device_time_s"):
+            k[f] = round(k[f], 4)
+    return snap
+
+
+def _with_profile(fn) -> dict:
+    from siddhi_tpu.core.profiling import profiler
+    profiler().enable()
+    res = fn()
+    res["kernel_profile"] = _kernel_profile_summary()
+    return res
+
+
 def _run_phase(phase: str) -> dict:
     """Run one device phase in a FRESH subprocess so one phase's queued
     device work (the runtime's readiness API returns early — see
@@ -676,17 +700,17 @@ def main():
             conformance_gate()
             print(json.dumps({"gate": "passed"}))
         elif phase == "thru":
-            print(json.dumps(bench_thru()))
+            print(json.dumps(_with_profile(bench_thru)))
         elif phase == "lat":
-            print(json.dumps(bench_lat()))
+            print(json.dumps(_with_profile(bench_lat)))
         elif phase == "latsweep":
             print(json.dumps(bench_latsweep()))
         elif phase == "engine":
-            print(json.dumps(bench_engine()))
+            print(json.dumps(_with_profile(bench_engine)))
         elif phase == "engine_wagg":
-            print(json.dumps(bench_engine_wagg()))
+            print(json.dumps(_with_profile(bench_engine_wagg)))
         elif phase == "engine_absent":
-            print(json.dumps(bench_engine_absent()))
+            print(json.dumps(_with_profile(bench_engine_absent)))
         return
 
     import jax
@@ -770,6 +794,11 @@ def main():
         "conformance_gate": (f"passed at measured shape P={N_PARTITIONS} "
                              f"K={N_SLOTS} T={T_PER_BLOCK} "
                              f"chunk={PATTERN_CHUNK}"),
+        # per-kernel attribution (compile counts, dispatch-time
+        # fractions, bytes moved) for the two headline phases — the
+        # "why" next to the "what" for BENCH round diffs
+        "kernel_profile_thru": thru.get("kernel_profile"),
+        "kernel_profile_engine": eng.get("kernel_profile"),
     }))
 
 

@@ -26,7 +26,11 @@ __version__ = "0.1.0"
 
 from .compiler import SiddhiCompiler
 from .core.event import Event, EventChunk
+from .core.profiling import (KernelProfiler, disable_profiling,
+                             enable_profiling, profiler)
 from .core.runtime import SiddhiAppRuntime, SiddhiManager
+from .core.statistics import StatisticsManager, prometheus_text
+from .core.tracing import Tracer, disable_tracing, enable_tracing, tracer
 from .core.snapshot import (FileSystemPersistenceStore,
                             InMemoryPersistenceStore, PersistenceStore)
 from .core.source_sink import InMemoryBroker
@@ -41,4 +45,7 @@ __all__ = [
     "FileSystemPersistenceStore",
     "SiddhiApp", "StreamDefinition", "Query", "Selector", "Expression",
     "Annotation", "AttrType",
+    "StatisticsManager", "prometheus_text",
+    "KernelProfiler", "profiler", "enable_profiling", "disable_profiling",
+    "Tracer", "tracer", "enable_tracing", "disable_tracing",
 ]

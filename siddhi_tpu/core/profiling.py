@@ -1,0 +1,253 @@
+"""Device/kernel profiling.
+
+Wraps every jitted step function the planner and the plan/* compilers
+build (NFA step, bank step, egress pack, dwin/gagg/wagg steps, device
+filter program) in a ``ProfiledKernel`` that — when profiling is enabled
+— records per kernel:
+
+  * call count and host-side dispatch time,
+  * compile/retrace count (via the jitted callable's ``_cache_size()``
+    when JAX exposes it, argument-signature tracking otherwise) — so a
+    BENCH regression can be attributed to "NFA step retraced 40x"
+    instead of guessed at,
+  * blocked device time (``jax.block_until_ready`` deltas) when
+    ``device_timing`` is on — this serializes the pipeline, so it is a
+    separate, opt-in level,
+  * batch sizes (events carried per call, from a per-site hint) and
+    host→device transfer bytes (host-resident ndarray arguments);
+    device→host bytes are reported by the egress/retire sites via
+    ``record_d2h``.
+
+Disabled (the default) the wrapper is one attribute check + a passthrough
+call per *block* — zero extra device syncs, nothing registered.  The
+profiler is process-global (kernels are built by standalone compiled
+objects as well as app runtimes); ``@app:statistics`` enables it for the
+process, ``enable_profiling()`` does so explicitly.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+class KernelStats:
+    __slots__ = ("name", "calls", "compile_count", "dispatch_ns",
+                 "device_ns", "batch_events", "h2d_bytes", "d2h_bytes",
+                 "max_batch", "signatures")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.calls = 0
+        self.compile_count = 0
+        self.dispatch_ns = 0
+        self.device_ns = 0
+        self.batch_events = 0
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.max_batch = 0
+        self.signatures: set = set()
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"calls": self.calls,
+                "compile_count": self.compile_count,
+                "dispatch_time_s": self.dispatch_ns / 1e9,
+                "device_time_s": self.device_ns / 1e9,
+                "batch_events": self.batch_events,
+                "max_batch": self.max_batch,
+                "h2d_bytes": self.h2d_bytes,
+                "d2h_bytes": self.d2h_bytes}
+
+
+def _signature(args) -> tuple:
+    """Shape/dtype signature of the positional args — retrace detector
+    for callables that don't expose a compile-cache size."""
+    import numpy as np
+    sig: List[Any] = []
+    for a in args:
+        if hasattr(a, "shape") and hasattr(a, "dtype"):
+            sig.append((tuple(a.shape), str(a.dtype)))
+        elif isinstance(a, dict):
+            sig.append(tuple(sorted(
+                (k, tuple(v.shape), str(v.dtype))
+                for k, v in a.items()
+                if hasattr(v, "shape") and hasattr(v, "dtype"))))
+        elif isinstance(a, (int, float, bool, str, type(None))):
+            sig.append(a)
+        elif isinstance(a, np.ndarray):
+            sig.append((tuple(a.shape), str(a.dtype)))
+        else:
+            sig.append(type(a).__name__)
+    return tuple(sig)
+
+
+def _host_bytes(args) -> int:
+    """nbytes of host-resident ndarray leaves (≈ the H2D transfer the
+    call implies; device-resident jax arrays transfer nothing)."""
+    import numpy as np
+    total = 0
+    stack = list(args)
+    while stack:
+        a = stack.pop()
+        if isinstance(a, np.ndarray):
+            total += a.nbytes
+        elif isinstance(a, dict):
+            stack.extend(a.values())
+        elif isinstance(a, (list, tuple)):
+            stack.extend(a)
+    return total
+
+
+class ProfiledKernel:
+    """Transparent wrapper around a jitted callable."""
+
+    __slots__ = ("fn", "stats", "profiler", "batch_of", "_cache_size_fn",
+                 "_last_cs")
+
+    def __init__(self, fn: Callable, stats: KernelStats,
+                 profiler: "KernelProfiler",
+                 batch_of: Optional[Callable[..., int]] = None):
+        self.fn = fn
+        self.stats = stats
+        self.profiler = profiler
+        self.batch_of = batch_of
+        self._cache_size_fn = getattr(fn, "_cache_size", None)
+        self._last_cs = 0
+
+    def __call__(self, *args, **kwargs):
+        prof = self.profiler
+        if not prof.enabled:
+            return self.fn(*args, **kwargs)
+        st = self.stats
+        t0 = time.perf_counter_ns()
+        out = self.fn(*args, **kwargs)
+        t1 = time.perf_counter_ns()
+        compiled = False
+        with prof._lock:
+            st.calls += 1
+            st.dispatch_ns += t1 - t0
+            if self._cache_size_fn is not None:
+                try:
+                    # per-wrapper delta: stats with one name can span
+                    # several rebuilt jit instances (slot growth rebuilds
+                    # the step), each with its own compile cache
+                    cs = self._cache_size_fn()
+                    if cs > self._last_cs:
+                        compiled = True
+                        st.compile_count += cs - self._last_cs
+                        self._last_cs = cs
+                except Exception:   # noqa: BLE001 — fall back to sigs
+                    self._cache_size_fn = None
+            if self._cache_size_fn is None:
+                sig = _signature(args)
+                if sig not in st.signatures:
+                    st.signatures.add(sig)
+                    st.compile_count += 1
+                    compiled = True
+            if self.batch_of is not None:
+                try:
+                    b = int(self.batch_of(*args, **kwargs))
+                    st.batch_events += b
+                    if b > st.max_batch:
+                        st.max_batch = b
+                except Exception:   # noqa: BLE001 — hint only
+                    pass
+            st.h2d_bytes += _host_bytes(args)
+        from .tracing import tracer
+        tr = tracer()
+        if tr.enabled:
+            if compiled:
+                tr.instant(f"jit-compile:{st.name}", cat="jit")
+            tr.complete(f"kernel.{st.name}", t0, t1, cat="kernel")
+        if prof.device_timing:
+            import jax
+            t2 = time.perf_counter_ns()
+            out = jax.block_until_ready(out)
+            with prof._lock:
+                st.device_ns += (t1 - t0) + (time.perf_counter_ns() - t2)
+        return out
+
+
+class KernelProfiler:
+    def __init__(self):
+        self.kernels: Dict[str, KernelStats] = {}
+        self.enabled = False
+        self.device_timing = False
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ control
+
+    def enable(self, device_timing: bool = False):
+        self.enabled = True
+        self.device_timing = device_timing
+
+    def disable(self):
+        self.enabled = False
+        self.device_timing = False
+
+    def reset(self):
+        with self._lock:
+            self.kernels.clear()
+
+    # ------------------------------------------------------------ recording
+
+    def stats(self, name: str) -> KernelStats:
+        with self._lock:
+            return self.kernels.setdefault(name, KernelStats(name))
+
+    def wrap(self, name: str, fn: Callable,
+             batch_of: Optional[Callable[..., int]] = None
+             ) -> ProfiledKernel:
+        return ProfiledKernel(fn, self.stats(name), self, batch_of)
+
+    def record_d2h(self, name: str, nbytes: int):
+        if not self.enabled:
+            return
+        self.stats(name).d2h_bytes += int(nbytes)
+
+    # ------------------------------------------------------------ reads
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {name: st.as_dict() for name, st in self.kernels.items()}
+
+    def prometheus_lines(self) -> List[str]:
+        lines: List[str] = []
+        for name, st in list(self.kernels.items()):
+            lb = '{kernel="' + name + '"}'
+            lines.append(f"siddhi_kernel_calls_total{lb} {st.calls}")
+            lines.append(
+                f"siddhi_kernel_compile_count{lb} {st.compile_count}")
+            lines.append("siddhi_kernel_device_time_seconds_total"
+                         f"{lb} {st.device_ns / 1e9:.9g}")
+            lines.append("siddhi_kernel_dispatch_time_seconds_total"
+                         f"{lb} {st.dispatch_ns / 1e9:.9g}")
+            lines.append(f"siddhi_kernel_h2d_bytes_total{lb} {st.h2d_bytes}")
+            lines.append(f"siddhi_kernel_d2h_bytes_total{lb} {st.d2h_bytes}")
+            lines.append(
+                f"siddhi_kernel_batch_events_total{lb} {st.batch_events}")
+        return lines
+
+
+_GLOBAL = KernelProfiler()
+
+
+def profiler() -> KernelProfiler:
+    return _GLOBAL
+
+
+def wrap_kernel(name: str, fn: Callable,
+                batch_of: Optional[Callable[..., int]] = None
+                ) -> ProfiledKernel:
+    """Wrap a jitted callable under the process-global profiler.  The
+    wrapper is always installed (so later enabling profiles already-built
+    kernels); while disabled it is a single-attribute-check passthrough."""
+    return _GLOBAL.wrap(name, fn, batch_of)
+
+
+def enable_profiling(device_timing: bool = False):
+    _GLOBAL.enable(device_timing=device_timing)
+
+
+def disable_profiling():
+    _GLOBAL.disable()

@@ -140,6 +140,7 @@ class SiddhiAppRuntime:
         if stats is None:
             stats = find_annotation(self.app.annotations, "statistics")
         reporter, interval, enabled = "console", 60, False
+        tracing_on = False
         if stats is not None:
             reporter = stats.get("reporter", "console")
             interval = int(stats.get("interval", "60"))
@@ -150,9 +151,18 @@ class SiddhiAppRuntime:
                 enabled = str(enable_attr).lower() == "true"
             elif pos and str(pos[0]).lower() == "false":
                 enabled = False
+            tracing_on = str(stats.get("tracing", "false")).lower() == "true"
         self.app_ctx.statistics_manager = StatisticsManager(
             self.name, reporter, interval)
         self.app_ctx.stats_enabled = enabled
+        if enabled:
+            # kernel profiling rides @app:statistics: the per-kernel
+            # compile/device-time gauges feed the same /metrics surface
+            from .profiling import profiler
+            profiler().enable()
+        if tracing_on:
+            from .tracing import tracer
+            tracer().enable()
 
     def _build(self):
         from .source_sink import attach_sources_and_sinks
@@ -238,6 +248,11 @@ class SiddhiAppRuntime:
             sm = self.app_ctx.statistics_manager
             for sid, j in self.junctions.items():
                 j.throughput_tracker = sm.throughput_tracker("Streams", sid)
+                if j.is_async:
+                    # @Async queue depth: backpressure is visible before
+                    # it becomes an @OnError drop
+                    sm.buffered_tracker("Streams", sid).register(
+                        j.queue_depth)
 
     def _make_junction(self, sid: str, d: StreamDefinition) -> StreamJunction:
         fault_junction = None
@@ -450,14 +465,41 @@ class SiddhiAppRuntime:
 
     def enable_stats(self, enabled: bool = True):
         self.app_ctx.stats_enabled = enabled
+        from .profiling import profiler
         if enabled:
             self.app_ctx.statistics_manager.start_reporting()
+            profiler().enable()
+            if not self.app_ctx.statistics_manager.throughput:
+                # late enable: wire junction trackers now
+                sm = self.app_ctx.statistics_manager
+                for sid, j in self.junctions.items():
+                    j.throughput_tracker = sm.throughput_tracker(
+                        "Streams", sid)
+                    if j.is_async:
+                        sm.buffered_tracker("Streams", sid).register(
+                            j.queue_depth)
         else:
             self.app_ctx.statistics_manager.stop_reporting()
 
     @property
     def statistics(self) -> dict:
-        return self.app_ctx.statistics_manager.snapshot()
+        from .profiling import profiler
+        snap = self.app_ctx.statistics_manager.snapshot()
+        snap["kernels"] = profiler().snapshot()
+        return snap
+
+    # ------------------------------------------------------------ tracing
+
+    def enable_tracing(self):
+        from .tracing import tracer
+        tracer().enable()
+
+    def dump_trace(self, path: str) -> str:
+        """Export collected spans as Chrome trace-event JSON
+        (Perfetto-loadable).  Spans cover parse → plan → jit-compile →
+        ingest chunk → kernel step → match scatter → callback."""
+        from .tracing import tracer
+        return tracer().export(path)
 
     # ------------------------------------------------------------ store queries
 
@@ -498,10 +540,13 @@ class SiddhiManager:
 
     def create_siddhi_app_runtime(
             self, app: Union[str, SiddhiApp]) -> SiddhiAppRuntime:
+        from .tracing import trace_span
         app_string = app if isinstance(app, str) else None
         if isinstance(app, str):
-            app = SiddhiCompiler.parse(app)
-        rt = SiddhiAppRuntime(app, self.siddhi_context, app_string)
+            with trace_span("parse", cat="compile", chars=len(app)):
+                app = SiddhiCompiler.parse(app)
+        with trace_span("plan", cat="compile", app=app.name or "?"):
+            rt = SiddhiAppRuntime(app, self.siddhi_context, app_string)
         self.runtimes[rt.name] = rt
         return rt
 

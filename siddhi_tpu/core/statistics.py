@@ -5,9 +5,29 @@ StatisticsManager / StatisticsTrackerFactory SPIs; throughput per junction,
 latency per query, memory gauges; console/JMX reporters configured by
 `@app:statistics(reporter='console', interval='5')`.)
 
-Here: lightweight in-process counters with an optional periodic console/JSON
-reporter thread.  The memory gauge reports numpy buffer footprints of
-registered state holders instead of walking a Java object graph.
+Grown into a full metrics core (observability PR):
+
+  * ``Histogram`` — log-bucketed HDR-style value recorder (32 sub-buckets
+    per octave → ≤ ~6% relative error) with p50/p95/p99/max, the shape a
+    p99-latency headline metric needs (BASELINE.json).
+  * ``LatencyTracker`` — histogram-backed, safe under nesting and
+    concurrent queries (per-thread mark stacks; the old single `_mark`
+    field dropped legitimate 0-ns marks and let interleaved queries
+    corrupt each other).
+  * ``ThroughputTracker`` — lifetime AND windowed (since-last-snapshot)
+    rates, so a reporter interval sees current load, not the lifetime
+    average.
+  * ``Counter`` / ``Gauge`` — label-carrying primitives for everything
+    that isn't one of the four classic tracker kinds.
+  * Prometheus/OpenMetrics text rendering (``prometheus_text``) consumed
+    by the service's ``GET /metrics`` endpoint (service/rest.py).
+
+Metric naming keeps the reference's
+``io.siddhi.SiddhiApps.<app>.Siddhi.<kind>.<name>`` scheme internally;
+the Prometheus renderer maps it onto ``siddhi_*{app=,kind=,name=}``
+series.  Everything stays off the hot path when ``@app:statistics`` is
+disabled: no trackers are registered at all (core/runtime.py wires them
+only when enabled).
 """
 from __future__ import annotations
 
@@ -15,16 +35,117 @@ import json
 import sys
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
+# ------------------------------------------------------------------ histogram
+
+_SUB_BITS = 5                    # 2^5 sub-buckets per octave
+_SUB = 1 << _SUB_BITS            # values < 32 are exact
+_HALF = _SUB >> 1
+
+
+def _bucket_index(v: int) -> int:
+    """Value → log-bucket index.  Exact below _SUB; above, one bucket per
+    (octave, sub-bucket) pair — HDR-histogram math with 2^(1-_SUB_BITS)
+    (~6%) worst-case relative error."""
+    if v < _SUB:
+        return v if v >= 0 else 0
+    s = v.bit_length() - _SUB_BITS
+    return _SUB + ((s - 1) << (_SUB_BITS - 1)) + ((v >> s) - _HALF)
+
+
+def _bucket_bounds(idx: int) -> Tuple[int, int]:
+    """Bucket index → half-open value range [lo, hi)."""
+    if idx < _SUB:
+        return idx, idx + 1
+    s = ((idx - _SUB) >> (_SUB_BITS - 1)) + 1
+    sub = (idx - _SUB) & (_HALF - 1)
+    lo = (_HALF + sub) << s
+    return lo, lo + (1 << s)
+
+
+class Histogram:
+    """Log-bucketed value recorder with percentile estimation.
+
+    ``record`` is O(1) (a bit_length + one list increment); percentile
+    reads walk the bucket array.  Thread-safe: records take a lock —
+    callers record per *chunk*, not per event, so contention is nil.
+    """
+
+    __slots__ = ("counts", "count", "total", "min", "max", "_lock")
+
+    def __init__(self):
+        self.counts: List[int] = []
+        self.count = 0
+        self.total = 0
+        self.min: Optional[int] = None
+        self.max = 0
+        self._lock = threading.Lock()
+
+    def record(self, v: int) -> None:
+        v = int(v)
+        if v < 0:
+            v = 0
+        idx = _bucket_index(v)
+        with self._lock:
+            if idx >= len(self.counts):
+                self.counts.extend([0] * (idx + 1 - len(self.counts)))
+            self.counts[idx] += 1
+            self.count += 1
+            self.total += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 100] → bucket-midpoint estimate (≤ ~6% rel error)."""
+        with self._lock:
+            n = self.count
+            if n == 0:
+                return 0.0
+            target = max(1, int(round(q / 100.0 * n)))
+            cum = 0
+            for idx, c in enumerate(self.counts):
+                if not c:
+                    continue
+                cum += c
+                if cum >= target:
+                    lo, hi = _bucket_bounds(idx)
+                    return (lo + hi - 1) / 2.0
+            return float(self.max)
+
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def buckets(self) -> List[Tuple[int, int]]:
+        """Non-empty (upper_bound, count) pairs in increasing order —
+        feed for cumulative Prometheus ``_bucket`` series."""
+        with self._lock:
+            return [(_bucket_bounds(i)[1], c)
+                    for i, c in enumerate(self.counts) if c]
+
+    def summary(self, scale: float = 1.0) -> Dict[str, float]:
+        return {"count": self.count,
+                "mean": self.mean() * scale,
+                "p50": self.percentile(50) * scale,
+                "p95": self.percentile(95) * scale,
+                "p99": self.percentile(99) * scale,
+                "min": (self.min or 0) * scale,
+                "max": self.max * scale}
+
+
+# ------------------------------------------------------------------ trackers
 
 class ThroughputTracker:
-    __slots__ = ("name", "count", "_t0")
+    __slots__ = ("name", "count", "_t0", "_win_count", "_win_t0")
 
     def __init__(self, name: str):
         self.name = name
         self.count = 0
         self._t0 = time.time()
+        self._win_count = 0
+        self._win_t0 = self._t0
 
     def event_in(self, n: int = 1):
         self.count += n
@@ -33,27 +154,58 @@ class ThroughputTracker:
         dt = time.time() - self._t0
         return self.count / dt if dt > 0 else 0.0
 
+    def windowed_rate(self) -> float:
+        """Rate since the previous ``windowed_rate`` call (the reporter
+        interval), falling back to the lifetime rate on the first read."""
+        now = time.time()
+        dt = now - self._win_t0
+        dn = self.count - self._win_count
+        self._win_t0, self._win_count = now, self.count
+        if dt <= 0:
+            return 0.0
+        return dn / dt
+
 
 class LatencyTracker:
-    __slots__ = ("name", "total_ns", "count", "_mark")
+    """Histogram-backed latency tracker.
+
+    Marks nest via a per-thread stack (``mark_in``/``mark_out`` pairs can
+    recurse — e.g. a query feeding another query on the same thread — and
+    concurrent queries on different threads never see each other's
+    marks).  A 0-ns duration is recorded, not dropped."""
+
+    __slots__ = ("name", "total_ns", "count", "hist", "_tls")
 
     def __init__(self, name: str):
         self.name = name
         self.total_ns = 0
         self.count = 0
-        self._mark = 0
+        self.hist = Histogram()
+        self._tls = threading.local()
 
     def mark_in(self):
-        self._mark = time.perf_counter_ns()
+        stack = getattr(self._tls, "marks", None)
+        if stack is None:
+            stack = self._tls.marks = []
+        stack.append(time.perf_counter_ns())
 
     def mark_out(self):
-        if self._mark:
-            self.total_ns += time.perf_counter_ns() - self._mark
-            self.count += 1
-            self._mark = 0
+        stack = getattr(self._tls, "marks", None)
+        if not stack:
+            return              # unmatched mark_out: ignore
+        dt = time.perf_counter_ns() - stack.pop()
+        self.total_ns += dt
+        self.count += 1
+        self.hist.record(dt)
 
     def avg_ms(self) -> float:
         return (self.total_ns / self.count) / 1e6 if self.count else 0.0
+
+    def percentiles_ms(self) -> Dict[str, float]:
+        return {"p50_ms": self.hist.percentile(50) / 1e6,
+                "p95_ms": self.hist.percentile(95) / 1e6,
+                "p99_ms": self.hist.percentile(99) / 1e6,
+                "max_ms": self.hist.max / 1e6}
 
 
 class MemoryTracker:
@@ -71,10 +223,88 @@ class MemoryTracker:
 
 
 class BufferedEventsTracker:
+    """Queue-depth gauge over registered suppliers — wired to @Async
+    junction queues (core/stream.py) so backpressure is visible before it
+    becomes an @OnError drop."""
+
     def __init__(self, name: str):
         self.name = name
-        self.buffered = 0
+        self._suppliers: List[Callable[[], int]] = []
 
+    def register(self, fn: Callable[[], int]):
+        self._suppliers.append(fn)
+
+    @property
+    def buffered(self) -> int:
+        total = 0
+        for f in self._suppliers:
+            try:
+                total += int(f())
+            except Exception:   # noqa: BLE001 — a dying junction reads as 0
+                pass
+        return total
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonic counter with label support: ``c.inc(3, stream='S')``."""
+
+    __slots__ = ("name", "_series", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._series: Dict[Tuple, int] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1, **labels):
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + n
+
+    def value(self, **labels) -> int:
+        return self._series.get(_label_key(labels), 0)
+
+    def series(self) -> Dict[Tuple, int]:
+        return dict(self._series)
+
+
+class Gauge:
+    """Point-in-time value with label support; a labelset can also be
+    bound to a supplier callable (read at snapshot time)."""
+
+    __slots__ = ("name", "_series", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._series: Dict[Tuple, Callable[[], float]] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels):
+        with self._lock:
+            self._series[_label_key(labels)] = lambda v=value: v
+
+    def set_fn(self, fn: Callable[[], float], **labels):
+        with self._lock:
+            self._series[_label_key(labels)] = fn
+
+    def value(self, **labels) -> float:
+        fn = self._series.get(_label_key(labels))
+        return float(fn()) if fn is not None else 0.0
+
+    def series(self) -> Dict[Tuple, float]:
+        out = {}
+        for key, fn in list(self._series.items()):
+            try:
+                out[key] = float(fn())
+            except Exception:   # noqa: BLE001 — supplier died with its owner
+                out[key] = 0.0
+        return out
+
+
+# ------------------------------------------------------------------ manager
 
 class StatisticsManager:
     """Registry + reporter.  Metric naming mirrors the reference:
@@ -90,9 +320,12 @@ class StatisticsManager:
         self.latency: Dict[str, LatencyTracker] = {}
         self.memory: Dict[str, MemoryTracker] = {}
         self.buffered: Dict[str, BufferedEventsTracker] = {}
+        self.counters: Dict[str, Counter] = {}
+        self.gauges: Dict[str, Gauge] = {}
         self.enabled = False
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._lifecycle_lock = threading.Lock()
 
     def _metric(self, kind: str, name: str) -> str:
         return f"io.siddhi.SiddhiApps.{self.app_name}.Siddhi.{kind}.{name}"
@@ -113,14 +346,82 @@ class StatisticsManager:
         key = self._metric(kind, name)
         return self.buffered.setdefault(key, BufferedEventsTracker(key))
 
+    def counter(self, kind: str, name: str) -> Counter:
+        key = self._metric(kind, name)
+        return self.counters.setdefault(key, Counter(key))
+
+    def gauge(self, kind: str, name: str) -> Gauge:
+        key = self._metric(kind, name)
+        return self.gauges.setdefault(key, Gauge(key))
+
     def snapshot(self) -> dict:
         return {
-            "throughput": {k: {"count": t.count, "rate_eps": t.rate()}
+            "throughput": {k: {"count": t.count, "rate_eps": t.rate(),
+                               "rate_windowed_eps": t.windowed_rate()}
                            for k, t in self.throughput.items()},
-            "latency_ms": {k: t.avg_ms() for k, t in self.latency.items()},
+            "latency_ms": {k: {"avg_ms": t.avg_ms(), "count": t.count,
+                               **t.percentiles_ms()}
+                           for k, t in self.latency.items()},
             "memory_bytes": {k: m.bytes() for k, m in self.memory.items()},
             "buffered": {k: b.buffered for k, b in self.buffered.items()},
+            "counters": {k: {"|".join("=".join(p) for p in key) or "_": v
+                             for key, v in c.series().items()}
+                         for k, c in self.counters.items()},
+            "gauges": {k: {"|".join("=".join(p) for p in key) or "_": v
+                           for key, v in g.series().items()}
+                       for k, g in self.gauges.items()},
         }
+
+    # -------------------------------------------------------- prometheus
+
+    def _parse_key(self, key: str) -> Dict[str, str]:
+        """io.siddhi.SiddhiApps.<app>.Siddhi.<kind>.<name> → labels."""
+        prefix = "io.siddhi.SiddhiApps."
+        rest = key[len(prefix):] if key.startswith(prefix) else key
+        app, sep, tail = rest.partition(".Siddhi.")
+        if not sep:
+            return {"app": self.app_name, "kind": "", "name": rest}
+        kind, _, name = tail.partition(".")
+        return {"app": app, "kind": kind, "name": name}
+
+    def prometheus_lines(self) -> List[str]:
+        lines: List[str] = []
+        for key, t in self.throughput.items():
+            lb = _fmt_labels(self._parse_key(key))
+            lines.append(f"siddhi_throughput_events_total{lb} {t.count}")
+            lines.append(
+                f"siddhi_throughput_events_per_second{lb} {t.rate():.6g}")
+        for key, t in self.latency.items():
+            lb_map = self._parse_key(key)
+            lb = _fmt_labels(lb_map)
+            cum = 0
+            for hi_ns, c in t.hist.buckets():
+                cum += c
+                le = hi_ns / 1e9
+                lines.append("siddhi_latency_seconds_bucket"
+                             f"{_fmt_labels(lb_map, le=f'{le:.9g}')} {cum}")
+            lines.append("siddhi_latency_seconds_bucket"
+                         f"{_fmt_labels(lb_map, le='+Inf')} {t.hist.count}")
+            lines.append(
+                f"siddhi_latency_seconds_sum{lb} {t.total_ns / 1e9:.9g}")
+            lines.append(f"siddhi_latency_seconds_count{lb} {t.hist.count}")
+        for key, m in self.memory.items():
+            lb = _fmt_labels(self._parse_key(key))
+            lines.append(f"siddhi_memory_bytes{lb} {m.bytes()}")
+        for key, b in self.buffered.items():
+            lb = _fmt_labels(self._parse_key(key))
+            lines.append(f"siddhi_buffered_events{lb} {b.buffered}")
+        for key, c in self.counters.items():
+            base = self._parse_key(key)
+            for lkey, v in c.series().items():
+                lb = _fmt_labels({**base, **dict(lkey)})
+                lines.append(f"siddhi_counter_total{lb} {v}")
+        for key, g in self.gauges.items():
+            base = self._parse_key(key)
+            for lkey, v in g.series().items():
+                lb = _fmt_labels({**base, **dict(lkey)})
+                lines.append(f"siddhi_gauge{lb} {v:.9g}")
+        return lines
 
     # ------------------------------------------------------------ lifecycle
 
@@ -128,19 +429,87 @@ class StatisticsManager:
         self.enabled = True
         if self.reporter not in ("console", "json") or self.interval_s <= 0:
             return
-        if self._thread is not None:
-            return
-        self._stop.clear()
+        with self._lifecycle_lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop.clear()
 
-        def loop():
-            while not self._stop.wait(self.interval_s):
-                if self.enabled:
-                    print(json.dumps({"siddhi_stats": self.snapshot()}),
-                          file=sys.stderr)
-        self._thread = threading.Thread(target=loop, daemon=True)
-        self._thread.start()
+            def loop():
+                while not self._stop.wait(self.interval_s):
+                    if self.enabled:
+                        print(json.dumps({"siddhi_stats": self.snapshot()}),
+                              file=sys.stderr)
+            self._thread = threading.Thread(target=loop, daemon=True)
+            self._thread.start()
 
     def stop_reporting(self):
         self.enabled = False
-        self._stop.set()
-        self._thread = None
+        with self._lifecycle_lock:
+            self._stop.set()
+            t = self._thread
+            if t is not None:
+                # join, don't abandon: the old `_thread = None` without a
+                # join let a racing start_reporting spawn a second
+                # reporter while the first still printed
+                t.join(timeout=5.0)
+                self._thread = None
+
+
+# ------------------------------------------------------------------ exposition
+
+def _fmt_labels(labels: Dict[str, str], **extra) -> str:
+    merged = {**labels, **extra}
+    merged = {k: v for k, v in merged.items() if v != ""}
+    if not merged:
+        return ""
+    body = ",".join(
+        f'{k}="{_escape(str(v))}"' for k, v in sorted(merged.items()))
+    return "{" + body + "}"
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+_TYPES = [
+    ("siddhi_throughput_events_total",
+     "counter", "Events entering a stream junction"),
+    ("siddhi_throughput_events_per_second",
+     "gauge", "Lifetime event rate of a stream junction"),
+    ("siddhi_latency_seconds",
+     "histogram", "Per-query processing latency"),
+    ("siddhi_memory_bytes", "gauge", "State-holder buffer footprint"),
+    ("siddhi_buffered_events",
+     "gauge", "Queued events in @Async junction buffers"),
+    ("siddhi_counter_total", "counter", "App-defined counters"),
+    ("siddhi_gauge", "gauge", "App-defined gauges"),
+    ("siddhi_kernel_calls_total",
+     "counter", "Device kernel invocations"),
+    ("siddhi_kernel_compile_count",
+     "gauge", "XLA compiles (incl. retraces) of a kernel"),
+    ("siddhi_kernel_device_time_seconds_total",
+     "gauge", "Blocked device time per kernel (profiling mode)"),
+    ("siddhi_kernel_dispatch_time_seconds_total",
+     "gauge", "Host-side dispatch time per kernel"),
+    ("siddhi_kernel_h2d_bytes_total",
+     "counter", "Host->device bytes fed to a kernel"),
+    ("siddhi_kernel_d2h_bytes_total",
+     "counter", "Device->host bytes retired from a kernel"),
+    ("siddhi_kernel_batch_events_total",
+     "counter", "Events carried through a kernel"),
+]
+
+
+def prometheus_text(managers: List[StatisticsManager],
+                    kernel_profiler=None) -> str:
+    """Full Prometheus/OpenMetrics text exposition over any number of app
+    StatisticsManagers plus the (process-global) kernel profiler."""
+    lines: List[str] = []
+    for name, typ, help_ in _TYPES:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {typ}")
+    for sm in managers:
+        lines.extend(sm.prometheus_lines())
+    if kernel_profiler is not None:
+        lines.extend(kernel_profiler.prometheus_lines())
+    return "\n".join(lines) + "\n"

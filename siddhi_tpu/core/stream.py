@@ -25,6 +25,7 @@ from ..query_api.definition import StreamDefinition
 from ..utils.errors import SiddhiAppRuntimeException
 from .context import SiddhiAppContext
 from .event import CURRENT, EXPIRED, Event, EventChunk
+from .tracing import tracer as _tracer
 
 log = logging.getLogger(__name__)
 
@@ -132,6 +133,12 @@ class StreamJunction:
         if not self.is_async or q is None:
             return True
         return q.unfinished_tasks == 0
+
+    def queue_depth(self) -> int:
+        """Chunks waiting in the @Async buffer right now — the
+        BufferedEventsTracker supplier (core/statistics.py)."""
+        q = self._queue
+        return q.qsize() if q is not None else 0
 
     def _configure_from_annotations(self):
         ann = find_annotation(self.definition.annotations, "async")
@@ -291,9 +298,18 @@ class StreamJunction:
             self._deliver(chunk)
 
     def _deliver(self, chunk: EventChunk):
+        tr = _tracer()
         for r in list(self.receivers):
             try:
-                r.receive_chunk(chunk)
+                if tr.enabled:
+                    with tr.span("callback" if isinstance(
+                            r, (StreamCallback, QueryCallback))
+                            else "deliver",
+                            stream=self.definition.id, n=len(chunk),
+                            receiver=type(r).__name__):
+                        r.receive_chunk(chunk)
+                else:
+                    r.receive_chunk(chunk)
             except Exception as e:  # noqa: BLE001 — @OnError boundary
                 self._handle_error(chunk, e)
 
@@ -352,7 +368,9 @@ class InputHandler:
         for ts in stamps:
             self.app_ctx.timestamp_generator.observe_event_time(ts)
         chunk = EventChunk.from_rows(self.definition, rows, stamps)
-        self.junction.send(chunk)
+        with _tracer().span("ingest.chunk", stream=self.definition.id,
+                            n=len(rows)):
+            self.junction.send(chunk)
         if self.app_ctx.timestamp_generator.in_playback:
             self.app_ctx.scheduler.advance_to(max(stamps))
 
@@ -368,6 +386,7 @@ class InputHandler:
             self.app_ctx.timestamp_generator.observe_event_time(
                 int(ts_arr.max()))
         chunk = EventChunk.from_columns(names, ts_arr, dict(columns))
-        self.junction.send(chunk)
+        with _tracer().span("ingest.chunk", stream=self.definition.id, n=n):
+            self.junction.send(chunk)
         if self.app_ctx.timestamp_generator.in_playback and len(ts_arr) > 0:
             self.app_ctx.scheduler.advance_to(int(ts_arr.max()))

@@ -171,3 +171,23 @@ class MultiHostAppRuntime:
         return {n: int(digits[j, 0]) + int(digits[j, 1]) * D +
                 int(digits[j, 2]) * D * D
                 for j, n in enumerate(names)}
+
+    def global_statistics(self) -> Dict[str, int]:
+        """Cluster-wide engine statistics: every host's StatisticsManager
+        counters (junction throughput counts, query latency event counts,
+        @Async queue depths) summed over the SAME fused DCN all-reduce
+        ``global_stats`` uses — COLLECTIVE, so every process must call it
+        at the same point.  Keys keep the reference metric naming; with
+        one process this degrades to the local snapshot's counters."""
+        sm = self.runtime.app_ctx.statistics_manager
+        counters: Dict[str, int] = {}
+        if sm is not None:
+            for k, t in sm.throughput.items():
+                counters[k + ".count"] = t.count
+            for k, t in sm.latency.items():
+                counters[k + ".count"] = t.count
+            for k, b in sm.buffered.items():
+                counters[k + ".buffered"] = b.buffered
+        if not counters or self.nproc <= 1:
+            return counters
+        return self.global_stats(**counters)

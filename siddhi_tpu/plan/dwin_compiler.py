@@ -237,7 +237,10 @@ class DeviceWindowProcessor(WindowProcessor):
         key = (self.capacity, T)
         fn = self._steps.get(key)
         if fn is None:
-            fn = jax.jit(build_dwin_step(self._spec()), static_argnums=7)
+            from ..core.profiling import wrap_kernel
+            fn = wrap_kernel(
+                f"dwin.{self.kind}.step",
+                jax.jit(build_dwin_step(self._spec()), static_argnums=7))
             self._steps[key] = fn
         return fn
 

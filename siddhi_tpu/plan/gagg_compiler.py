@@ -275,12 +275,15 @@ class CompiledGroupedAgg:
     # ------------------------------------------------------------ shapes
 
     def _build_step(self):
+        from ..core.profiling import wrap_kernel
         if self.window_kind == "time":
-            self._step = jax.jit(build_grouped_time_step(
-                self.window_ms, self.window, self.want_forever))
+            self._step = wrap_kernel("gagg.time.step", jax.jit(
+                build_grouped_time_step(
+                    self.window_ms, self.window, self.want_forever)))
         else:
-            self._step = jax.jit(build_grouped_step(
-                self.window, self.want_minmax, self.want_forever))
+            self._step = wrap_kernel("gagg.step", jax.jit(
+                build_grouped_step(
+                    self.window, self.want_minmax, self.want_forever)))
 
     def _make_carry(self, n_lanes: int, n_groups: Optional[int] = None):
         g = self.n_groups if n_groups is None else n_groups

@@ -1174,14 +1174,22 @@ class CompiledPatternNFA:
         return self.mesh is None or bool(self.spec.mid_every)
 
     def _jit_step(self):
+        from ..core.profiling import wrap_kernel
+        batch_of = (lambda carry, block:
+                    int(block["__ts"].size) if "__ts" in block else 0)
         if self.mesh is None:
             # no donation: the engine path replays a chunk from the
             # pre-chunk carry after a slot overflow (grow-and-replay), so
             # the input carry must survive the step
-            return jax.jit(build_block_step(self.spec))
+            return wrap_kernel("nfa.step",
+                               jax.jit(build_block_step(self.spec)),
+                               batch_of=batch_of)
         from ..parallel.mesh import jit_engine_step
-        return jit_engine_step(self.spec, self.mesh,
-                               donate=not self.spec.mid_every)
+        return wrap_kernel(
+            "nfa.mesh_step",
+            jit_engine_step(self.spec, self.mesh,
+                            donate=not self.spec.mid_every),
+            batch_of=batch_of)
 
     def grow(self, n_partitions: int) -> None:
         """Widen the partition axis (slab growth for keyed partitioning);
@@ -1343,7 +1351,9 @@ class CompiledPatternNFA:
             return jnp.concatenate([rows, tail], axis=0)
 
         if not hasattr(self, "_egress_jit"):
-            self._egress_jit = jax.jit(pack, static_argnums=8)
+            from ..core.profiling import wrap_kernel
+            self._egress_jit = wrap_kernel(
+                "nfa.egress_pack", jax.jit(pack, static_argnums=8))
         dropped = self.carry["dropped"]
         dl_st = self.carry["slot_state"] if self.has_absent else None
         dl = self.carry.get("deadline") if self.has_absent else None
@@ -1363,6 +1373,8 @@ class CompiledPatternNFA:
         sets self.last_dropped_total (drives grow-and-replay without an
         extra sync)."""
         buf = np.asarray(handle["buf"])
+        from ..core.profiling import profiler
+        profiler().record_d2h("nfa.egress_pack", buf.nbytes)
         count = int(buf[-1, 0])
         self.last_dropped_total = int(buf[-1, 1])
         while count > handle["cap"]:
@@ -1746,8 +1758,13 @@ class CompiledPatternBank:
         self.carries = [make_bank_carry(self.nfa.spec, self.chunk,
                                         n_partitions)
                         for _ in range(self.n_chunks)]
-        self._step = jax.jit(build_bank_step(self.nfa.spec, ring=self.ring),
-                             donate_argnums=0)
+        from ..core.profiling import wrap_kernel
+        self._step = wrap_kernel(
+            "nfa.bank_step",
+            jax.jit(build_bank_step(self.nfa.spec, ring=self.ring),
+                    donate_argnums=0),
+            batch_of=lambda carry, block, params:
+                int(block["__ts"].size) if "__ts" in block else 0)
         self.base_ts: Optional[int] = None
 
     def _default_chunk(self, n_partitions: int, n_slots: int) -> int:

@@ -343,10 +343,12 @@ class DevicePatternRuntime:
 
     def _emit_columns(self, pids, ts, cols) -> None:
         from ..core.event import EventChunk
+        from ..core.tracing import trace_span
         if not len(ts):
             return
         names = [o[0] for o in self.nfa.select_outputs]
-        self.head.process(EventChunk.from_columns(names, ts, cols))
+        with trace_span("match.scatter", n=int(len(ts))):
+            self.head.process(EventChunk.from_columns(names, ts, cols))
 
     def _emit(self, matches) -> None:
         from ..core.event import EventChunk
@@ -1021,7 +1023,10 @@ class DeviceFilterRuntime(PipelinedDeviceIngest):
                     for ce in dev_exprs]
             return ok, outs
 
-        self._program = jax.jit(program)
+        from ..core.profiling import wrap_kernel
+        self._program = wrap_kernel(
+            "filter.program", jax.jit(program),
+            batch_of=lambda cols, ts, valid: int(ts.shape[0]))
 
         # trace now so incompatibilities reject at plan time
         try:
@@ -1084,8 +1089,13 @@ class DeviceFilterRuntime(PipelinedDeviceIngest):
 
     def _retire(self, work) -> None:
         from ..core.event import TIMER, RESET, EventChunk
+        from ..core.profiling import profiler
         chunk, n, outs = work["chunk"], work["n"], work["outs"]
         ok = np.asarray(work["ok"])[:n]
+        prof = profiler()
+        if prof.enabled:
+            prof.record_d2h("filter.program", ok.nbytes + sum(
+                getattr(o, "nbytes", 0) for o in outs))
         # TIMER/RESET rows always pass (host FilterProcessor parity)
         ok = ok | (chunk.types == TIMER) | (chunk.types == RESET)
         if not ok.any():
@@ -1111,7 +1121,9 @@ class DeviceFilterRuntime(PipelinedDeviceIngest):
             [o[0] for o in self.outputs],
             np.asarray(chunk.timestamps)[ok], out_cols,
             types=chunk.types[ok])
-        self.head.process(out)
+        from ..core.tracing import trace_span
+        with trace_span("match.scatter", n=len(out)):
+            self.head.process(out)
 
     # ------------------------------------------------------------ lifecycle
 

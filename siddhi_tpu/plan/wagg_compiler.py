@@ -184,7 +184,11 @@ class CompiledWindowedAgg:
         # no donation on the time path: overflow replay re-steps the block
         # from the PREVIOUS carry, which donation would have invalidated
         donate = (0,) if self.window_kind == "length" else ()
-        self._step = jax.jit(full_step, donate_argnums=donate)
+        from ..core.profiling import wrap_kernel
+        self._step = wrap_kernel(
+            f"wagg.{self.window_kind}.step",
+            jax.jit(full_step, donate_argnums=donate),
+            batch_of=lambda carry, block: int(block["__ts"].size))
 
     def _make_carry(self, n: int):
         return (make_wagg_carry(n, self.window)

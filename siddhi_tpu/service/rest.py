@@ -7,6 +7,12 @@ SiddhiApi.java:31-62, SiddhiApiServiceImpl.java:42.)
 Extras beyond the reference surface (operationally useful for a TPU-backed
 deployment): list apps, push events into a stream, run store queries, and
 snapshot/restore — all JSON over stdlib http.server (zero dependencies).
+
+Observability surface (this PR): ``GET /metrics`` serves the
+Prometheus/OpenMetrics text exposition over every deployed app's
+StatisticsManager plus the process-global kernel profiler
+(core/statistics.prometheus_text); ``GET /stats`` serves the same data
+as JSON.  Both are scrape-ready on the zero-dependency server.
 """
 from __future__ import annotations
 
@@ -126,4 +132,31 @@ class SiddhiService:
             return h._send(200, {"apps": sorted(self.manager.runtimes)})
         if parts == ["health"]:
             return h._send(200, {"status": "up"})
+        if parts == ["metrics"]:
+            return self._send_metrics(h)
+        if parts == ["stats"]:
+            return h._send(200, self._stats_json())
         h._send(404, {"error": f"no route {h.path}"})
+
+    # ------------------------------------------------------------ metrics
+
+    def _send_metrics(self, h):
+        from ..core.profiling import profiler
+        from ..core.statistics import prometheus_text
+        managers = [rt.app_ctx.statistics_manager
+                    for rt in self.manager.runtimes.values()
+                    if rt.app_ctx.statistics_manager is not None]
+        body = prometheus_text(managers, profiler()).encode()
+        h.send_response(200)
+        h.send_header("Content-Type",
+                      "text/plain; version=0.0.4; charset=utf-8")
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)
+
+    def _stats_json(self) -> dict:
+        from ..core.profiling import profiler
+        return {"apps": {name: rt.app_ctx.statistics_manager.snapshot()
+                         for name, rt in self.manager.runtimes.items()
+                         if rt.app_ctx.statistics_manager is not None},
+                "kernels": profiler().snapshot()}
