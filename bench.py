@@ -654,6 +654,20 @@ def bench_oracle():
     return n / elapsed
 
 
+def retrace_count(*profiles) -> int:
+    """Total RE-compilations across kernel-profile snapshots: each
+    kernel's first compile is expected, every compile after it is a
+    retrace.  Input: dicts as emitted by KernelProfiler.snapshot() /
+    the per-phase `kernel_profile` blobs (None entries are skipped)."""
+    total = 0
+    for prof in profiles:
+        if not prof:
+            continue
+        for st in prof.values():
+            total += max(0, int(st.get("compile_count", 0)) - 1)
+    return total
+
+
 def _kernel_profile_summary() -> dict:
     """Per-kernel profile of THIS phase process (calls, compiles,
     dispatch-time fractions, bytes moved) — recorded next to the
@@ -694,6 +708,14 @@ def _run_phase(phase: str) -> dict:
 
 
 def main():
+    # --fail-on-retrace N: exit non-zero when the measured phases
+    # re-JIT'd their kernels more than N times total (first compiles
+    # excluded) — a mechanical recompilation-regression gate for BENCH
+    # rounds, driven by the KernelProfiler compile counters
+    fail_on_retrace = None
+    if "--fail-on-retrace" in sys.argv:
+        fail_on_retrace = int(
+            sys.argv[sys.argv.index("--fail-on-retrace") + 1])
     if "--phase" in sys.argv:
         phase = sys.argv[sys.argv.index("--phase") + 1]
         if phase == "gate":
@@ -729,6 +751,9 @@ def main():
     # compute-side anchor: the steady-state pipelined per-block time
     compute_side = N_PARTITIONS * T_PER_BLOCK / \
         (thru["pipelined_block_ms"] / 1000)
+    retraces = retrace_count(
+        thru.get("kernel_profile"), eng.get("kernel_profile"),
+        eng_wagg.get("kernel_profile"), eng_absent.get("kernel_profile"))
     print(json.dumps({
         "metric": (f"pattern-match throughput ({N_PATTERNS} NFAs x "
                    f"{N_PARTITIONS} partitions, every A->B within, "
@@ -799,7 +824,15 @@ def main():
         # "why" next to the "what" for BENCH round diffs
         "kernel_profile_thru": thru.get("kernel_profile"),
         "kernel_profile_engine": eng.get("kernel_profile"),
+        "retrace_total": retraces,
     }))
+    if fail_on_retrace is not None and retraces > fail_on_retrace:
+        sys.stderr.write(
+            f"[bench] FAIL: {retraces} kernel retraces across measured "
+            f"phases exceeds --fail-on-retrace {fail_on_retrace} — a "
+            f"recompilation regression (see kernel_profile_* "
+            f"compile_count for the guilty kernel)\n")
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -16,6 +16,7 @@ def main(n_patterns=100, n_partitions=1000):
         define stream S (partition int, price float, kind int);
         @info(name='q')
         from every e1=S[kind == 0 and price > {thr}] -> e2=S[kind == 1 and price > e1.price]
+            within 40 sec
         select e1.price as p1, e2.price as p2 insert into Out;
     """ for thr in np.linspace(5, 95, n_patterns)]
     bank = CompiledPatternBank(apps, n_partitions=n_partitions, n_slots=8,

@@ -24,6 +24,7 @@ Public API mirrors the reference's entry points:
 
 __version__ = "0.1.0"
 
+from .analysis import AnalysisResult, Diagnostic, analyze
 from .compiler import SiddhiCompiler
 from .core.event import Event, EventChunk
 from .core.profiling import (KernelProfiler, disable_profiling,
@@ -48,4 +49,5 @@ __all__ = [
     "StatisticsManager", "prometheus_text",
     "KernelProfiler", "profiler", "enable_profiling", "disable_profiling",
     "Tracer", "tracer", "enable_tracing", "disable_tracing",
+    "analyze", "AnalysisResult", "Diagnostic",
 ]

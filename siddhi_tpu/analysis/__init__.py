@@ -1,0 +1,19 @@
+"""siddhi_tpu.analysis — compile-time semantic analysis for SiddhiQL apps.
+
+Public surface:
+
+    from siddhi_tpu.analysis import analyze, AnalysisResult, Diagnostic
+
+    result = analyze(app_text)          # or a query_api SiddhiApp
+    for d in result.diagnostics:
+        print(d.render("app.siddhi"))
+    result.raise_if(strict=True)        # warnings promote to errors
+
+CLI: ``python -m siddhi_tpu.analyze app.siddhi [--json] [--strict]``.
+Diagnostic catalog: docs/analysis.md (generated from diagnostics.CATALOG).
+"""
+from .analyzer import AnalysisResult, analyze
+from .diagnostics import CATALOG, CatalogEntry, Diagnostic, Severity
+
+__all__ = ["analyze", "AnalysisResult", "Diagnostic", "Severity",
+           "CATALOG", "CatalogEntry"]

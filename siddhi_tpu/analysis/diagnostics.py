@@ -1,0 +1,241 @@
+"""Typed diagnostics for the compile-time semantic analyzer.
+
+Every finding the analyzer (siddhi_tpu/analysis/analyzer.py) emits is a
+:class:`Diagnostic` with a *stable* code.  Codes are API: tests, CI
+gates, expected-warning allowlists and user suppression all key on them,
+so a code's meaning never changes — retired codes are never reused.
+
+Families:
+  ``SA0xx`` — semantic / type errors and warnings (name resolution,
+              expression typing, schema compatibility)
+  ``SA02x`` — unbounded-state findings
+  ``SA03x`` — partition-safety findings
+  ``SA04x`` — dead-code findings
+  ``SP0xx`` — TPU performance hazards (retrace storms, host fallbacks,
+              float32 precision loss)
+
+The full catalog with meanings and fixes is rendered in
+``docs/analysis.md``; :data:`CATALOG` is its single source of truth.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, List, Optional
+
+from ..query_api.position import SourcePos
+
+
+class Severity(Enum):
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+    @property
+    def rank(self) -> int:
+        return {"error": 0, "warning": 1, "info": 2}[self.value]
+
+
+@dataclass(frozen=True)
+class CatalogEntry:
+    code: str
+    severity: Severity
+    title: str          # short kebab-ish label
+    meaning: str        # what the finding tells the user
+    fix: str            # how to make it go away
+
+
+# -------------------------------------------------------------- the catalog
+
+_C = CatalogEntry
+_E, _W, _I = Severity.ERROR, Severity.WARNING, Severity.INFO
+
+CATALOG: Dict[str, CatalogEntry] = {e.code: e for e in [
+    _C("SA000", _E, "parse-error",
+       "The app text failed to parse; nothing beyond this point was "
+       "analyzed.",
+       "Fix the syntax error at the reported position."),
+    _C("SA001", _E, "unknown-source",
+       "A query reads from (or writes a table operation against) a stream, "
+       "table, window or aggregation that is defined nowhere in the app "
+       "and produced by no other query.",
+       "Define the source, or fix the misspelled identifier."),
+    _C("SA002", _E, "unknown-attribute",
+       "An expression references an attribute that does not exist on any "
+       "stream in scope — at runtime this fails only when the query first "
+       "compiles or (worse) executes.",
+       "Fix the attribute name; check the stream definition it should "
+       "come from."),
+    _C("SA003", _E, "ambiguous-attribute",
+       "An unqualified attribute name matches more than one stream in "
+       "scope (e.g. both sides of a join).",
+       "Qualify the reference with the stream id or alias "
+       "(`s.price`)."),
+    _C("SA004", _E, "type-mismatch",
+       "An operator is applied to operand types it does not support: "
+       "arithmetic on strings/bools, ordering comparison between a number "
+       "and a string, logical and/or over non-boolean operands, or a "
+       "function argument of the wrong type.",
+       "Cast explicitly with convert(value, 'type') or fix the operand."),
+    _C("SA005", _E, "non-boolean-condition",
+       "A filter `[...]`, `having`, or join `on` expression does not "
+       "evaluate to bool — the runtime would coerce or crash per batch.",
+       "Make the condition a comparison/logical expression."),
+    _C("SA006", _W, "lossy-promotion",
+       "An int/long attribute is implicitly promoted to float in an "
+       "expression.  Device lanes are float32: integers above 2^24 stop "
+       "being exact, so equality and ordering can silently diverge from "
+       "the host path.",
+       "Use convert(x, 'double') explicitly, or keep both operands "
+       "integer-typed."),
+    _C("SA007", _W, "unknown-function",
+       "A function call matches no builtin, aggregator, script function "
+       "or statically known namespace.  It may resolve through an "
+       "extension registered at runtime — or fail at app creation.",
+       "Check the spelling/namespace, or register the extension before "
+       "creating the runtime."),
+    _C("SA008", _E, "insert-schema-mismatch",
+       "A query inserts into an explicitly defined stream/table whose "
+       "schema does not match the select clause (arity or incompatible "
+       "attribute types).",
+       "Align the select clause with the target definition."),
+    # ---- unbounded state ------------------------------------------------
+    _C("SA020", _W, "unbounded-pattern-state",
+       "An `every` pattern has no `within` bound: every arming event "
+       "keeps a partial match alive forever, so pattern state grows "
+       "without bound on an infinite stream.",
+       "Add `within <time>` to the pattern (or an `every (...) within` "
+       "group bound)."),
+    _C("SA021", _W, "unbounded-table-growth",
+       "A query continuously inserts into a table that has no "
+       "@PrimaryKey: rows are appended per event and never overwritten "
+       "or evicted, so the table grows with the stream.",
+       "Add @PrimaryKey('key') so writes upsert, or use update or "
+       "insert / delete maintenance."),
+    _C("SA022", _W, "unbounded-group-state",
+       "A windowless aggregation with group-by keeps one running "
+       "aggregate per distinct key forever.  With an unbounded key "
+       "domain this is a slow memory leak.",
+       "Add a #window handler to bound state, or group by a key with a "
+       "bounded domain."),
+    # ---- partition safety ----------------------------------------------
+    _C("SA030", _W, "partition-shared-table-write",
+       "A query inside a `partition` block writes to a table shared by "
+       "all partition instances.  Every key's runtime mutates the same "
+       "rows, so writes race and reads see cross-partition data.",
+       "Include the partition key in the table's @PrimaryKey and write "
+       "conditions, or move the write outside the partition."),
+    _C("SA031", _W, "partition-shared-window-write",
+       "A query inside a `partition` block inserts into a named window "
+       "shared across partition instances — contents mix events from "
+       "every key.",
+       "Use an #InnerStream plus a per-query window, or partition-key-"
+       "scope the window contents explicitly."),
+    # ---- dead code ------------------------------------------------------
+    _C("SA040", _I, "unused-stream",
+       "A defined stream is never read by any query, never written to, "
+       "and carries no @source/@sink — it is dead weight in the app.",
+       "Delete the definition or wire a query/source to it."),
+    _C("SA041", _I, "unused-attribute",
+       "A stream attribute is never referenced by any query (and the "
+       "stream is never forwarded whole via `select *` or a positional "
+       "insert).  It still costs a column in every batch.",
+       "Drop the attribute from the definition, or project it where "
+       "intended."),
+    # ---- TPU performance hazards ---------------------------------------
+    _C("SP001", _W, "retrace-slot-growth",
+       "A device-eligible `every` pattern without `within` will grow its "
+       "slot ring as partials accumulate; every doubling rebuilds and "
+       "re-JITs the NFA step kernel — an unbounded recompilation storm "
+       "the KernelProfiler surfaces as a rising compile_count.",
+       "Add `within <time>` so live partials are bounded and the ring "
+       "never grows."),
+    _C("SP002", _I, "retrace-lane-growth",
+       "A partitioned device query maps partition keys to device lanes "
+       "that start at 8 and double on demand; each doubling retraces the "
+       "kernels.  Bounded (log2 of key cardinality) but visible as "
+       "compile_count churn while the key population ramps.",
+       "Expected behavior; pre-warm with representative keys if the "
+       "ramp-time latency matters."),
+    _C("SP003", _W, "dynamic-window-param",
+       "A window handler parameter is not a constant — the window shape "
+       "would depend on runtime data, which the planner cannot compile "
+       "to a fixed device ring (and the host path evaluates once, not "
+       "per event).",
+       "Use a literal window size/duration."),
+    _C("SP010", _W, "host-fallback",
+       "This query uses a construct the device NFA/aggregation compilers "
+       "reject, so the planner will pin it to the single-threaded host "
+       "oracle.  Correct, but orders of magnitude slower than the device "
+       "path.",
+       "See the message for the construct; restructure the query if "
+       "device residency matters."),
+    _C("SP011", _W, "int-precision-f32",
+       "A pattern filter compares an int/long attribute against values "
+       "above 2^24.  Device capture lanes are float32, so the compare "
+       "rides an exact-integer companion lane or falls back to host — "
+       "either way extra cost the query shape opted into silently.",
+       "Keep compared integers under 2^24, or use double attributes."),
+]}
+
+
+@dataclass
+class Diagnostic:
+    """One analyzer finding, anchored to a source position when the parse
+    carried one (fluent-API apps have no text, hence no spans)."""
+    code: str
+    message: str
+    severity: Severity = None  # default: catalog severity
+    pos: Optional[SourcePos] = None
+    query: Optional[str] = None      # query/partition context name
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.severity is None:
+            self.severity = CATALOG[self.code].severity
+
+    @property
+    def line(self) -> int:
+        return self.pos.line if self.pos else -1
+
+    @property
+    def col(self) -> int:
+        return self.pos.col if self.pos else -1
+
+    def as_dict(self) -> Dict[str, Any]:
+        d = {"code": self.code,
+             "severity": self.severity.value,
+             "title": CATALOG[self.code].title,
+             "message": self.message,
+             "line": self.line,
+             "col": self.col}
+        if self.query:
+            d["query"] = self.query
+        if self.extra:
+            d["extra"] = self.extra
+        return d
+
+    def render(self, filename: str = "<app>") -> str:
+        loc = (f"{filename}:{self.line}:{self.col}" if self.pos
+               else filename)
+        ctx = f" [{self.query}]" if self.query else ""
+        return (f"{loc}: {self.severity.value} {self.code} "
+                f"({CATALOG[self.code].title}): {self.message}{ctx}")
+
+
+class DiagnosticSink:
+    """Collector passed through the passes; dedupes exact repeats."""
+
+    def __init__(self):
+        self.diagnostics: List[Diagnostic] = []
+        self._seen = set()
+
+    def emit(self, code: str, message: str, pos: Optional[SourcePos] = None,
+             query: Optional[str] = None, **extra) -> None:
+        key = (code, message, pos.line if pos else -1,
+               pos.col if pos else -1, query)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.diagnostics.append(
+            Diagnostic(code, message, pos=pos, query=query, extra=extra))

@@ -1,0 +1,83 @@
+"""``python -m siddhi_tpu.analyze`` — compile-time analysis CLI.
+
+Usage:
+    python -m siddhi_tpu.analyze app.siddhi            # pretty output
+    python -m siddhi_tpu.analyze app.siddhi --json     # machine-readable
+    python -m siddhi_tpu.analyze app.siddhi --strict   # warnings = errors
+    python -m siddhi_tpu.analyze - < app.siddhi        # read stdin
+    python -m siddhi_tpu.analyze --catalog             # list every code
+
+Exit codes: 0 clean (infos allowed), 1 errors (or warnings under
+--strict), 2 usage error.  The analyzer itself imports no jax — this
+command runs fine on a machine with no accelerator stack.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _print_catalog() -> None:
+    from .analysis import CATALOG
+    for code in sorted(CATALOG):
+        e = CATALOG[code]
+        print(f"{code}  {e.severity.value:<7}  {e.title}")
+        print(f"       {e.meaning}")
+        print(f"       fix: {e.fix}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m siddhi_tpu.analyze",
+        description="Static semantic analysis for SiddhiQL apps: type "
+                    "checking, unbounded-state, retrace-hazard, "
+                    "partition-safety and host-fallback diagnostics.")
+    ap.add_argument("app", nargs="?",
+                    help="path to a .siddhi app file, or '-' for stdin")
+    ap.add_argument("--json", action="store_true",
+                    help="emit diagnostics as a JSON array")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero on warnings too")
+    ap.add_argument("--engine", choices=("auto", "device", "host"),
+                    help="override the engine mode assumed by the SP0xx "
+                         "performance passes")
+    ap.add_argument("--catalog", action="store_true",
+                    help="print the diagnostic catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.catalog:
+        _print_catalog()
+        return 0
+    if not args.app:
+        ap.print_usage(sys.stderr)
+        return 2
+    if args.app == "-":
+        text = sys.stdin.read()
+        name = "<stdin>"
+    else:
+        try:
+            with open(args.app) as f:
+                text = f.read()
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        name = args.app
+
+    from .analysis import analyze
+    result = analyze(text, engine=args.engine)
+
+    if args.json:
+        print(json.dumps({"app": result.app_name,
+                          "ok": result.ok,
+                          "diagnostics": result.as_dicts()}, indent=1))
+    else:
+        print(result.render(name))
+
+    if result.errors or (args.strict and result.warnings):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

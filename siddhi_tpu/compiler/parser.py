@@ -30,6 +30,7 @@ from ..query_api import (AbsentStreamStateElement, AggregationDefinition,
                          Variable, WindowDefinition, WindowHandler)
 from ..query_api.expression import (LAST_INDEX, And, AttributeFunction, Compare,
                                     In, IsNull, MathExpr, Not, Or)
+from ..query_api.position import pos_from_token, set_pos
 from ..utils.errors import SiddhiParserException
 from .tokenizer import Token, tokenize
 
@@ -102,6 +103,10 @@ class Parser:
             self.next()
             return True
         return False
+
+    def mark(self):
+        """Source position of the NEXT token — attach with set_pos()."""
+        return pos_from_token(self.peek())
 
     # ------------------------------------------------- app
 
@@ -178,18 +183,22 @@ class Parser:
     # ------------------------------------------------- definitions
 
     def parse_definition(self, app: SiddhiApp, anns: List[Annotation]):
+        def_pos = self.mark()
         self.eat_kw("define")
         kind = self.eat_id().text.lower()
         if kind == "stream":
             d = StreamDefinition(self.eat_id().text, annotations=anns)
+            set_pos(d, def_pos)
             self._parse_attr_list(d)
             app.define_stream(d)
         elif kind == "table":
             d = TableDefinition(self.eat_id().text, annotations=anns)
+            set_pos(d, def_pos)
             self._parse_attr_list(d)
             app.define_table(d)
         elif kind == "window":
             d = WindowDefinition(self.eat_id().text, annotations=anns)
+            set_pos(d, def_pos)
             self._parse_attr_list(d)
             ns, name, params = self._parse_window_call()
             d.window_namespace, d.window_name, d.window_params = ns, name, params
@@ -229,8 +238,10 @@ class Parser:
     def _parse_attr_list(self, d):
         self.eat_op("(")
         while not self.at_op(")"):
+            attr_pos = self.mark()
             name = self.eat_id().text
             d.attribute(name, AttrType.of(self.eat_id().text))
+            set_pos(d.attributes[-1], attr_pos)
             if not self.try_op(","):
                 break
         self.eat_op(")")
@@ -250,9 +261,11 @@ class Parser:
         return ns, name, params
 
     def _parse_event_type_kw(self) -> str:
+        tok = self.peek()
         t = self.eat_id().text.lower()
         if t not in ("current", "expired", "all"):
-            raise SiddhiParserException(f"Bad event type {t!r}")
+            raise SiddhiParserException(f"Bad event type {t!r}",
+                                        tok.line, tok.col)
         self.try_kw("events")
         return t
 
@@ -303,34 +316,40 @@ class Parser:
         if self.try_kw("by"):
             by_attr = self.eat_id().text
         self.eat_kw("every")
-        periods = [self._norm_duration(self.eat_id().text)]
+        t = self.eat_id()
+        periods = [self._norm_duration(t.text, t)]
         if self.at_op("."):  # range: sec ... year
             self.eat_op(".")
             self.eat_op(".")
             self.eat_op(".")
-            periods.append(self._norm_duration(self.eat_id().text))
+            t = self.eat_id()
+            periods.append(self._norm_duration(t.text, t))
             from ..query_api.definition import DURATION_ORDER
             lo = DURATION_ORDER.index(periods[0])
             hi = DURATION_ORDER.index(periods[1])
             periods = DURATION_ORDER[lo:hi + 1]
         else:
             while self.try_op(","):
-                periods.append(self._norm_duration(self.eat_id().text))
+                t = self.eat_id()
+                periods.append(self._norm_duration(t.text, t))
         return AggregationDefinition(aid, stream, selector, by_attr, periods, anns)
 
     @staticmethod
-    def _norm_duration(word: str) -> str:
+    def _norm_duration(word: str, tok: Optional[Token] = None) -> str:
         w = word.lower().rstrip("s") if word.lower() != "s" else word.lower()
         m = {"second": "sec", "sec": "sec", "minute": "min", "min": "min",
              "hour": "hour", "day": "day", "month": "month", "year": "year"}
         if w not in m:
-            raise SiddhiParserException(f"Bad aggregation duration {word!r}")
+            raise SiddhiParserException(
+                f"Bad aggregation duration {word!r}",
+                tok.line if tok else -1, tok.col if tok else -1)
         return m[w]
 
     # ------------------------------------------------- query
 
     def parse_query(self, anns: List[Annotation]) -> Query:
         q = Query(annotations=anns)
+        set_pos(q, self.mark())
         self.eat_kw("from")
         q.input_stream = self.parse_input_stream()
         if self.try_kw("select"):
@@ -340,7 +359,8 @@ class Parser:
         self._parse_selector_suffix(q.selector)
         if self.try_kw("output"):
             q.output_rate = self.parse_output_rate()
-        q.output_stream = self.parse_output_action()
+        out_pos = self.mark()
+        q.output_stream = set_pos(self.parse_output_action(), out_pos)
         return q
 
     def parse_output_rate(self) -> OutputRate:
@@ -426,6 +446,7 @@ class Parser:
             sel.select_all = True
             return sel
         while True:
+            oa_pos = self.mark()
             expr = self.parse_expression()
             if self.try_kw("as"):
                 rename = self.eat_id().text
@@ -435,7 +456,8 @@ class Parser:
                 rename = expr.name
             else:
                 rename = f"_{len(sel.attributes)}"
-            sel.attributes.append(OutputAttribute(rename, expr))
+            sel.attributes.append(
+                set_pos(OutputAttribute(rename, expr), oa_pos))
             if not self.try_op(","):
                 break
         return sel
@@ -513,10 +535,12 @@ class Parser:
             k += 1
 
     def parse_single_stream(self) -> SingleInputStream:
+        s_pos = self.mark()
         is_inner = self.try_op("#")
         is_fault = (not is_inner) and self.try_op("!")
         sid = self.eat_id().text
         s = SingleInputStream(sid, is_inner=is_inner, is_fault=is_fault)
+        set_pos(s, s_pos)
         self._parse_stream_handlers(s)
         if self.try_kw("as"):
             s.stream_ref = self.eat_id().text
@@ -524,6 +548,7 @@ class Parser:
 
     def _parse_stream_handlers(self, s: SingleInputStream):
         while True:
+            h_pos = self.mark()
             if self.at_op("["):
                 self.eat_op("[")
                 s.handlers.append(Filter(self.parse_expression()))
@@ -540,6 +565,7 @@ class Parser:
                     s.handlers.append(StreamFunctionHandler(ns, name, params))
             else:
                 break
+            set_pos(s.handlers[-1], h_pos)
 
     def parse_join_rest(self, left: SingleInputStream,
                         unidir_left: bool) -> JoinInputStream:
@@ -630,6 +656,10 @@ class Parser:
                                 within_ms=within_ms)
 
     def parse_pattern_element(self):
+        el_pos = self.mark()
+        return set_pos(self._parse_pattern_element_inner(), el_pos)
+
+    def _parse_pattern_element_inner(self):
         if self.try_kw("every"):
             inner = self.parse_pattern_unit()
             # `every (...) within t`: the group-scoped within parsed inside
@@ -685,14 +715,16 @@ class Parser:
         return self._parse_stream_state_raw()
 
     def _parse_stream_state_raw(self) -> StreamStateElement:
+        s_pos = self.mark()
         ref = None
         if self.peek().kind == "ID" and self.at_op("=", k=1):
             ref = self.eat_id().text
             self.eat_op("=")
         sid = self.eat_id().text
         s = SingleInputStream(sid, stream_ref=ref)
+        set_pos(s, s_pos)
         self._parse_stream_handlers(s)
-        return StreamStateElement(stream=s)
+        return set_pos(StreamStateElement(stream=s), s_pos)
 
     def _maybe_count(self, base: StreamStateElement):
         ANY = CountStateElement.ANY
@@ -744,11 +776,14 @@ class Parser:
     # ------------------------------------------------- partition
 
     def parse_partition(self, anns: List[Annotation]) -> Partition:
+        p_pos = self.mark()
         self.eat_kw("partition")
         self.eat_kw("with")
         self.eat_op("(")
         p = Partition(annotations=anns)
+        set_pos(p, p_pos)
         while not self.at_op(")"):
+            pt_pos = self.mark()
             expr = self.parse_expression()
             if self.try_kw("as"):
                 # range partition: cond as 'label' (or cond as 'label')* of Stream
@@ -760,11 +795,13 @@ class Parser:
                     ranges.append(RangePartitionProperty(self.next().value, c))
                 self.eat_kw("of")
                 sid = self.eat_id().text
-                p.partition_types.append(RangePartitionType(sid, ranges))
+                p.partition_types.append(
+                    set_pos(RangePartitionType(sid, ranges), pt_pos))
             else:
                 self.eat_kw("of")
                 sid = self.eat_id().text
-                p.partition_types.append(ValuePartitionType(sid, expr))
+                p.partition_types.append(
+                    set_pos(ValuePartitionType(sid, expr), pt_pos))
             if not self.try_op(","):
                 break
         self.eat_op(")")
@@ -914,6 +951,10 @@ class Parser:
         return self._parse_primary()
 
     def _parse_primary(self) -> Expression:
+        p_pos = self.mark()
+        return set_pos(self._parse_primary_inner(), p_pos)
+
+    def _parse_primary_inner(self) -> Expression:
         t = self.peek()
         if self.at_op("("):
             self.next()
@@ -972,11 +1013,14 @@ class Parser:
         return AttributeFunction(ns, fname, tuple(args))
 
     def parse_variable(self) -> Variable:
+        t = self.peek()
+        v_pos = self.mark()
         name = self.eat_id().text
         v = self._parse_variable_rest(name)
         if not isinstance(v, Variable):
-            raise SiddhiParserException("Expected a variable reference")
-        return v
+            raise SiddhiParserException("Expected a variable reference",
+                                        t.line, t.col)
+        return set_pos(v, v_pos)
 
     def _parse_variable_rest(self, name: str) -> Variable:
         idx = None
@@ -1009,7 +1053,7 @@ class Parser:
             return e.value
         if isinstance(e, Constant):
             return int(e.value)
-        raise SiddhiParserException("Expected time constant")
+        raise SiddhiParserException("Expected time constant", t.line, t.col)
 
 
 # ------------------------------------------------------------------ facade

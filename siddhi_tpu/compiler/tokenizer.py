@@ -8,7 +8,7 @@ their case; backtick-quoted identifiers are supported.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import List
 
 from ..utils.errors import SiddhiParserException
 

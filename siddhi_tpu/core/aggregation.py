@@ -25,9 +25,8 @@ import numpy as np
 
 from ..plan.expr_compiler import CompiledExpr, EvalCtx, ExprCompiler, Scope
 from ..query_api import Filter
-from ..query_api.definition import (DURATION_MS, DURATION_ORDER,
-                                    AggregationDefinition, Attribute,
-                                    AttrType, StreamDefinition)
+from ..query_api.definition import (DURATION_MS, AggregationDefinition,
+                                    Attribute, AttrType, StreamDefinition)
 from ..query_api.expression import AttributeFunction, Constant, TimeConstant
 from ..utils.errors import SiddhiAppCreationError, StoreQueryCreationError
 from .event import CURRENT, EventChunk

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import heapq
 from collections import Counter
-from typing import Any, Dict, List, Optional, Type
+from typing import Dict, List, Optional, Type
 
 import numpy as np
 

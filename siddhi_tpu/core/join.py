@@ -269,7 +269,7 @@ class JoinRuntime:
         from ..query_api.expression import variables_of
         from ..plan.expr_compiler import ExprCompiler as _EC
 
-        from ..query_api.expression import Compare, MathExpr
+        from ..query_api.expression import MathExpr
 
         def _fail(reason):
             self.device_probe_reason = "device join probe: " + reason

@@ -6,9 +6,8 @@ UpdateOrInsertTableCallback + QueryCallback split of current/expired.)
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
-import numpy as np
 
 from ..query_api.query import OutputEventsFor
 from .event import CURRENT, EXPIRED, EventChunk

@@ -11,7 +11,7 @@ for it.
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -20,7 +20,7 @@ from ..query_api import (Partition, Query, RangePartitionType,
                          ValuePartitionType, find_annotation)
 from ..query_api.definition import StreamDefinition
 from ..utils.errors import DefinitionNotExistError, SiddhiAppCreationError
-from .event import CURRENT, EventChunk
+from .event import EventChunk
 from .query_runtime import QueryRuntime
 from .stream import StreamJunction
 

@@ -22,8 +22,7 @@ and util/parser/StateInputStreamParser.java:76-404 (state graph wiring:
 """
 from __future__ import annotations
 
-import threading
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 

@@ -15,7 +15,7 @@ from typing import Optional
 import numpy as np
 
 from ..plan.expr_compiler import CompiledExpr, EvalCtx
-from .event import CURRENT, EXPIRED, RESET, TIMER, EventChunk
+from .event import RESET, TIMER, EventChunk
 
 
 class Processor:

@@ -11,13 +11,12 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..plan.expr_compiler import EvalCtx, ExprCompiler, Scope
+from ..plan.expr_compiler import ExprCompiler, Scope
 from ..query_api import (Filter, InsertIntoStream, JoinInputStream, Query,
-                         ReturnStream, SingleInputStream, StateInputStream,
+                         SingleInputStream, StateInputStream,
                          StreamFunctionHandler, WindowHandler)
 from ..query_api.definition import StreamDefinition
-from ..query_api.query import (DeleteStream, OutputEventsFor, UpdateOrInsertStream,
-                               UpdateStream)
+from ..query_api.query import DeleteStream, UpdateOrInsertStream, UpdateStream
 from ..utils.errors import SiddhiAppCreationError
 from .event import EventChunk
 from .output import (DeleteTableCallback, InsertIntoStreamCallback,

@@ -18,17 +18,16 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
 from ..query_api.definition import AttrType, TableDefinition
-from ..query_api.expression import (And, AttributeFunction, Compare,
-                                    CompareOp, Constant, Expression, IsNull,
-                                    MathExpr, MathOp, Not, Or, Variable,
-                                    variables_of)
+from ..query_api.expression import (And, AttributeFunction, Compare, Constant,
+                                    Expression, IsNull, MathExpr, Not, Or,
+                                    Variable, variables_of)
 from ..utils.errors import SiddhiAppCreationError
-from .event import CURRENT, EventChunk, dtype_for
+from .event import EventChunk, dtype_for
 from .table import STREAM_QUAL, _item, _scalar
 
 

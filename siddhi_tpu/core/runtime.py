@@ -8,25 +8,21 @@ trigger wiring; util/parser/SiddhiAppParser.java — @app annotations.)
 """
 from __future__ import annotations
 
-import threading
-from typing import Any, Callable, Dict, List, Optional, Union
+from typing import Any, Dict, List, Optional, Union
 
 import numpy as np
 
 from ..compiler import SiddhiCompiler
-from ..plan.expr_compiler import EvalCtx, ExprCompiler, Scope
-from ..query_api import (Annotation, AttrType, Partition, Query, SiddhiApp,
-                         StreamDefinition, find_annotation)
-from ..query_api.definition import TableDefinition
+from ..plan.expr_compiler import ExprCompiler, Scope
+from ..query_api import (AttrType, Query, SiddhiApp, StreamDefinition,
+                         find_annotation)
 from ..utils.errors import (DefinitionNotExistError, NoPersistenceStoreError,
                             SiddhiAppCreationError)
 from ..utils.extension import ExtensionRegistry
 from .context import SiddhiAppContext, SiddhiContext
-from .event import CURRENT, EventChunk
 from .named_window import NamedWindow
 from .query_runtime import QueryRuntime
-from .snapshot import (InMemoryPersistenceStore, PersistenceStore,
-                       SnapshotService)
+from .snapshot import PersistenceStore, SnapshotService
 from .statistics import StatisticsManager
 from .stream import InputHandler, QueryCallback, StreamCallback, StreamJunction
 from .table import InMemoryTable
@@ -80,6 +76,11 @@ class ScriptFunction:
 
 
 class SiddhiAppRuntime:
+    #: AnalysisResult from the compile-time semantic analyzer (set by
+    #: SiddhiManager.create_siddhi_app_runtime; None for runtimes built
+    #: directly).  Surfaced by GET /stats on the REST service.
+    analysis = None
+
     def __init__(self, app: SiddhiApp, siddhi_context: SiddhiContext,
                  app_string: Optional[str] = None):
         self.app = app
@@ -539,20 +540,40 @@ class SiddhiManager:
         self.runtimes: Dict[str, SiddhiAppRuntime] = {}
 
     def create_siddhi_app_runtime(
-            self, app: Union[str, SiddhiApp]) -> SiddhiAppRuntime:
+            self, app: Union[str, SiddhiApp],
+            strict: bool = False) -> SiddhiAppRuntime:
+        """Parse → analyze → plan.  The semantic analyzer
+        (siddhi_tpu.analysis) always runs and its diagnostics ride the
+        returned runtime as ``rt.analysis`` (and GET /stats on the REST
+        service); with ``strict=True`` any error OR warning diagnostic
+        raises SiddhiAppValidationException before anything is built —
+        fail-fast for deployments that refuse hazardous apps."""
         from .tracing import trace_span
         app_string = app if isinstance(app, str) else None
         if isinstance(app, str):
             with trace_span("parse", cat="compile", chars=len(app)):
                 app = SiddhiCompiler.parse(app)
+        analysis = None
+        try:
+            from ..analysis import analyze
+            with trace_span("analyze", cat="compile"):
+                analysis = analyze(app)
+        except Exception:   # noqa: BLE001 — advisory pass must never
+            # take down app creation (strict mode excepted below)
+            if strict:
+                raise
+        if strict and analysis is not None:
+            analysis.raise_if(strict=True)
         with trace_span("plan", cat="compile", app=app.name or "?"):
             rt = SiddhiAppRuntime(app, self.siddhi_context, app_string)
+        rt.analysis = analysis
         self.runtimes[rt.name] = rt
         return rt
 
-    def validate_siddhi_app(self, app: Union[str, SiddhiApp]):
+    def validate_siddhi_app(self, app: Union[str, SiddhiApp],
+                            strict: bool = False):
         """Parse + build, then dispose (reference validateSiddhiApp)."""
-        rt = self.create_siddhi_app_runtime(app)
+        rt = self.create_siddhi_app_runtime(app, strict=strict)
         self.runtimes.pop(rt.name, None)
         rt.shutdown()
 

@@ -9,12 +9,11 @@ serialisation): each stateful element exposes current_state()/restore_state().
 """
 from __future__ import annotations
 
-import json
 import os
 import pickle
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Optional
 
 
 class PersistenceStore:

@@ -16,14 +16,13 @@ import logging
 import re
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
-import numpy as np
 
 from ..query_api.annotation import Annotation, find_all, find_annotation
 from ..utils.errors import (ConnectionUnavailableError, MappingFailedError,
                             SiddhiAppCreationError)
-from .event import CURRENT, EXPIRED, Event, EventChunk
+from .event import CURRENT, Event, EventChunk
 
 log = logging.getLogger(__name__)
 

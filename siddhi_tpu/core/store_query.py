@@ -11,9 +11,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..plan.expr_compiler import EvalCtx, ExprCompiler, Scope
-from ..query_api.query import (DeleteStream, InsertIntoStream, StoreQuery,
-                               StoreQueryType, UpdateOrInsertStream,
-                               UpdateStream)
+from ..query_api.query import InsertIntoStream, StoreQuery, StoreQueryType
 from ..utils.errors import StoreQueryCreationError
 from .event import CURRENT, Event, EventChunk
 from .selector import QuerySelector

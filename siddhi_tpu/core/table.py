@@ -13,7 +13,7 @@ with a hash-index fast path when the condition is `table.pk == <stream expr>`.
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -22,7 +22,7 @@ from ..query_api.annotation import find_annotation
 from ..query_api.definition import TableDefinition
 from ..query_api.expression import (And, Compare, CompareOp, Expression,
                                     Variable)
-from .event import CURRENT, EventChunk
+from .event import EventChunk
 
 STREAM_QUAL = "__stream__"
 

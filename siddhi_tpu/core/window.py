@@ -972,7 +972,7 @@ def create_window_processor(name: str, params: List, app_ctx, names,
     class either subclasses WindowProcessor (instantiated as
     cls(app_ctx, names, params, compile_expr)) or provides a
     create(app_ctx, names, params, compile_expr) factory."""
-    from ..query_api.expression import Constant, TimeConstant, Variable
+    from ..query_api.expression import Constant, TimeConstant
 
     def _extension():
         if extension_registry is None:

@@ -39,7 +39,7 @@ displaced, 3=batch-current, 4=carry-expired-batch, 5=delayed-current.
 """
 from __future__ import annotations
 
-from typing import Dict, NamedTuple, Optional
+from typing import Dict, NamedTuple
 
 import jax
 import jax.numpy as jnp

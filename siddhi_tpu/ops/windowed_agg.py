@@ -24,7 +24,7 @@ expression compiler (plan/expr_compiler with xp=jnp) — the kernel consumes
 """
 from __future__ import annotations
 
-from typing import Dict, NamedTuple, Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -30,8 +30,8 @@ import numpy as np
 
 from ..core.event import CURRENT, EXPIRED, RESET, EventChunk, dtype_for
 from ..core.window import WindowProcessor, _interleave, _reset_row
-from ..ops.dwin import (C_BATCH, C_DELAY, C_EXPBATCH, C_LEN, C_TIME,
-                        TS_NONE, DwinSpec, build_dwin_step, make_dwin_carry)
+from ..ops.dwin import (C_BATCH, C_EXPBATCH, C_TIME, TS_NONE, DwinSpec,
+                        build_dwin_step, make_dwin_carry)
 from ..query_api.definition import AttrType
 from ..query_api.expression import Constant, TimeConstant, Variable
 from ..utils.errors import (SiddhiAppCreationError,

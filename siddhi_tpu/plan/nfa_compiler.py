@@ -38,9 +38,9 @@ from ..query_api import (AbsentStreamStateElement, CountStateElement,
                          LogicalStateElement, NextStateElement, Query,
                          StateInputStream, StateType, StreamStateElement)
 from ..query_api.definition import AttrType
-from ..query_api.expression import (And, AttributeFunction, Compare,
-                                    CompareOp, Constant, IsNull, Not, Or,
-                                    TimeConstant, Variable, variables_of)
+from ..query_api.expression import (And, Compare, CompareOp, Constant, IsNull,
+                                    Not, Or, TimeConstant, Variable,
+                                    variables_of)
 from ..utils.errors import SiddhiAppCreationError
 from .expr_compiler import EvalCtx, ExprCompiler, Scope
 

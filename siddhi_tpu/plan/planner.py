@@ -17,9 +17,8 @@ Engine selection:
 from __future__ import annotations
 
 import os
-import threading
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 

@@ -20,11 +20,10 @@ captures survive across chunks there; see plan/nfa_compiler.py.)
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Set, Tuple
 
 import numpy as np
 
-from ..query_api.definition import AttrType
 from ..query_api.expression import (And, AttributeFunction, Compare,
                                     CompareOp, Constant, Expression, In,
                                     IsNull, MathExpr, Not, Or, Variable,

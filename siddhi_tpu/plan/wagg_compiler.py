@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..compiler import SiddhiCompiler
-from ..query_api import Filter, Query, SingleInputStream, WindowHandler
+from ..query_api import Filter, Query, SingleInputStream
 from ..query_api.definition import AttrType
 from ..query_api.expression import AttributeFunction, Constant, Variable
 from ..utils.errors import SiddhiAppCreationError

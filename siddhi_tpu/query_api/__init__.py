@@ -24,4 +24,5 @@ from .query import (AbsentStreamStateElement, CountStateElement, DeleteStream,
                     StreamHandler, StreamStateElement, UpdateOrInsertStream,
                     UpdateSetAssignment, UpdateStream, ValuePartitionType,
                     WindowHandler)
+from .position import SourcePos, nearest_pos, pos_of, set_pos
 from .siddhi_app import SiddhiApp

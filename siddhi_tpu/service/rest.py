@@ -156,7 +156,15 @@ class SiddhiService:
 
     def _stats_json(self) -> dict:
         from ..core.profiling import profiler
-        return {"apps": {name: rt.app_ctx.statistics_manager.snapshot()
-                         for name, rt in self.manager.runtimes.items()
-                         if rt.app_ctx.statistics_manager is not None},
-                "kernels": profiler().snapshot()}
+        apps = {}
+        for name, rt in self.manager.runtimes.items():
+            if rt.app_ctx.statistics_manager is None:
+                continue
+            doc = rt.app_ctx.statistics_manager.snapshot()
+            # compile-time analyzer findings ride the same surface: an
+            # operator scraping /stats sees "this app's pattern has no
+            # within bound" next to the runtime counters it explains
+            if rt.analysis is not None:
+                doc["analysis"] = rt.analysis.as_dicts()
+            apps[name] = doc
+        return {"apps": apps, "kernels": profiler().snapshot()}

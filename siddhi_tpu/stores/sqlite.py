@@ -17,7 +17,7 @@ curious users) can verify pushdown actually happened.
 from __future__ import annotations
 
 import sqlite3
-from typing import Any, Dict, Iterable, List, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional
 
 from ..core.record_table import (AbstractQueryableRecordTable, Agg, Arith,
                                  BoolAnd, BoolNot, BoolOr, Cmp, Col, Const,

@@ -8,7 +8,7 @@ covers the expressions used across the reference test-suite.
 from __future__ import annotations
 
 import time
-from typing import List, Optional, Set
+from typing import Optional, Set
 
 
 def _parse_field(spec: str, lo: int, hi: int) -> Optional[Set[int]]:
