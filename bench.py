@@ -326,6 +326,17 @@ def bench_thru():
     dropped = _total_dropped(bank) - dropped_before
     assert dropped == 0, \
         f"throughput run dropped {dropped} partials — headline is void"
+    # static cost model (analysis/cost_model.py): predicted persistent
+    # HBM vs the KernelProfiler live_bytes gauge the bank recorded at
+    # carry placement — the predicted-vs-measured column the
+    # --fail-on-hbm-budget gate and BENCH rounds key on
+    from siddhi_tpu.analysis.cost_model import bank_state_bytes
+    from siddhi_tpu.analysis.plan_ir import automaton_ir_from_nfa
+    from siddhi_tpu.core.profiling import profiler
+    a_ir = automaton_ir_from_nfa(bank.nfa, "bank")
+    hbm_predicted = bank_state_bytes(a_ir, N_PATTERNS)
+    hbm_measured = profiler().snapshot().get(
+        "nfa.bank_step", {}).get("live_bytes", 0)
     # steady-state pipelined per-block time: total walltime of the fully
     # queued run divided by blocks.  The per-read tunnel round-trip is paid
     # once, so this is the honest COMPUTE-side block latency at depth-B
@@ -342,6 +353,11 @@ def bench_thru():
             "payloads": payloads, "payload_shortfall": shortfall,
             "slot_dropped_partials": dropped,
             "pipelined_block_ms": pipelined_block_ms,
+            "hbm_predicted_bytes": int(hbm_predicted),
+            "hbm_live_bytes": int(hbm_measured),
+            "hbm_predicted_vs_measured": (
+                round(hbm_predicted / hbm_measured, 4)
+                if hbm_measured else None),
             "sample": sample}
 
 
@@ -716,6 +732,14 @@ def main():
     if "--fail-on-retrace" in sys.argv:
         fail_on_retrace = int(
             sys.argv[sys.argv.index("--fail-on-retrace") + 1])
+    # --fail-on-hbm-budget MB: exit non-zero when the static cost model
+    # predicts more persistent HBM than the budget — the mechanical gate
+    # of the plan-level verifier (analysis/cost_model.py), validated
+    # against the KernelProfiler live_bytes gauge in the same JSON
+    fail_on_hbm = None
+    if "--fail-on-hbm-budget" in sys.argv:
+        fail_on_hbm = float(
+            sys.argv[sys.argv.index("--fail-on-hbm-budget") + 1])
     if "--phase" in sys.argv:
         phase = sys.argv[sys.argv.index("--phase") + 1]
         if phase == "gate":
@@ -825,7 +849,21 @@ def main():
         "kernel_profile_thru": thru.get("kernel_profile"),
         "kernel_profile_engine": eng.get("kernel_profile"),
         "retrace_total": retraces,
+        # static cost model: predicted persistent HBM next to the
+        # profiler-measured live bytes (acceptance: within 2x)
+        "cost_model": {
+            "hbm_predicted_bytes": thru.get("hbm_predicted_bytes"),
+            "hbm_live_bytes": thru.get("hbm_live_bytes"),
+            "predicted_vs_measured": thru.get("hbm_predicted_vs_measured"),
+        },
     }))
+    if fail_on_hbm is not None:
+        predicted = thru.get("hbm_predicted_bytes") or 0
+        if predicted > fail_on_hbm * (1 << 20):
+            sys.stderr.write(
+                f"[bench] FAIL: predicted persistent HBM {predicted} B "
+                f"exceeds --fail-on-hbm-budget {fail_on_hbm} MB\n")
+            sys.exit(1)
     if fail_on_retrace is not None and retraces > fail_on_retrace:
         sys.stderr.write(
             f"[bench] FAIL: {retraces} kernel retraces across measured "

@@ -9,11 +9,31 @@ Public surface:
         print(d.render("app.siddhi"))
     result.raise_if(strict=True)        # warnings promote to errors
 
-CLI: ``python -m siddhi_tpu.analyze app.siddhi [--json] [--strict]``.
-Diagnostic catalog: docs/analysis.md (generated from diagnostics.CATALOG).
+Plan-level surface (PR 3) — a verifier over the *compiled* plan:
+
+    from siddhi_tpu.analysis import extract_plan, verify_plan
+
+    rt = manager.create_siddhi_app_runtime(app)   # plan report attaches
+    rt.analysis.plan                              # PlanReport (PV/PC codes,
+                                                  # pruned-state counts, cost)
+
+CLI: ``python -m siddhi_tpu.analyze app.siddhi [--json] [--strict]
+[--plan]``.  Everything importable here stays jax-free; only the jaxpr
+sanitizer (plan_verify.sanitize_runtime) imports jax, lazily.
+Diagnostic catalog: docs/analysis.md (generated from
+diagnostics.catalog_markdown()).
 """
 from .analyzer import AnalysisResult, analyze
-from .diagnostics import CATALOG, CatalogEntry, Diagnostic, Severity
+from .cost_model import CostReport, plan_cost
+from .diagnostics import (CATALOG, CatalogEntry, Diagnostic, Severity,
+                          catalog_markdown)
+from .plan_ir import AutomatonIR, PlanIR, ProgramIR, extract_plan
+from .plan_verify import (PlanReport, attach_plan_analysis, sanitize_step,
+                          verify_automaton, verify_plan)
 
 __all__ = ["analyze", "AnalysisResult", "Diagnostic", "Severity",
-           "CATALOG", "CatalogEntry"]
+           "CATALOG", "CatalogEntry", "catalog_markdown",
+           "PlanIR", "AutomatonIR", "ProgramIR", "extract_plan",
+           "CostReport", "plan_cost",
+           "PlanReport", "verify_plan", "verify_automaton",
+           "sanitize_step", "attach_plan_analysis"]

@@ -59,6 +59,10 @@ _NUMERIC = (AttrType.INT, AttrType.LONG, AttrType.FLOAT, AttrType.DOUBLE)
 class AnalysisResult:
     diagnostics: List[Diagnostic] = field(default_factory=list)
     app_name: Optional[str] = None
+    #: PlanReport from the plan-level verifier (plan_verify.py) — set by
+    #: attach_plan_analysis after the runtime is built; None when only
+    #: source-level analysis ran (e.g. the default CLI path)
+    plan: Optional[object] = None
 
     @property
     def errors(self) -> List[Diagnostic]:

@@ -1,0 +1,197 @@
+"""Static cost model over the Plan-IR: HBM footprint + FLOP estimates.
+
+Prices a compiled plan BEFORE any event is ingested:
+
+  * **HBM state bytes** — the persistent device arrays a plan keeps
+    alive between steps.  For pattern automata the formulas mirror
+    ``ops/nfa.make_carry`` exactly (slot rings, capture banks, per-kind
+    extras), so the prediction is checked byte-exact against the real
+    carry in tests/test_plan_verify.py and against the KernelProfiler's
+    ``live_bytes`` gauge in bench.py (predicted-vs-measured columns).
+  * **FLOPs per event** — a coarse per-ingested-event work estimate:
+    every live slot of a lane evaluates each unit's condition program,
+    so cost scales with (condition ops x slot ring width) summed over
+    the chain.  Good for ranking plans and flagging compute-bound
+    shapes, not for cycle accounting.
+
+Diagnostics (stable codes in diagnostics.CATALOG):
+  PC001 info   — per-app cost summary (bytes + flops/event in extra)
+  PC002 warn   — predicted HBM exceeds a configured budget
+  PC003 warn   — per-event FLOP estimate above threshold
+
+No jax imports: everything is arithmetic over Plan-IR dims.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .diagnostics import Diagnostic
+from .plan_ir import AutomatonIR, PlanIR
+
+I32 = 4
+F32 = 4
+
+#: FLOP model coefficients: each expression node in a condition costs
+#: about this many device ops per evaluated slot ...
+_OPS_PER_COND_NODE = 4
+#: ... plus fixed per-unit advance/bookkeeping work per slot.
+_UNIT_OVERHEAD_OPS = 16
+
+#: default PC003 threshold — a per-event estimate above this means the
+#: step is compute-bound far below ingest capability on current TPUs
+DEFAULT_FLOPS_WARN = 1_000_000
+
+
+def nfa_state_bytes(a: AutomatonIR,
+                    n_partitions: Optional[int] = None
+                    ) -> Dict[str, int]:
+    """Per-array persistent carry bytes of a pattern automaton — the
+    exact shapes ``ops/nfa.make_carry`` allocates (kept in lockstep; the
+    equivalence is asserted in tests)."""
+    P = n_partitions if n_partitions is not None else a.n_partitions
+    K = a.n_slots
+    R = max(a.n_rows, 1)
+    C = max(a.n_caps, 1)
+    kinds = {s.kind for s in a.states}
+    b: Dict[str, int] = {
+        "slot_state": P * K * I32,
+        "slot_start": P * K * I32,
+        "slot_enter": P * K * I32,
+        "slot_seq": P * K * I32,
+        "arm_seq": P * I32,
+        "captures": P * K * R * C * F32,
+        "dropped": P * I32,
+    }
+    if "count" in kinds:
+        b["cnt_cur"] = P * K * I32
+        b["cnt_prev"] = P * K * I32
+    if a.eps_start and a.is_sequence:
+        b["seq_froze"] = P * I32
+    if "logical" in kinds:
+        b["lmask"] = P * K * I32
+    if "absent" in kinds:
+        b["deadline"] = P * K * I32
+    arm_once = (not a.is_every) or \
+        (not a.is_sequence and a.states and a.states[0].kind == "count")
+    if arm_once:
+        b["armed_total"] = P * I32
+    return b
+
+
+def nfa_egress_bytes(a: AutomatonIR) -> int:
+    """Per-chunk compacted-egress buffer: (cap+1) x (4 + R*C) int32."""
+    R = max(a.n_rows, 1)
+    C = max(a.n_caps, 1)
+    return (a.egress_cap + 1) * (4 + R * C) * I32
+
+
+def nfa_flops_per_event(a: AutomatonIR) -> int:
+    """Per-ingested-event condition work: every slot of the event's lane
+    evaluates each unit's condition program each step."""
+    per_slot = sum(s.cond_ops * _OPS_PER_COND_NODE + _UNIT_OVERHEAD_OPS
+                   for s in a.states)
+    return per_slot * a.n_slots
+
+
+def bank_state_bytes(a: AutomatonIR, n_patterns: int,
+                     n_partitions: Optional[int] = None) -> int:
+    """A CompiledPatternBank carries the same arrays with a leading
+    pattern axis (ops/nfa.make_bank_carry broadcasts, the first donated
+    step materializes them dense)."""
+    return n_patterns * sum(nfa_state_bytes(a, n_partitions).values())
+
+
+@dataclass
+class CostEntry:
+    query: str
+    kind: str
+    hbm_bytes: int
+    flops_per_event: int
+    breakdown: Dict[str, int] = field(default_factory=dict)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"query": self.query, "kind": self.kind,
+                "hbm_bytes": self.hbm_bytes,
+                "flops_per_event": self.flops_per_event,
+                "breakdown": dict(self.breakdown)}
+
+
+@dataclass
+class CostReport:
+    entries: List[CostEntry] = field(default_factory=list)
+
+    @property
+    def total_hbm_bytes(self) -> int:
+        return sum(e.hbm_bytes for e in self.entries)
+
+    @property
+    def total_flops_per_event(self) -> int:
+        return sum(e.flops_per_event for e in self.entries)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"total_hbm_bytes": self.total_hbm_bytes,
+                "total_flops_per_event": self.total_flops_per_event,
+                "entries": [e.as_dict() for e in self.entries]}
+
+
+def plan_cost(plan: PlanIR) -> CostReport:
+    """Price every entry of a Plan-IR.  Automata get the closed-form
+    make_carry formulas; non-pattern programs carry their shape-derived
+    persistent bytes from extraction (still static: array shapes are
+    fixed at plan time) plus a condition-graph FLOP estimate."""
+    rep = CostReport()
+    for a in plan.automata:
+        bd = nfa_state_bytes(a)
+        bd["egress_buffer"] = nfa_egress_bytes(a)
+        rep.entries.append(CostEntry(
+            query=a.query, kind="pattern-nfa",
+            hbm_bytes=sum(bd.values()),
+            flops_per_event=0 if a.statically_dead
+            else nfa_flops_per_event(a),
+            breakdown=bd))
+    for p in plan.programs:
+        if p.backend == "host":
+            continue
+        rep.entries.append(CostEntry(
+            query=p.query, kind=p.kind, hbm_bytes=p.state_bytes,
+            flops_per_event=p.cond_ops * _OPS_PER_COND_NODE,
+            breakdown={"state": p.state_bytes}))
+    return rep
+
+
+def cost_diagnostics(report: CostReport,
+                     hbm_budget_mb: Optional[float] = None,
+                     flops_warn: int = DEFAULT_FLOPS_WARN,
+                     query: Optional[str] = None) -> List[Diagnostic]:
+    """CostReport -> PC0xx diagnostics."""
+    diags: List[Diagnostic] = []
+    if report.entries:
+        diags.append(Diagnostic(
+            "PC001",
+            f"plan cost: {report.total_hbm_bytes} persistent HBM bytes, "
+            f"~{report.total_flops_per_event} FLOPs/event across "
+            f"{len(report.entries)} device plan(s)",
+            query=query,
+            extra={"hbm_bytes": report.total_hbm_bytes,
+                   "flops_per_event": report.total_flops_per_event}))
+    if hbm_budget_mb is not None:
+        budget = int(hbm_budget_mb * (1 << 20))
+        if report.total_hbm_bytes > budget:
+            diags.append(Diagnostic(
+                "PC002",
+                f"predicted persistent HBM {report.total_hbm_bytes} B "
+                f"exceeds the {hbm_budget_mb} MB budget",
+                query=query,
+                extra={"hbm_bytes": report.total_hbm_bytes,
+                       "budget_bytes": budget}))
+    for e in report.entries:
+        if e.flops_per_event > flops_warn:
+            diags.append(Diagnostic(
+                "PC003",
+                f"'{e.query}' estimates ~{e.flops_per_event} FLOPs per "
+                f"event (threshold {flops_warn}) — the step will be "
+                f"compute-bound",
+                query=e.query,
+                extra={"flops_per_event": e.flops_per_event}))
+    return diags

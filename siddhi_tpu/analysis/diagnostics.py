@@ -13,9 +13,16 @@ Families:
   ``SA04x`` — dead-code findings
   ``SP0xx`` — TPU performance hazards (retrace storms, host fallbacks,
               float32 precision loss)
+  ``PV0xx`` — plan-level verifier findings over the compiled Plan-IR
+              (automaton well-formedness, liveness pruning, jaxpr
+              kernel sanitation) — analysis/plan_verify.py
+  ``PC0xx`` — static cost-model findings (HBM footprint, FLOP
+              estimates, budget gates) — analysis/cost_model.py
 
 The full catalog with meanings and fixes is rendered in
-``docs/analysis.md``; :data:`CATALOG` is its single source of truth.
+``docs/analysis.md``; :data:`CATALOG` is its single source of truth and
+:func:`catalog_markdown` is the renderer the docs/tests share, so the
+document can never drift from the code.
 """
 from __future__ import annotations
 
@@ -176,6 +183,93 @@ CATALOG: Dict[str, CatalogEntry] = {e.code: e for e in [
        "rides an exact-integer companion lane or falls back to host — "
        "either way extra cost the query shape opted into silently.",
        "Keep compared integers under 2^24, or use double attributes."),
+    # ---- plan verifier: automaton well-formedness ------------------------
+    _C("PV001", _E, "dangling-transition",
+       "A compiled automaton transition targets a state id that does not "
+       "exist — the transition table is malformed and the step kernel "
+       "would index out of range (or silently clamp).",
+       "Internal compiler invariant; report with the app that produced "
+       "it.  The planner refuses to run a plan with this finding."),
+    _C("PV002", _E, "accept-unreachable",
+       "No path through the compiled automaton reaches the accept state: "
+       "the pattern can NEVER match (e.g. a condition that folds to a "
+       "constant false, or a SEQUENCE leading kleene with min >= 2 whose "
+       "per-event barrier provably kills every sub-min accumulator).  "
+       "The kernel would burn device time scanning events for nothing.",
+       "Fix the contradictory condition / kleene bounds — or delete the "
+       "query.  With pruning on, the engine skips the device step for "
+       "such plans (match output is identically empty)."),
+    _C("PV003", _W, "unreachable-state",
+       "An automaton state is unreachable from the start state — it can "
+       "never hold a partial match, but still widens the transition "
+       "matrices and capture banks every step pays for.",
+       "Internal compiler invariant for chain automata; report it with "
+       "the app.  Liveness pruning removes prunable cases."),
+    _C("PV004", _I, "states-pruned",
+       "Liveness pruning removed automaton states that could never "
+       "contribute to a match (statically-false skippable conditions, "
+       "dead or-sides), shrinking the transition tables and capture "
+       "banks.  Match output is unchanged — equivalence is test-asserted.",
+       "Nothing to do; informational.  Set SIDDHI_TPU_NFA_PRUNE=0 to "
+       "disable pruning when diffing against an unpruned plan."),
+    _C("PV005", _W, "within-starved",
+       "The pattern's `within` bound is smaller than (or equal to) the "
+       "summed `not ... for t` waiting times on the match path: every "
+       "partial expires before the absence chain can confirm, so the "
+       "pattern can match only degenerately (or never).",
+       "Raise the `within` bound above the summed absent waits, or "
+       "shorten the waits."),
+    # ---- plan verifier: jaxpr kernel sanitation --------------------------
+    _C("PV010", _E, "jaxpr-host-callback",
+       "A jitted step's jaxpr contains a host callback primitive "
+       "(pure_callback/io_callback/debug print).  Every step round-trips "
+       "to Python — the kernel is effectively host-bound and the TPU "
+       "pipeline serializes on it.",
+       "Remove the callback from the compiled path (host work belongs in "
+       "ingest/egress, not inside the step)."),
+    _C("PV011", _W, "jaxpr-float64",
+       "A jitted step's jaxpr carries float64 values.  TPUs emulate f64 "
+       "in software (an order of magnitude slower) and the engine's lane "
+       "contract is float32 — an upcast usually indicates an accidental "
+       "numpy float64 constant leaking into the trace.",
+       "Cast constants/operands to float32 (or int32) before the jit "
+       "boundary."),
+    _C("PV012", _W, "jaxpr-dynamic-shape",
+       "A step function could not be traced to a static jaxpr: its "
+       "shapes depend on data (boolean masking, nonzero without a static "
+       "size, host round-trips mid-trace).  Under jit this retraces or "
+       "falls back to host per batch.",
+       "Use fixed-size forms (masking via where, nonzero with size=) so "
+       "the trace is shape-static."),
+    _C("PV013", _W, "jaxpr-unexpected-gather",
+       "A jitted step that should be purely elementwise (e.g. the filter "
+       "column program) contains gather/scatter primitives — lane-"
+       "crossing addressing that breaks TPU vectorization and usually "
+       "signals an expression compiled into indexed loads.",
+       "Restructure the expression to elementwise column math; "
+       "gather/scatter belongs only in the NFA/egress kernels that "
+       "declare it."),
+    # ---- static cost model ----------------------------------------------
+    _C("PC001", _I, "plan-cost-summary",
+       "Static cost-model estimate for a compiled plan: persistent HBM "
+       "state bytes (state banks, slot rings, capture banks, agg tables "
+       "at current lane counts) and estimated FLOPs per ingested event.  "
+       "Predicted-vs-measured live bytes ride bench.py JSON.",
+       "Nothing to do; informational.  The numbers feed `rt.analysis`, "
+       "GET /stats and the bench.py --fail-on-hbm-budget gate."),
+    _C("PC002", _W, "hbm-budget-exceeded",
+       "The plan's predicted persistent HBM footprint exceeds the "
+       "configured budget (analyze --plan --hbm-budget / bench.py "
+       "--fail-on-hbm-budget).  Slot-ring or lane growth at runtime "
+       "would start from an already-over-budget base.",
+       "Shrink partition lanes / slots / window sizes, shard the plan "
+       "across chips, or raise the budget deliberately."),
+    _C("PC003", _W, "flops-per-event-heavy",
+       "The estimated per-event FLOP cost of a step is high (deep "
+       "condition chains x wide slot rings x many lanes).  Throughput "
+       "will be compute-bound well below the ingest path's capability.",
+       "Reduce condition complexity or slot width, or split the pattern "
+       "across queries/chips."),
 ]}
 
 
@@ -221,6 +315,52 @@ class Diagnostic:
         ctx = f" [{self.query}]" if self.query else ""
         return (f"{loc}: {self.severity.value} {self.code} "
                 f"({CATALOG[self.code].title}): {self.message}{ctx}")
+
+
+_FAMILIES = (
+    ("SA00", "Semantic & type checking"),
+    ("SA02", "Unbounded state"),
+    ("SA03", "Partition safety"),
+    ("SA04", "Dead code"),
+    ("SP0", "TPU performance hazards"),
+    ("PV00", "Plan verifier — automaton"),
+    ("PV01", "Plan verifier — jaxpr kernel sanitizer"),
+    ("PC0", "Static cost model"),
+)
+
+
+def catalog_markdown() -> str:
+    """Render :data:`CATALOG` as the markdown section embedded in
+    docs/analysis.md.  The docs file must contain this text verbatim
+    (asserted by tests/test_analysis.py), so code and docs cannot drift;
+    regenerate with ``python -m siddhi_tpu.analyze --catalog-md``."""
+    lines = ["<!-- generated by siddhi_tpu.analysis.diagnostics."
+             "catalog_markdown(); do not edit by hand -->", ""]
+    rendered = set()
+    for prefix, title in _FAMILIES:
+        codes = [c for c in sorted(CATALOG)
+                 if c.startswith(prefix) and c not in rendered]
+        if not codes:
+            continue
+        rendered.update(codes)
+        lines += [f"### {title}", "",
+                  "| code | severity | title | meaning | fix |",
+                  "|---|---|---|---|---|"]
+        for code in codes:
+            e = CATALOG[code]
+            row = [code, e.severity.value, e.title,
+                   e.meaning.replace("|", "\\|"),
+                   e.fix.replace("|", "\\|")]
+            lines.append("| " + " | ".join(row) + " |")
+        lines.append("")
+    leftover = sorted(set(CATALOG) - rendered)
+    if leftover:      # a new family without a _FAMILIES entry still renders
+        lines += ["### Other", ""]
+        lines += [f"- `{c}` ({CATALOG[c].severity.value}) "
+                  f"{CATALOG[c].title}: {CATALOG[c].meaning}"
+                  for c in leftover]
+        lines.append("")
+    return "\n".join(lines)
 
 
 class DiagnosticSink:

@@ -4,12 +4,21 @@ Usage:
     python -m siddhi_tpu.analyze app.siddhi            # pretty output
     python -m siddhi_tpu.analyze app.siddhi --json     # machine-readable
     python -m siddhi_tpu.analyze app.siddhi --strict   # warnings = errors
+    python -m siddhi_tpu.analyze app.siddhi --plan     # plan-level verify
     python -m siddhi_tpu.analyze - < app.siddhi        # read stdin
     python -m siddhi_tpu.analyze --catalog             # list every code
+    python -m siddhi_tpu.analyze --catalog-md          # docs/analysis.md
+                                                       # catalog section
 
 Exit codes: 0 clean (infos allowed), 1 errors (or warnings under
---strict), 2 usage error.  The analyzer itself imports no jax — this
-command runs fine on a machine with no accelerator stack.
+--strict), 2 usage error.
+
+The DEFAULT path imports no jax — this command runs fine on a machine
+with no accelerator stack (tests/test_analysis.py asserts jax stays out
+of sys.modules).  ``--plan`` is the explicit opt-in that builds the
+runtime, extracts the Plan-IR, runs the automaton verifier + jaxpr
+kernel sanitizer + static cost model (PV0xx/PC0xx codes), and therefore
+lazily imports the jax-backed planner.
 """
 from __future__ import annotations
 
@@ -27,12 +36,29 @@ def _print_catalog() -> None:
         print(f"       fix: {e.fix}")
 
 
+def _plan_result(text: str, engine, hbm_budget):
+    """--plan: build the app (lazy jax import via the planner), attach
+    the plan-level verification (with the jaxpr sanitizer on) and return
+    the merged AnalysisResult."""
+    from .analysis.plan_verify import attach_plan_analysis
+    from .core.runtime import SiddhiManager
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(text)
+    try:
+        attach_plan_analysis(rt, hbm_budget_mb=hbm_budget, jaxpr=True)
+        return rt.analysis
+    finally:
+        rt.shutdown()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m siddhi_tpu.analyze",
         description="Static semantic analysis for SiddhiQL apps: type "
                     "checking, unbounded-state, retrace-hazard, "
-                    "partition-safety and host-fallback diagnostics.")
+                    "partition-safety and host-fallback diagnostics; "
+                    "--plan adds compiled-plan verification (automaton "
+                    "reachability, jaxpr sanitation, HBM/FLOP cost).")
     ap.add_argument("app", nargs="?",
                     help="path to a .siddhi app file, or '-' for stdin")
     ap.add_argument("--json", action="store_true",
@@ -42,12 +68,25 @@ def main(argv=None) -> int:
     ap.add_argument("--engine", choices=("auto", "device", "host"),
                     help="override the engine mode assumed by the SP0xx "
                          "performance passes")
+    ap.add_argument("--plan", action="store_true",
+                    help="build the runtime and run the plan-level "
+                         "verifier + cost model (imports jax)")
+    ap.add_argument("--hbm-budget", type=float, metavar="MB",
+                    help="with --plan: emit PC002 when the predicted "
+                         "persistent HBM footprint exceeds this budget")
     ap.add_argument("--catalog", action="store_true",
                     help="print the diagnostic catalog and exit")
+    ap.add_argument("--catalog-md", action="store_true",
+                    help="print the generated docs/analysis.md catalog "
+                         "section and exit")
     args = ap.parse_args(argv)
 
     if args.catalog:
         _print_catalog()
+        return 0
+    if args.catalog_md:
+        from .analysis import catalog_markdown
+        print(catalog_markdown())
         return 0
     if not args.app:
         ap.print_usage(sys.stderr)
@@ -64,15 +103,34 @@ def main(argv=None) -> int:
             return 2
         name = args.app
 
-    from .analysis import analyze
-    result = analyze(text, engine=args.engine)
+    if args.plan:
+        try:
+            result = _plan_result(text, args.engine, args.hbm_budget)
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print(f"error: plan build failed: {e}", file=sys.stderr)
+            return 1
+    else:
+        from .analysis import analyze
+        result = analyze(text, engine=args.engine)
 
     if args.json:
-        print(json.dumps({"app": result.app_name,
-                          "ok": result.ok,
-                          "diagnostics": result.as_dicts()}, indent=1))
+        doc = {"app": result.app_name,
+               "ok": result.ok,
+               "diagnostics": result.as_dicts()}
+        plan = getattr(result, "plan", None)
+        if plan is not None:
+            doc["plan"] = plan.as_dict()
+        print(json.dumps(doc, indent=1))
     else:
         print(result.render(name))
+        plan = getattr(result, "plan", None)
+        if plan is not None:
+            c = plan.cost
+            print(f"plan: {len(plan.plan.automata)} automaton/automata, "
+                  f"{len(plan.plan.programs)} program(s), "
+                  f"{plan.pruned_states} state(s) pruned, "
+                  f"predicted HBM {c.total_hbm_bytes} B, "
+                  f"~{c.total_flops_per_event} FLOPs/event")
 
     if result.errors or (args.strict and result.warnings):
         return 1

@@ -219,7 +219,7 @@ class AggregationRuntime:
             ts_col = chunk.timestamps
         key_cols = [np.asarray(g.fn(ctx)) for g in self.group_exprs]
         base_vals = []
-        for fn, arg in zip(self.base_fns, self.base_args):
+        for _fn, arg in zip(self.base_fns, self.base_args):
             if arg is None:
                 base_vals.append(None)
             else:
@@ -291,7 +291,7 @@ class AggregationRuntime:
             if o.name in cols:
                 continue
             arr = np.empty(k, object)
-            for i, (b_ts, key, slots) in enumerate(rows):
+            for i, (_b_ts, key, slots) in enumerate(rows):
                 if o.kind == "group":
                     arr[i] = key[o.group_idx]
                 elif o.kind == "last":
@@ -400,7 +400,8 @@ def _eval_per(per, probe_row=None) -> str:
     except Exception:
         # `per` may now flow from event data (per i.perValue): a bad value
         # is a store-query error, not a parse-time one
-        raise StoreQueryCreationError(f"Bad per duration {word!r}")
+        raise StoreQueryCreationError(
+            f"Bad per duration {word!r}") from None
 
 
 _DATE_FORMATS = ["%Y-%m-%d %H:%M:%S %z", "%Y-%m-%d %H:%M:%S",
@@ -463,6 +464,7 @@ def _eval_within(within, probe_row=None) -> Tuple[int, int]:
                 raise ValueError(s)
             return (int(lo.timestamp() * 1000), int(hi.timestamp() * 1000))
         except ValueError:
-            raise StoreQueryCreationError(f"Bad within pattern {s!r}")
+            raise StoreQueryCreationError(
+                f"Bad within pattern {s!r}") from None
     t = _parse_time_point(w)
     return (t, 2**62)

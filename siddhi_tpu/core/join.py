@@ -394,8 +394,8 @@ class JoinRuntime:
             # warm trace at [1, 1] so untraceable conditions (functions,
             # scripts, table membership) reject at build time
             warm = {}
-            for (keys, names), s in ((refs[0], self.left),
-                                     (refs[1], self.right)):
+            for (_keys, names), s in ((refs[0], self.left),
+                                      (refs[1], self.right)):
                 warm[s.side] = {
                     nm: jnp.zeros((1,), jnp.int32 if nm.startswith("__")
                                   else jnp.float32)

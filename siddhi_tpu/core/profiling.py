@@ -34,7 +34,7 @@ from typing import Any, Callable, Dict, List, Optional
 class KernelStats:
     __slots__ = ("name", "calls", "compile_count", "dispatch_ns",
                  "device_ns", "batch_events", "h2d_bytes", "d2h_bytes",
-                 "max_batch", "signatures")
+                 "max_batch", "signatures", "live_bytes")
 
     def __init__(self, name: str):
         self.name = name
@@ -47,6 +47,10 @@ class KernelStats:
         self.d2h_bytes = 0
         self.max_batch = 0
         self.signatures: set = set()
+        # persistent device state bytes (a gauge, not a counter): set by
+        # the carry-placement sites; the measured side of the static cost
+        # model's HBM prediction (analysis/cost_model.py, bench.py)
+        self.live_bytes = 0
 
     def as_dict(self) -> Dict[str, Any]:
         return {"calls": self.calls,
@@ -56,7 +60,8 @@ class KernelStats:
                 "batch_events": self.batch_events,
                 "max_batch": self.max_batch,
                 "h2d_bytes": self.h2d_bytes,
-                "d2h_bytes": self.d2h_bytes}
+                "d2h_bytes": self.d2h_bytes,
+                "live_bytes": self.live_bytes}
 
 
 def _signature(args) -> tuple:
@@ -205,6 +210,14 @@ class KernelProfiler:
             return
         self.stats(name).d2h_bytes += int(nbytes)
 
+    def set_live_bytes(self, name: str, nbytes: int):
+        """Gauge: current persistent device state owned by a kernel
+        (carry slabs, rings, capture banks).  Overwritten on growth/
+        restore so it always reflects the live footprint."""
+        if not self.enabled:
+            return
+        self.stats(name).live_bytes = int(nbytes)
+
     # ------------------------------------------------------------ reads
 
     def snapshot(self) -> Dict[str, Dict[str, Any]]:
@@ -224,6 +237,7 @@ class KernelProfiler:
                          f"{lb} {st.dispatch_ns / 1e9:.9g}")
             lines.append(f"siddhi_kernel_h2d_bytes_total{lb} {st.h2d_bytes}")
             lines.append(f"siddhi_kernel_d2h_bytes_total{lb} {st.d2h_bytes}")
+            lines.append(f"siddhi_kernel_live_bytes{lb} {st.live_bytes}")
             lines.append(
                 f"siddhi_kernel_batch_events_total{lb} {st.batch_events}")
         return lines

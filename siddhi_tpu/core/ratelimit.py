@@ -72,7 +72,7 @@ class _EventCountLimiter(Processor):
             self.counter += 1
             if self.counter % self.n == 0:
                 if self.mode == "last":
-                    for key, (r, _) in self.last_per_group.items():
+                    for (r, _) in self.last_per_group.values():
                         self.send_next(r)
                 self.last_per_group.clear()
 

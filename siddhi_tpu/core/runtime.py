@@ -567,6 +567,27 @@ class SiddhiManager:
         with trace_span("plan", cat="compile", app=app.name or "?"):
             rt = SiddhiAppRuntime(app, self.siddhi_context, app_string)
         rt.analysis = analysis
+        # plan-level verifier (analysis/plan_verify.py): automaton
+        # well-formedness + liveness-pruning report + static cost model
+        # over the COMPILED plan; findings merge into rt.analysis and the
+        # full report rides rt.analysis.plan (and GET /stats).  The jaxpr
+        # sanitizer is opt-in (analyze --plan) — tracing every step here
+        # would tax app creation.
+        try:
+            from ..analysis.plan_verify import attach_plan_analysis
+            with trace_span("plan.verify", cat="compile"):
+                attach_plan_analysis(rt)
+        except Exception:   # noqa: BLE001 — advisory pass must never
+            # take down app creation (strict mode excepted below)
+            if strict:
+                rt.shutdown()
+                raise
+        if strict and rt.analysis is not None:
+            try:
+                rt.analysis.raise_if(strict=True)
+            except Exception:
+                rt.shutdown()
+                raise
         self.runtimes[rt.name] = rt
         return rt
 

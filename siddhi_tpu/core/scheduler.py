@@ -55,7 +55,7 @@ class Scheduler:
         with self._lock:
             while self._heap and self._heap[0][0] <= now:
                 due.append(heapq.heappop(self._heap))
-        for ts, _, target in due:
+        for _ts, _, target in due:
             try:
                 target(now)
             except Exception:  # noqa: BLE001 — scheduler thread must survive

@@ -223,7 +223,7 @@ class QuerySelector(Processor):
 
         if self.order_by:
             keys = []
-            for name, asc in reversed(self.order_by):
+            for name, _asc in reversed(self.order_by):
                 col = out.columns[name]
                 keys.append(col)
             idx = np.arange(len(out))
@@ -265,7 +265,7 @@ class QuerySelector(Processor):
         # RESET rows reset every group's state
         if reset_mask.any():
             # process per-row in order, handling resets globally
-            for pos, i in enumerate(idx_active):
+            for i in idx_active:
                 if reset_mask[i]:
                     self._agg_states.clear()
             # fall through to grouped processing (resets already applied
@@ -285,7 +285,7 @@ class QuerySelector(Processor):
                     states = [spec.new_instance() for spec in self.agg_specs]
                     self._agg_states[key] = states
                 tps = chunk.types[rows_arr]
-                for si, spec in enumerate(self.agg_specs):
+                for si, _spec in enumerate(self.agg_specs):
                     vals = None
                     if arg_vals[si] is not None:
                         v = arg_vals[si]
@@ -301,7 +301,7 @@ class QuerySelector(Processor):
             key = keys[pos]
             if types[i] == RESET:
                 for states in self._agg_states.values():
-                    for si, spec in enumerate(self.agg_specs):
+                    for si, _spec in enumerate(self.agg_specs):
                         v = arg_vals[si]
                         vals = None if v is None else np.asarray(
                             [v[i] if isinstance(v, np.ndarray) and v.ndim > 0
@@ -312,7 +312,7 @@ class QuerySelector(Processor):
             if states is None:
                 states = [spec.new_instance() for spec in self.agg_specs]
                 self._agg_states[key] = states
-            for si, spec in enumerate(self.agg_specs):
+            for si, _spec in enumerate(self.agg_specs):
                 v = arg_vals[si]
                 vals = None if v is None else np.asarray(
                     [v[i] if isinstance(v, np.ndarray) and v.ndim > 0 else v])

@@ -152,6 +152,10 @@ def _land_static(spec: NfaSpec, j_from: int):
 
 
 def make_carry(spec: NfaSpec, n_partitions: int) -> Dict[str, jnp.ndarray]:
+    # NOTE: the static cost model (analysis/cost_model.nfa_state_bytes)
+    # mirrors these shapes closed-form and is asserted BYTE-EXACT against
+    # the arrays allocated here (tests/test_plan_verify.py) — adding or
+    # resizing a carry array must update both, or that test fails.
     P, K = n_partitions, spec.n_slots
     R, C = max(spec.n_rows, 1), max(spec.n_caps, 1)
     carry = {

@@ -282,8 +282,8 @@ class JoinLanes:
         elif self.str_attrs:
             per: List[Tuple[Dict, str, np.ndarray]] = []
             pool: List[np.ndarray] = []
-            for cols, lanes, n in ((left_cols, lanes_l, nl),
-                                   (right_cols, lanes_r, nr)):
+            for cols, lanes, _n in ((left_cols, lanes_l, nl),
+                                    (right_cols, lanes_r, nr)):
                 for a in sorted(self.str_attrs):
                     col = cols.get(a)
                     if col is None:
@@ -306,8 +306,8 @@ class JoinLanes:
                 for lanes, n in ((lanes_l, nl), (lanes_r, nr)):
                     lanes[f"__sc{i}_lo"] = np.full(n, lo, np.int32)
                     lanes[f"__sc{i}_hi"] = np.full(n, hi, np.int32)
-        for cols, lanes, n in ((left_cols, lanes_l, nl),
-                               (right_cols, lanes_r, nr)):
+        for cols, lanes, _n in ((left_cols, lanes_l, nl),
+                                (right_cols, lanes_r, nr)):
             for a in sorted(self.dbl_attrs):
                 col = cols.get(a)
                 if col is None:

@@ -24,6 +24,7 @@ core/pattern.py with a recorded reason for anything else):
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -310,17 +311,283 @@ def _walk_filter_constants(units: List[_UnitDesc]) -> List:
     return found
 
 
+def _fold_const(e):
+    """Best-effort constant folding: (True, value) when the expression is
+    a compile-time constant, else (False, None).  Mirrors the reference
+    null law (any null operand makes a comparison false)."""
+    from ..query_api.expression import (And, Compare, CompareOp, IsNull,
+                                        MathExpr, MathOp, Not, Or)
+    if isinstance(e, (Constant, TimeConstant)):
+        return True, e.value
+    if isinstance(e, Not):
+        ok, v = _fold_const(e.expr)
+        return (True, not v) if ok and isinstance(v, bool) else (False, None)
+    if isinstance(e, And) or isinstance(e, Or):
+        lok, lv = _fold_const(e.left)
+        rok, rv = _fold_const(e.right)
+        is_and = isinstance(e, And)
+        for ok, v in ((lok, lv), (rok, rv)):
+            if ok and isinstance(v, bool) and v != is_and:
+                return True, v          # short-circuit dominator
+        if lok and rok and isinstance(lv, bool) and isinstance(rv, bool):
+            return True, (lv and rv) if is_and else (lv or rv)
+        return False, None
+    if isinstance(e, IsNull):
+        if e.expr is not None:
+            ok, v = _fold_const(e.expr)
+            if ok:
+                return True, v is None
+        return False, None
+    if isinstance(e, Compare):
+        lok, lv = _fold_const(e.left)
+        rok, rv = _fold_const(e.right)
+        if not (lok and rok):
+            return False, None
+        if lv is None or rv is None:
+            return True, False          # reference: null compares false
+        try:
+            return True, {
+                CompareOp.LT: lambda a, b: a < b,
+                CompareOp.GT: lambda a, b: a > b,
+                CompareOp.LTE: lambda a, b: a <= b,
+                CompareOp.GTE: lambda a, b: a >= b,
+                CompareOp.EQ: lambda a, b: a == b,
+                CompareOp.NEQ: lambda a, b: a != b,
+            }[e.op](lv, rv)
+        except TypeError:
+            return False, None
+    if isinstance(e, MathExpr):
+        lok, lv = _fold_const(e.left)
+        rok, rv = _fold_const(e.right)
+        if not (lok and rok) or isinstance(lv, (str, bool)) or \
+                isinstance(rv, (str, bool)):
+            return False, None
+        try:
+            return True, {
+                MathOp.ADD: lambda a, b: a + b,
+                MathOp.SUB: lambda a, b: a - b,
+                MathOp.MUL: lambda a, b: a * b,
+                MathOp.DIV: lambda a, b: a / b,
+                MathOp.MOD: lambda a, b: a % b,
+            }[e.op](lv, rv)
+        except (TypeError, ZeroDivisionError):
+            return False, None
+    return False, None
+
+
+def _fold_bool(e) -> Optional[bool]:
+    """Fold a filter expression to a constant boolean, or None."""
+    ok, v = _fold_const(e)
+    return v if ok and isinstance(v, bool) else None
+
+
+def _simplify_expr(e, changed: List[int]):
+    """Boolean simplification: fold constant subtrees out of And/Or/Not
+    (`x and 2 > 1` -> `x`).  Purely semantics-preserving — the compiled
+    condition is the same function with less trace work.  Increments
+    changed[0] per rewrite."""
+    from ..query_api.expression import And, Not, Or
+    if isinstance(e, (And, Or)):
+        left = _simplify_expr(e.left, changed)
+        right = _simplify_expr(e.right, changed)
+        is_and = isinstance(e, And)
+        lv, rv = _fold_bool(left), _fold_bool(right)
+        for v, other in ((lv, right), (rv, left)):
+            if v is not None:
+                changed[0] += 1
+                if v == is_and:          # neutral operand drops out
+                    return other
+                return Constant(v, "bool")      # dominator
+        if left is e.left and right is e.right:
+            return e
+        return And(left, right) if is_and else Or(left, right)
+    if isinstance(e, Not):
+        inner = _simplify_expr(e.expr, changed)
+        v = _fold_bool(inner)
+        if v is not None:
+            changed[0] += 1
+            return Constant(not v, "bool")
+        return e if inner is e.expr else Not(inner)
+    return e
+
+
+def _referenced_names(units: List[_UnitDesc], query,
+                      skip_side: _Side) -> set:
+    """Every stream_id a Variable mentions in the chain's filters (other
+    than skip_side's own) or the select clause — the conservative "is
+    this capture addressed anywhere" test the pruner uses."""
+    names: set = set()
+
+    def note(v: Variable):
+        if v.stream_id:
+            names.add(v.stream_id)
+    for u in units:
+        for side in u.sides:
+            if side is skip_side:
+                continue
+            for fe in side.filters:
+                _scan_vars(fe, note)
+    for oa in query.selector.attributes:
+        _scan_vars(oa.expr, note)
+    return names
+
+
+def _prune_chain(low: _Lowering, query) -> Dict[str, Any]:
+    """Liveness pruning over the lowered unit chain, BEFORE capture-row
+    allocation and condition compilation (so everything downstream —
+    lane layout, cond programs, NfaSpec — is built from the pruned
+    chain and stays internally consistent).
+
+    Match-output equivalence (asserted on randomized feeds in
+    tests/test_plan_verify.py):
+
+      * a filter folding to constant TRUE is dropped (the condition
+        without it is identical);
+      * an `or` side folding to constant FALSE can never match its
+        side, so the unit degrades to a simple unit of the live side —
+        guarded on the dead side's captures being referenced nowhere;
+      * a min-0 kleene whose condition folds FALSE can never append:
+        its only viable path is the epsilon skip `_land_static` already
+        takes, so the unit is deleted outright (same guard, plus chain-
+        adjacency rules so no host-only shape is created);
+      * any NON-skippable unit whose condition folds FALSE makes accept
+        unreachable — the chain is a straight line, partials only move
+        forward — so the whole automaton is dead: the engine skips the
+        device step (zero matches either way).
+
+    Returns the prune report {pruned_states, simplified, dead, notes}.
+    """
+    report: Dict[str, Any] = {"pruned_states": 0, "simplified": 0,
+                              "dead": False, "notes": []}
+    units = low.units
+
+    # ---- pass 1: simplify + fold filters per side
+    false_sides: Dict[int, List[_Side]] = {}
+    for ui, u in enumerate(units):
+        for side in u.sides:
+            kept = []
+            side_false = False
+            changed = [0]
+            for fe in side.filters:
+                fe = _simplify_expr(fe, changed)
+                v = _fold_bool(fe)
+                if v is True:
+                    changed[0] += 1
+                    report["notes"].append(
+                        f"s{ui}/{side.ref}: dropped constant-true filter")
+                    continue
+                if v is False:
+                    side_false = True
+                kept.append(fe)
+            report["simplified"] += changed[0]
+            if changed[0]:
+                report["notes"].append(
+                    f"s{ui}/{side.ref}: folded {changed[0]} constant "
+                    f"boolean subtree(s)")
+            if not side_false:
+                # only mutate when provably harmless: constant subtrees
+                # folded out, everything else identical
+                side.filters = kept
+            else:
+                false_sides.setdefault(ui, []).append(side)
+
+    # ---- pass 2: unit satisfiability (can a partial ever pass it?)
+    for ui, u in enumerate(units):
+        dead_here = False
+        fs = false_sides.get(ui, [])
+        if u.kind == "simple" and fs:
+            dead_here = True
+        elif u.kind == "count" and fs and u.min_count >= 1:
+            dead_here = True
+        elif u.kind == "logical" and fs:
+            dead_here = u.is_and or len(fs) == len(u.sides)
+        # absent: a false condition only means no arrival can ever kill
+        # the wait — the absence always confirms; the unit stays live
+        if dead_here:
+            report["dead"] = True
+            report["notes"].append(
+                f"s{ui} ({u.kind}) condition folds to constant false: "
+                f"accept unreachable, automaton dead")
+    if report["dead"]:
+        return report
+
+    # ---- pass 3: structural prunes (skippable dead pieces)
+
+    def is_referenced(side: _Side) -> bool:
+        names = _referenced_names(units, query, side)
+        return side.ref in names or side.stream_id in names
+
+    # or-units with exactly one dead side degrade to simple
+    for ui, u in enumerate(units):
+        fs = false_sides.get(ui, [])
+        if u.kind == "logical" and not u.is_and and len(fs) == 1:
+            dead = fs[0]
+            live = next(s for s in u.sides if s is not dead)
+            if is_referenced(dead):
+                report["notes"].append(
+                    f"s{ui}: dead `or` side {dead.ref} kept "
+                    f"(referenced in select/conditions)")
+                continue
+            u.kind = "simple"
+            u.sides = [live]
+            u.is_and = False
+            report["pruned_states"] += 1
+            report["notes"].append(
+                f"s{ui}: `or` side {dead.ref} can never match — "
+                f"degraded to simple({live.ref})")
+
+    # dead min-0 kleene units delete outright (epsilon path only)
+    structural_ok = (not low.mid_every and low.tail_every_start < 0)
+    j = len(units) - 1
+    while j >= 1:
+        u = units[j]
+        fs = false_sides.get(j, [])
+        if u.kind == "count" and u.min_count == 0 and fs and \
+                structural_ok and \
+                not (low.is_every and j <= low.every_group_end):
+            side = u.sides[0]
+            prev_k = units[j - 1].kind
+            next_k = units[j + 1].kind if j + 1 < len(units) else None
+            adjacency_safe = not (
+                prev_k == "count" and next_k in ("count", "absent"))
+            if adjacency_safe and not is_referenced(side):
+                units.pop(j)
+                report["pruned_states"] += 1
+                report["notes"].append(
+                    f"s{j}: min-0 kleene {side.ref} can never append — "
+                    f"state deleted, transition matrices shrink")
+            elif not adjacency_safe:
+                report["notes"].append(
+                    f"s{j}: dead min-0 kleene kept (deletion would "
+                    f"create a host-only adjacency)")
+            else:
+                report["notes"].append(
+                    f"s{j}: dead min-0 kleene {side.ref} kept "
+                    f"(referenced in select/conditions)")
+        j -= 1
+    return report
+
+
+PRUNE_ENV = "SIDDHI_TPU_NFA_PRUNE"
+
+
 class CompiledPatternNFA:
     """One pattern query compiled for batched multi-partition execution."""
 
     def __init__(self, app_string, n_partitions: int,
                  n_slots: int = 8, query_name: Optional[str] = None,
                  parameterize: bool = False, query: Optional[Query] = None,
-                 mesh: Any = "auto"):
+                 mesh: Any = "auto", prune: Optional[bool] = None):
         """mesh: "auto" (default) shards the partition axis over all local
         devices when more than one exists (parallel/mesh.auto_mesh); a
         jax.sharding.Mesh pins an explicit mesh; None forces single-device.
-        The partition lane count rounds up to a mesh-size multiple."""
+        The partition lane count rounds up to a mesh-size multiple.
+
+        prune: liveness pruning over the unit chain (on by default; env
+        SIDDHI_TPU_NFA_PRUNE=0 disables globally — the unpruned baseline
+        the equivalence tests diff against).  Pattern-bank mode
+        (parameterize=True) always compiles unpruned: folding constants
+        out of filters would desync the per-pattern parameter lanes."""
         app = (SiddhiCompiler.parse(app_string)
                if isinstance(app_string, str) else app_string)
         self.app = app
@@ -331,6 +598,14 @@ class CompiledPatternNFA:
             raise SiddhiAppCreationError(
                 "TPU NFA path needs a PATTERN/SEQUENCE query")
         low = _Lowering(sis, app)
+        if prune is None:
+            prune = os.environ.get(PRUNE_ENV, "1") != "0"
+        self.prune_enabled = bool(prune) and not parameterize
+        if self.prune_enabled:
+            self.prune_report = _prune_chain(low, query)
+        else:
+            self.prune_report = {"pruned_states": 0, "simplified": 0,
+                                 "dead": False, "notes": []}
         self.units = low.units
         self.is_sequence = sis.state_type == StateType.SEQUENCE
         if self.units[0].kind == "absent" and self.is_sequence:
@@ -366,6 +641,19 @@ class CompiledPatternNFA:
         if low.group_within is not None:
             within_ms = (low.group_within if within_ms is None
                          else min(within_ms, low.group_within))
+
+        # statically-dead plans (pruner-proven constant-false condition,
+        # or the SEQUENCE dead-start family — both reach accept never):
+        # the engine path skips the device step entirely; match output is
+        # identically empty either way (equivalence test-asserted)
+        if self.seq_dead_start and self.prune_enabled and \
+                not self.prune_report["dead"]:
+            self.prune_report["dead"] = True
+            self.prune_report["notes"].append(
+                "SEQUENCE leading kleene min>=2: per-event barrier kills "
+                "every sub-min accumulator — automaton dead")
+        self.statically_dead = bool(self.prune_enabled and
+                                    self.prune_report["dead"])
 
         # stream codes: order of first appearance
         self.stream_codes: Dict[str, int] = {}
@@ -421,7 +709,7 @@ class CompiledPatternNFA:
         self.row_unit = [self.ref_to_unit[s.ref] for s in rows]
         # rows whose captures may legitimately be absent in a match
         self.nullable_rows: set = set()
-        for ui, u in enumerate(self.units):
+        for u in self.units:
             if u.kind == "count" and u.min_count == 0:
                 self.nullable_rows.add(u.sides[0].row)
             if u.kind == "logical" and not u.is_and:
@@ -585,7 +873,7 @@ class CompiledPatternNFA:
         lastk_banks: List[Tuple] = []    # per row: ((j, start), ...)
         m_src: List[Tuple[int, ...]] = []  # per row: l-bank source lanes
         n_last: List[int] = []
-        for r, side in enumerate(rows):
+        for r in range(len(rows)):
             unit = self.units[self.row_unit[r]]
             fcols = sorted(needed_f[r])
             lcols = sorted(needed_l[r]) if unit.kind == "count" else []
@@ -658,7 +946,7 @@ class CompiledPatternNFA:
         unit_specs: List[UnitSpec] = []
         self._n_lane = n_lane
         self._matched_lane = matched_lane
-        for ui, u in enumerate(self.units):
+        for u in self.units:
             ids = []
             for side in u.sides:
                 side.cond_id = len(cond_fns)
@@ -1158,11 +1446,22 @@ class CompiledPatternNFA:
 
     def _place_carry(self, carry: Dict[str, Any]) -> Dict[str, jnp.ndarray]:
         """Device placement: partition-axis sharded over the mesh when one
-        is set (parallel/mesh.py), plain device arrays otherwise."""
+        is set (parallel/mesh.py), plain device arrays otherwise.  When
+        profiling is on, the placed carry's total bytes feed the
+        KernelProfiler ``live_bytes`` gauge — the measured side of the
+        static cost model's HBM prediction (analysis/cost_model.py)."""
         if self.mesh is None:
-            return {k: jnp.asarray(v) for k, v in carry.items()}
-        from ..parallel.mesh import shard_carry
-        return shard_carry(carry, self.mesh)
+            placed = {k: jnp.asarray(v) for k, v in carry.items()}
+        else:
+            from ..parallel.mesh import shard_carry
+            placed = shard_carry(carry, self.mesh)
+        from ..core.profiling import profiler
+        prof = profiler()
+        if prof.enabled:
+            prof.set_live_bytes(
+                "nfa.step" if self.mesh is None else "nfa.mesh_step",
+                sum(int(getattr(v, "nbytes", 0)) for v in placed.values()))
+        return placed
 
     @property
     def replayable(self) -> bool:
@@ -1504,9 +1803,10 @@ class CompiledPatternNFA:
                 hik = (row, f"__exhi_{attr}", which)
                 if hik in self.cap_lane:
                     # exact payload: reassemble from companion lanes
-                    g = lambda p: np.rint(caps_f[
-                        :, row,
-                        self.cap_lane[(row, f"__ex{p}_{attr}", which)]])
+                    # (loop state frozen via defaults — B023)
+                    g = lambda p, _r=row, _a=attr, _w=which: np.rint(
+                        caps_f[:, _r,
+                               self.cap_lane[(_r, f"__ex{p}_{_a}", _w)]])
                     v = self._int_exact_join(g("hi"), g("md"), g("lo"))
                 else:
                     v = np.rint(v).astype(np.int64)
@@ -1544,6 +1844,11 @@ class CompiledPatternNFA:
     def process_timer(self, now_ms: int):
         """Inject one virtual TIMER row at absolute time now_ms (absent
         deadlines + within expiry between real events)."""
+        if self.statically_dead:
+            self.last_dropped_total = 0
+            if self.has_absent:
+                self.last_min_deadline = None
+            return []
         if self.base_ts is None:
             self.base_ts = now_ms
         self._maybe_rebase(now_ms, now_ms)
@@ -1566,6 +1871,15 @@ class CompiledPatternNFA:
         flight so the tunnel read round-trip of chunk N overlaps chunk
         N+1's dispatch; the handle carries everything needed to replay the
         block after a slot-ring growth (grow-and-replay)."""
+        if self.statically_dead:
+            # liveness pruning proved accept unreachable: zero matches on
+            # any input, so the kernel dispatch is skipped outright (the
+            # chunk is neither packed nor shipped)
+            if self.base_ts is None:
+                self.base_ts = int(timestamps[0]) if len(timestamps) else 0
+            return {"dead": True, "pre_carry": self.carry,
+                    "pre_base": self.base_ts, "base_ts": self.base_ts,
+                    "ts_range": None, "block": None}
         if self.base_ts is None:
             self.base_ts = int(timestamps[0]) if len(timestamps) else 0
         ts_range = None
@@ -1604,6 +1918,8 @@ class CompiledPatternNFA:
     def replay_block(self, h: dict) -> dict:
         """Re-dispatch a handle's block against the current carry (after a
         grow_slots); re-applies the rebase its original dispatch did."""
+        if h.get("dead"):
+            return h
         if h["ts_range"] is not None:
             self._maybe_rebase(*h["ts_range"])
         outs = self.process_block(h["block"])
@@ -1615,6 +1931,15 @@ class CompiledPatternNFA:
     def retire_events(self, h: dict):
         """Block on a dispatched handle → (pids, ts, columns) in emission
         order (columnar decode).  Sets self.last_dropped_total."""
+        if h.get("dead"):
+            self.last_dropped_total = 0
+            if self.has_absent:
+                self.last_min_deadline = None
+            R = max(self.spec.n_rows, 1)
+            C = max(self.spec.n_caps, 1)
+            return self.decode_compact_columns(
+                np.zeros((0, 4 + R * C), np.int32),
+                (1, self.spec.n_slots), base_ts=h["base_ts"])
         rows, tk = self.egress_retire(h)
         return self.decode_compact_columns(rows, tk,
                                            base_ts=h["base_ts"])
@@ -1632,6 +1957,9 @@ class CompiledPatternNFA:
                                  stream_names=stream_names,
                                  stream_codes=stream_codes,
                                  pad_t_pow2=pad_t_pow2)
+        if h.get("dead"):
+            self.last_dropped_total = 0
+            return []
         return self._decode_compact(*self.egress_retire(h))
 
     def _ts_safe_max(self) -> int:
@@ -1758,7 +2086,15 @@ class CompiledPatternBank:
         self.carries = [make_bank_carry(self.nfa.spec, self.chunk,
                                         n_partitions)
                         for _ in range(self.n_chunks)]
-        from ..core.profiling import wrap_kernel
+        from ..core.profiling import profiler, wrap_kernel
+        if profiler().enabled:
+            # logical carry footprint (broadcast views materialize dense
+            # on the first donated step) — the measured side of the cost
+            # model's bank_state_bytes prediction
+            profiler().set_live_bytes(
+                "nfa.bank_step",
+                sum(int(getattr(v, "nbytes", 0))
+                    for c in self.carries for v in c.values()))
         self._step = wrap_kernel(
             "nfa.bank_step",
             jax.jit(build_bank_step(self.nfa.spec, ring=self.ring),

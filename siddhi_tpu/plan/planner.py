@@ -546,7 +546,7 @@ class DeviceWindowedAggRuntime(PipelinedDeviceIngest):
             raise
         except Exception as e:
             raise SiddhiAppCreationError(
-                f"device wagg path: kernel compile failed ({e})")
+                f"device wagg path: kernel compile failed ({e})") from e
         self.head = qr._finish_device_chain(out_def, factory)
 
         recv = ProcessStreamReceiver(
@@ -926,7 +926,8 @@ class DeviceFilterRuntime(PipelinedDeviceIngest):
         try:
             filter_exprs = [slanes.rewrite(h.expr) for h in sis.handlers]
         except StringRewriteError as se:
-            raise SiddhiAppCreationError(f"device filter path: {se}")
+            raise SiddhiAppCreationError(
+                f"device filter path: {se}") from se
         out_rewritten = {}
         for oa in sel_attrs:
             try:
@@ -1039,7 +1040,7 @@ class DeviceFilterRuntime(PipelinedDeviceIngest):
             raise
         except Exception as e:
             raise SiddhiAppCreationError(
-                f"device filter path: program compile failed ({e})")
+                f"device filter path: program compile failed ({e})") from e
 
         recv = ProcessStreamReceiver(
             _DeviceIngress(self, 0, sis.stream_id), qr.lock,

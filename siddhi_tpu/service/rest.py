@@ -166,5 +166,10 @@ class SiddhiService:
             # within bound" next to the runtime counters it explains
             if rt.analysis is not None:
                 doc["analysis"] = rt.analysis.as_dicts()
+                # plan-level report: automaton shapes, pruned-state
+                # counts, predicted HBM/FLOP cost (analysis/plan_verify)
+                plan = getattr(rt.analysis, "plan", None)
+                if plan is not None:
+                    doc["plan"] = plan.as_dict()
             apps[name] = doc
         return {"apps": apps, "kernels": profiler().snapshot()}

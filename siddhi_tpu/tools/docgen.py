@@ -73,8 +73,8 @@ def generate_markdown(extension_registry=None) -> str:
     seen = set()
     metas = list(EXTENSION_METADATA.values())
     if extension_registry is not None:
-        for n, impl in sorted(getattr(extension_registry,
-                                      "_by_name", {}).items()):
+        for _n, impl in sorted(getattr(extension_registry,
+                                       "_by_name", {}).items()):
             m = getattr(impl, "__extension_meta__", None)
             if m is not None and m.key not in EXTENSION_METADATA:
                 metas.append(m)

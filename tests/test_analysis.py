@@ -362,6 +362,19 @@ def test_catalog_docs_cover_every_code():
         assert code in text, f"docs/analysis.md missing {code}"
 
 
+def test_catalog_docs_are_generated_verbatim():
+    """docs/analysis.md embeds catalog_markdown() output verbatim, so
+    the document can never drift from diagnostics.CATALOG — adding a
+    code without regenerating (`python -m siddhi_tpu.analyze
+    --catalog-md`) fails here."""
+    from siddhi_tpu.analysis import catalog_markdown
+    text = open(os.path.join(os.path.dirname(__file__), "..", "docs",
+                             "analysis.md")).read()
+    assert catalog_markdown() in text, (
+        "docs/analysis.md catalog section is stale — regenerate with "
+        "python -m siddhi_tpu.analyze --catalog-md")
+
+
 # ------------------------------------------- SP001 vs KernelProfiler (e2e)
 
 def test_sp001_prediction_matches_kernel_profiler_retraces():

@@ -53,7 +53,7 @@ def test_ring_concurrent_producers():
     r = ColumnarRing(capacity=100_000, n_cols=1)
 
     def producer(tid):
-        for i in range(100):
+        for _ in range(100):
             r.push(np.full((10, 1), float(tid)), np.arange(10),
                    np.zeros(10, np.int32), np.zeros(10, np.int32))
     threads = [threading.Thread(target=producer, args=(t,))

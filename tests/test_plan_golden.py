@@ -1,0 +1,127 @@
+"""Golden-file Plan-IR dumps for every shipped sample.
+
+Each SiddhiQL app embedded in samples/*.py is built into a runtime, its
+compiled plan extracted (analysis/plan_ir.py) and rendered with the
+stable textual dump; the result is pinned under tests/golden/.  A
+planner refactor that changes what actually compiles — a query silently
+falling off the device path, an automaton gaining a state, a capture
+bank widening — shows up as a reviewable golden diff instead of a
+throughput mystery three rounds later.
+
+Regenerate after an INTENTIONAL planner change with:
+
+    REGEN_PLAN_GOLDEN=1 python -m pytest tests/test_plan_golden.py
+
+Acceptance rider: every sample must be PV-error-free (the plan verifier
+finds no malformed/dead automata in shipped showcase code).
+"""
+import ast
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from siddhi_tpu import SiddhiManager  # noqa: E402
+from siddhi_tpu.analysis import Severity, extract_plan, verify_plan  # noqa: E402
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SAMPLES_DIR = os.path.join(ROOT, "samples")
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "golden")
+REGEN = os.environ.get("REGEN_PLAN_GOLDEN") == "1"
+
+
+def _apps_in(path):
+    """SiddhiQL app literals in a sample .py (same extraction as
+    test_samples_analysis): plain strings verbatim, f-string slots tried
+    as '0' then '' keeping the variant that parses."""
+    tree = ast.parse(open(path).read())
+    apps = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if "define stream" in node.value and ";" in node.value:
+                apps.append([node.value])
+        elif isinstance(node, ast.JoinedStr):
+            variants = []
+            for filler in ("0", ""):
+                text = "".join(str(v.value) if isinstance(v, ast.Constant)
+                               else filler for v in node.values)
+                variants.append(text)
+            if "define stream" in variants[0] and ";" in variants[0]:
+                apps.append(variants)
+    return [v for v in apps
+            if not any(v is not w and v[0] in w[0] for w in apps)]
+
+
+def _sample_files():
+    return sorted(f for f in os.listdir(SAMPLES_DIR) if f.endswith(".py"))
+
+
+def _manager():
+    """Manager with the extensions the samples register at runtime
+    (quickstart_extension's custom:plus), so its app builds here too."""
+    from siddhi_tpu.query_api.definition import AttrType
+    from siddhi_tpu.utils.extension import FunctionExtension
+
+    class _Plus(FunctionExtension):
+        return_type = AttrType.DOUBLE
+
+        def apply(self, *cols):
+            out = cols[0]
+            for c in cols[1:]:
+                out = out + c
+            return out
+
+    m = SiddhiManager()
+    m.set_extension("custom:plus", _Plus)
+    return m
+
+
+def _build_plan(variants):
+    """First parseable variant -> (dump text, verifier diagnostics)."""
+    m = _manager()
+    last = None
+    for text in variants:
+        try:
+            rt = m.create_siddhi_app_runtime(text)
+        except Exception as e:  # noqa: BLE001 — try the next variant
+            last = e
+            continue
+        try:
+            plan = extract_plan(rt)
+            report = verify_plan(plan)
+            return plan.dump(), report.diagnostics
+        finally:
+            rt.shutdown()
+    raise AssertionError(f"no app variant builds: {last}")
+
+
+@pytest.mark.parametrize("fname", _sample_files())
+def test_sample_plan_matches_golden(fname):
+    apps = _apps_in(os.path.join(SAMPLES_DIR, fname))
+    assert apps, f"{fname}: no SiddhiQL app string found"
+    for i, variants in enumerate(apps):
+        dump, diags = _build_plan(variants)
+        pv_errors = [d for d in diags
+                     if d.code.startswith("PV") and
+                     d.severity == Severity.ERROR]
+        assert not pv_errors, (
+            f"{fname} app #{i} has plan-verifier ERRORS:\n" +
+            "\n".join(d.render(fname) for d in pv_errors))
+        golden = os.path.join(
+            GOLDEN_DIR, f"{fname[:-3]}__app{i}.plan.txt")
+        if REGEN:
+            os.makedirs(GOLDEN_DIR, exist_ok=True)
+            with open(golden, "w") as f:
+                f.write(dump)
+            continue
+        assert os.path.exists(golden), (
+            f"missing golden {os.path.relpath(golden, ROOT)} — run "
+            f"REGEN_PLAN_GOLDEN=1 pytest tests/test_plan_golden.py")
+        want = open(golden).read()
+        assert dump == want, (
+            f"{fname} app #{i}: Plan-IR dump changed.  If the planner "
+            f"change is intentional, regenerate with "
+            f"REGEN_PLAN_GOLDEN=1.\n--- golden\n{want}\n--- now\n{dump}")
