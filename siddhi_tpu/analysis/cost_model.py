@@ -54,6 +54,9 @@ def nfa_state_bytes(a: AutomatonIR,
     R = max(a.n_rows, 1)
     C = max(a.n_caps, 1)
     kinds = {s.kind for s in a.states}
+    # NOTE: the fatter-tick restructuring (batch_b > 1) adds NO persistent
+    # arrays — hoisted gate tensors ([T, n_free] per block) are transient
+    # scan inputs, so the byte-exact contract below is unchanged.
     b: Dict[str, int] = {
         "slot_state": P * K * I32,
         "slot_start": P * K * I32,
@@ -87,11 +90,23 @@ def nfa_egress_bytes(a: AutomatonIR) -> int:
 
 
 def nfa_flops_per_event(a: AutomatonIR) -> int:
-    """Per-ingested-event condition work: every slot of the event's lane
-    evaluates each unit's condition program each step."""
-    per_slot = sum(s.cond_ops * _OPS_PER_COND_NODE + _UNIT_OVERHEAD_OPS
-                   for s in a.states)
-    return per_slot * a.n_slots
+    """Per-ingested-event condition work.
+
+    Legacy one-event ticks (batch_b == 1): every slot of the event's
+    lane evaluates each unit's condition program each step.  With the
+    fatter-tick restructuring (batch_b > 1, ops/nfa round 6) the
+    capture-free portion of each condition is HOISTED out of the scan and
+    evaluated once per event instead of once per (event, slot) — the
+    formula mirrors the real step: hoisted ops cost x1, the residual
+    per-slot ops and fixed unit bookkeeping still cost x n_slots."""
+    per_event = 0
+    for s in a.states:
+        hoisted = min(s.cond_ops_hoisted, s.cond_ops) \
+            if a.batch_b > 1 else 0
+        per_event += hoisted * _OPS_PER_COND_NODE
+        per_event += ((s.cond_ops - hoisted) * _OPS_PER_COND_NODE +
+                      _UNIT_OVERHEAD_OPS) * a.n_slots
+    return per_event
 
 
 def bank_state_bytes(a: AutomatonIR, n_patterns: int,

@@ -52,6 +52,10 @@ class StateIR:
     is_and: bool = False
     cond_ops: int = 0              # expression-node count of the conditions
     rows: Tuple[int, ...] = ()     # capture rows owned by this state
+    cond_ops_hoisted: int = 0      # portion of cond_ops that is capture-
+    #                                free: evaluated ONCE per event in the
+    #                                hoisted block-wide pass instead of
+    #                                per-slot inside the scan (batch mode)
 
 
 @dataclass
@@ -84,6 +88,8 @@ class AutomatonIR:
     prune_notes: Tuple[str, ...] = ()
     egress_cap: int = 1024
     meshed: bool = False
+    batch_b: int = 1              # events per scan tick (ops/nfa fatter
+    #                               ticks; 1 = legacy one-event chain)
 
     @property
     def accept(self) -> int:
@@ -96,6 +102,7 @@ class AutomatonIR:
             "n_slots": self.n_slots, "n_partitions": self.n_partitions,
             "n_rows": self.n_rows, "n_caps": self.n_caps,
             "within_ms": self.within_ms,
+            "batch_b": self.batch_b,
             "pruned_states": self.pruned_states,
             "simplified_conditions": self.simplified_conditions,
             "statically_dead": self.statically_dead,
@@ -148,8 +155,8 @@ class PlanIR:
                 ("DEAD", a.statically_dead)) if on]
             out.append(
                 f"  automaton {a.query}: states={len(a.states)} "
-                f"P={a.n_partitions} K={a.n_slots} R={a.n_rows} "
-                f"C={a.n_caps} within={a.within_ms} "
+                f"P={a.n_partitions} K={a.n_slots} B={a.batch_b} "
+                f"R={a.n_rows} C={a.n_caps} within={a.within_ms} "
                 f"pruned={a.pruned_states} "
                 f"flags=[{','.join(flags)}]")
             for s in a.states:
@@ -211,6 +218,7 @@ def automaton_ir_from_nfa(nfa, query: str) -> AutomatonIR:
     spec = nfa.spec
     units = spec.units
     S = len(units)
+    cond_free = getattr(spec, "cond_free", ()) or ()
     states: List[StateIR] = []
     for i, u in enumerate(units):
         desc = nfa.units[i] if i < len(getattr(nfa, "units", ())) else None
@@ -223,7 +231,11 @@ def automaton_ir_from_nfa(nfa, query: str) -> AutomatonIR:
             min_count=u.min_count, max_count=u.max_count,
             waiting_ms=u.waiting_ms, is_and=u.is_and,
             cond_ops=sum(_cond_ops(s.filters) for s in sides),
-            rows=rows))
+            rows=rows,
+            cond_ops_hoisted=sum(
+                _cond_ops(s.filters) for s in sides
+                if 0 <= getattr(s, "cond_id", -1) < len(cond_free)
+                and cond_free[s.cond_id])))
 
     def land(j: int) -> Tuple[int, bool]:
         """(target, eps_skipped) of an advance out of unit j — the
@@ -273,7 +285,8 @@ def automaton_ir_from_nfa(nfa, query: str) -> AutomatonIR:
         statically_dead=bool(getattr(nfa, "statically_dead", False)),
         prune_notes=tuple(report.get("notes", ())),
         egress_cap=int(getattr(nfa, "_egress_cap", 1024)),
-        meshed=getattr(nfa, "mesh", None) is not None)
+        meshed=getattr(nfa, "mesh", None) is not None,
+        batch_b=max(int(getattr(nfa, "batch_b", 1)), 1))
 
 
 def _array_bytes(obj) -> int:

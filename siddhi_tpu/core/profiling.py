@@ -34,7 +34,8 @@ from typing import Any, Callable, Dict, List, Optional
 class KernelStats:
     __slots__ = ("name", "calls", "compile_count", "dispatch_ns",
                  "device_ns", "batch_events", "h2d_bytes", "d2h_bytes",
-                 "max_batch", "signatures", "live_bytes")
+                 "max_batch", "signatures", "live_bytes", "scan_ticks",
+                 "batch_b")
 
     def __init__(self, name: str):
         self.name = name
@@ -51,6 +52,12 @@ class KernelStats:
         # the carry-placement sites; the measured side of the static cost
         # model's HBM prediction (analysis/cost_model.py, bench.py)
         self.live_bytes = 0
+        # sequential scan ticks issued (counter) and events-per-tick B
+        # (gauge) — set by scan-shaped kernels via a ticks_of hint; the
+        # T→⌈T/B⌉ reduction of the fatter-tick NFA restructuring shows up
+        # here (and is asserted in tests/test_nfa_batch.py)
+        self.scan_ticks = 0
+        self.batch_b = 0
 
     def as_dict(self) -> Dict[str, Any]:
         return {"calls": self.calls,
@@ -61,7 +68,9 @@ class KernelStats:
                 "max_batch": self.max_batch,
                 "h2d_bytes": self.h2d_bytes,
                 "d2h_bytes": self.d2h_bytes,
-                "live_bytes": self.live_bytes}
+                "live_bytes": self.live_bytes,
+                "scan_ticks": self.scan_ticks,
+                "batch_b": self.batch_b}
 
 
 def _signature(args) -> tuple:
@@ -106,16 +115,18 @@ def _host_bytes(args) -> int:
 class ProfiledKernel:
     """Transparent wrapper around a jitted callable."""
 
-    __slots__ = ("fn", "stats", "profiler", "batch_of", "_cache_size_fn",
-                 "_last_cs")
+    __slots__ = ("fn", "stats", "profiler", "batch_of", "ticks_of",
+                 "_cache_size_fn", "_last_cs")
 
     def __init__(self, fn: Callable, stats: KernelStats,
                  profiler: "KernelProfiler",
-                 batch_of: Optional[Callable[..., int]] = None):
+                 batch_of: Optional[Callable[..., int]] = None,
+                 ticks_of: Optional[Callable[..., tuple]] = None):
         self.fn = fn
         self.stats = stats
         self.profiler = profiler
         self.batch_of = batch_of
+        self.ticks_of = ticks_of
         self._cache_size_fn = getattr(fn, "_cache_size", None)
         self._last_cs = 0
 
@@ -155,6 +166,13 @@ class ProfiledKernel:
                     st.batch_events += b
                     if b > st.max_batch:
                         st.max_batch = b
+                except Exception:   # noqa: BLE001 — hint only
+                    pass
+            if self.ticks_of is not None:
+                try:
+                    ticks, bb = self.ticks_of(*args, **kwargs)
+                    st.scan_ticks += int(ticks)
+                    st.batch_b = int(bb)
                 except Exception:   # noqa: BLE001 — hint only
                     pass
             st.h2d_bytes += _host_bytes(args)
@@ -201,9 +219,11 @@ class KernelProfiler:
             return self.kernels.setdefault(name, KernelStats(name))
 
     def wrap(self, name: str, fn: Callable,
-             batch_of: Optional[Callable[..., int]] = None
+             batch_of: Optional[Callable[..., int]] = None,
+             ticks_of: Optional[Callable[..., tuple]] = None
              ) -> ProfiledKernel:
-        return ProfiledKernel(fn, self.stats(name), self, batch_of)
+        return ProfiledKernel(fn, self.stats(name), self, batch_of,
+                              ticks_of)
 
     def record_d2h(self, name: str, nbytes: int):
         if not self.enabled:
@@ -240,6 +260,9 @@ class KernelProfiler:
             lines.append(f"siddhi_kernel_live_bytes{lb} {st.live_bytes}")
             lines.append(
                 f"siddhi_kernel_batch_events_total{lb} {st.batch_events}")
+            lines.append(
+                f"siddhi_kernel_scan_ticks_total{lb} {st.scan_ticks}")
+            lines.append(f"siddhi_kernel_batch_b{lb} {st.batch_b}")
         return lines
 
 
@@ -251,12 +274,15 @@ def profiler() -> KernelProfiler:
 
 
 def wrap_kernel(name: str, fn: Callable,
-                batch_of: Optional[Callable[..., int]] = None
+                batch_of: Optional[Callable[..., int]] = None,
+                ticks_of: Optional[Callable[..., tuple]] = None
                 ) -> ProfiledKernel:
     """Wrap a jitted callable under the process-global profiler.  The
     wrapper is always installed (so later enabling profiles already-built
-    kernels); while disabled it is a single-attribute-check passthrough."""
-    return _GLOBAL.wrap(name, fn, batch_of)
+    kernels); while disabled it is a single-attribute-check passthrough.
+    ``ticks_of(*args) -> (scan_ticks, batch_b)`` lets scan-shaped kernels
+    report their sequential tick count per call."""
+    return _GLOBAL.wrap(name, fn, batch_of, ticks_of)
 
 
 def enable_profiling(device_timing: bool = False):

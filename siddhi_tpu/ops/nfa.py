@@ -42,6 +42,7 @@ is asserted in tests/test_tpu_nfa.py and tests/test_planner.py.
 """
 from __future__ import annotations
 
+import os
 from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
@@ -50,6 +51,27 @@ import numpy as np
 
 NO_SLOT = jnp.int32(-1)
 COUNT_INF = 0x7FFFFFFF
+
+#: B-event micro-batching of the scan chain (round 6).  The env value is
+#: B itself: unset/empty → DEFAULT_BATCH_B; ``=1`` is the kill switch
+#: (legacy one-event ticks, no hoisting — mirrors SIDDHI_TPU_NFA_PRUNE).
+BATCH_ENV = "SIDDHI_TPU_NFA_BATCH"
+DEFAULT_BATCH_B = 4
+
+
+def resolve_batch_b(batch_b: Optional[int] = None) -> int:
+    """Effective events-per-tick B: explicit argument wins, else the
+    BATCH_ENV value, else DEFAULT_BATCH_B.  Anything < 1 (or
+    unparseable) clamps to the legacy/default respectively."""
+    if batch_b is None:
+        raw = os.environ.get(BATCH_ENV, "").strip().lower()
+        if raw in ("", "on", "true", "default"):
+            return DEFAULT_BATCH_B
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            return DEFAULT_BATCH_B
+    return max(1, int(batch_b))
 
 
 class UnitSpec(NamedTuple):
@@ -124,6 +146,15 @@ class NfaSpec(NamedTuple):
     #                                   accumulator never survives — the
     #                                   shape produces ZERO matches (oracle
     #                                   verified); arming is suppressed
+    cond_free: Tuple[bool, ...] = ()  # per cond_fn: True when the program
+    #                                   reads ONLY the current event (no
+    #                                   captures, no __cnt lanes, no
+    #                                   nullable-row gates) — eligible for
+    #                                   block-wide hoisting out of the scan
+    batch_b: int = 0                  # events consumed per scan tick (the
+    #                                   compiler pins resolve_batch_b();
+    #                                   0 → resolve from env at build time,
+    #                                   1 → legacy one-event ticks)
 
     @property
     def n_states(self) -> int:
@@ -202,6 +233,86 @@ def _event_rows(spec: NfaSpec, event) -> jnp.ndarray:
         rows.append(jnp.stack(lanes) if lanes
                     else jnp.zeros((C,), jnp.float32))
     return jnp.stack(rows)
+
+
+def _gate_key(i: int) -> str:
+    """Event-dict column carrying cond i's hoisted block-wide gate."""
+    return f"__gate_{i}"
+
+
+def _eval_conds(spec: NfaSpec, event, caps) -> List[jnp.ndarray]:
+    """Per-cond [K] booleans for one event.
+
+    Hoisted conditions (capture-free, precomputed for the whole block by
+    ``_hoist_cond_gates``) read their scalar gate straight from the event
+    dict — the scan body then carries only the truly sequential masked
+    state update; everything else evaluates its program against the
+    current captures exactly as before."""
+    K = caps.shape[0]
+    conds = []
+    for i, fn in enumerate(spec.cond_fns):
+        key = _gate_key(i)
+        if key in event:
+            conds.append(jnp.broadcast_to(event[key], (K,)))
+        else:
+            conds.append(fn(event, caps))
+    return conds
+
+
+def _cond_on(spec: NfaSpec, event, cond_id: int, caps) -> jnp.ndarray:
+    """One condition against an explicit capture context (the virgin
+    zero-caps re-arm/seed sites).  A hoisted gate IS fn(event, zeros) by
+    construction, so it substitutes exactly."""
+    key = _gate_key(cond_id)
+    if key in event:
+        return event[key]
+    return spec.cond_fns[cond_id](event, caps)[0]
+
+
+def _hoist_cond_gates(spec: NfaSpec, events_p: Dict[str, jnp.ndarray],
+                      extra: Optional[Dict[str, jnp.ndarray]] = None
+                      ) -> Dict[str, jnp.ndarray]:
+    """Evaluate every capture-free condition for a whole [T] event lane in
+    ONE vectorized pass outside the scan → {__gate_i: [T] bool} columns.
+
+    Capture-free programs never read the slot captures (spec.cond_free,
+    proven statically by plan/nfa_compiler), so evaluating them against a
+    zero capture context is exact and uniform over K.  `extra` carries
+    per-pattern parameter scalars in bank mode."""
+    free = [i for i, f in enumerate(spec.cond_free) if f]
+    if not free:
+        return {}
+    R, C = max(spec.n_rows, 1), max(spec.n_caps, 1)
+    zero_caps = jnp.zeros((1, R, C), jnp.float32)
+
+    def one(ev):
+        if extra:
+            ev = {**ev, **extra}
+        return jnp.stack([jnp.asarray(spec.cond_fns[i](ev, zero_caps)[0],
+                                      bool) for i in free])
+    g = jax.vmap(one)(events_p)                  # [T, n_free]
+    return {_gate_key(i): g[:, j] for j, i in enumerate(free)}
+
+
+def _pad_block_t(events_p: Dict[str, jnp.ndarray], batch_b: int):
+    """Pad the time axis up to a batch_b multiple.  Padding rows are
+    invalid (__valid False — every transition/arm is gated on it) and
+    repeat the LAST event's timestamp, so the only unconditional per-tick
+    pass (within expiry) re-runs at a time it already ran at and kills
+    nothing new: the carry stays bit-identical to the unpadded scan."""
+    T = int(events_p["__ts"].shape[0])
+    ticks = -(-T // batch_b) if T else 0
+    pad = ticks * batch_b - T
+    if not pad:
+        return events_p, T, ticks
+
+    def pad_leaf(name, v):
+        if name == "__ts":
+            fill = jnp.broadcast_to(v[T - 1], (pad,))
+        else:
+            fill = jnp.zeros((pad,) + v.shape[1:], v.dtype)
+        return jnp.concatenate([v, fill], axis=0)
+    return ({k: pad_leaf(k, v) for k, v in events_p.items()}, T, ticks)
 
 
 class _StepState:
@@ -567,8 +678,9 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
     # live-append frees the start only at the NEXT event's re-init)
     cnt_prev_pre = s.cnt_prev
 
-    # ---- condition programs over the current capture state
-    conds = [fn(event, s.caps) for fn in spec.cond_fns]
+    # ---- condition programs over the current capture state (hoisted
+    # capture-free gates ride the event dict — see _eval_conds)
+    conds = _eval_conds(spec, event, s.caps)
     ev_rows = _event_rows(spec, event)
 
     advanced = jnp.zeros((K,), bool)
@@ -769,7 +881,7 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
             # in the kleene's own condition must see a virgin context
             # (empty last bank, __cnt == 0), not slot 0's stale captures
             zero_caps = jnp.zeros((1,) + s.caps.shape[1:], s.caps.dtype)
-            cond0 = spec.cond_fns[u0.cond_a](event, zero_caps)[0]
+            cond0 = _cond_on(spec, event, u0.cond_a, zero_caps)
         else:
             cond0 = conds[u0.cond_a][0]
         c0 = valid & (stream == u0.stream_a) & cond0
@@ -872,7 +984,7 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
         # (self e[last] refs read nothing), like the count re-arm above
         zero_caps = jnp.zeros((1,) + s.caps.shape[1:], s.caps.dtype)
         c0 = valid & (stream == u0.stream_a) & \
-            spec.cond_fns[u0.cond_a](event, zero_caps)[0]
+            _cond_on(spec, event, u0.cond_a, zero_caps)
         want_seed = seed_req & c0
         free_s = (s.st < 0) & ~s.m_mask
         seeded = (want_seed & jnp.any(free_s)) & \
@@ -934,17 +1046,56 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
     return out, (s.m_mask, match_caps, s.m_ts, s.m_enter, s.m_seq)
 
 
-def build_block_step(spec: NfaSpec):
+def build_block_step(spec: NfaSpec, batch_b: Optional[int] = None,
+                     unroll: int = 1):
     """Returns jittable fn(carry, block) → (carry, matches).
 
     block: dict of [P, T] arrays — per-partition event lanes, time-major
     scan; `__valid` masks padding.  matches: (mask [P, T, K],
-    caps [P, T, K, R, C], ts [P, T, K], enter [P, T, K], seq [P, T, K])."""
+    caps [P, T, K, R, C], ts [P, T, K], enter [P, T, K], seq [P, T, K]).
+
+    Round 6 — fatter scan ticks.  The legacy scan ran T ticks, each a
+    chain of ~10² small fused ops whose issue LATENCY (not throughput)
+    set the pace (docs/perf_notes.md §roofline accounting).  Two
+    composable restructurings, both gated by ``SIDDHI_TPU_NFA_BATCH``
+    (default B=4; ``=1`` is the kill switch → this exact legacy path):
+
+      1. **Condition hoisting** — capture-free condition programs
+         (spec.cond_free, the common case) are evaluated for the WHOLE
+         block in one vectorized [T] pass outside the scan; the scan body
+         reads precomputed boolean gates and shrinks to the truly
+         sequential masked state update.
+      2. **B-event micro-batching** — each scan tick consumes
+         ``batch_b`` events (a static unroll of the per-event transition
+         over the precomputed gates), cutting tick count T→⌈T/B⌉ so the
+         fixed per-tick issue cost amortizes and XLA can overlap the
+         independent per-lane work of the B sub-steps.
+
+    Sub-steps are the SAME per-event function, so match semantics are
+    bit-identical by construction (randomized parity across B × pattern
+    shapes is asserted in tests/test_nfa_batch.py)."""
+    B = resolve_batch_b(spec.batch_b or None) if batch_b is None \
+        else resolve_batch_b(batch_b)
 
     def per_partition(carry_p, events_p):
         def step(c, ev):
             return _one_partition_step(spec, c, ev)
-        return jax.lax.scan(step, carry_p, events_p)
+        if B == 1:
+            return jax.lax.scan(step, carry_p, events_p, unroll=unroll)
+        events_p = {**events_p, **_hoist_cond_gates(spec, events_p)}
+        events_p, T, ticks = _pad_block_t(events_p, B)
+        chunks = {k: v.reshape((ticks, B) + v.shape[1:])
+                  for k, v in events_p.items()}
+
+        def tick(c, evs):
+            # inner scan fully unrolled (length B == unroll B): the step
+            # body traces ONCE and XLA inlines B copies into the outer
+            # tick — the outer sequential chain genuinely shrinks to
+            # ⌈T/B⌉ ticks (asserted at the jaxpr level in tests)
+            return jax.lax.scan(step, c, evs, unroll=B)
+        carry2, ys = jax.lax.scan(tick, carry_p, chunks, unroll=unroll)
+        ys = tuple(y.reshape((ticks * B,) + y.shape[2:])[:T] for y in ys)
+        return carry2, ys
 
     def block_step(carry, block):
         return jax.vmap(per_partition)(carry, block)
@@ -952,7 +1103,8 @@ def build_block_step(spec: NfaSpec):
     return block_step
 
 
-def build_bank_step(spec: NfaSpec, ring: int = 0):
+def build_bank_step(spec: NfaSpec, ring: int = 0,
+                    batch_b: Optional[int] = None):
     """N structurally-identical patterns (constants differ) × P partitions.
 
     Returns jittable fn(carry, block, params):
@@ -986,8 +1138,11 @@ def build_bank_step(spec: NfaSpec, ring: int = 0):
     same-ts re-arm can slip through as a stale payload.
     """
 
+    B = resolve_batch_b(spec.batch_b or None) if batch_b is None \
+        else resolve_batch_b(batch_b)
+
     def per_partition(carry_p, events_p, prm):
-        def step(c, ev):
+        def sub_step(c, ev):
             inner, acc, lmt, lmk = c
             inner2, (mm, *_rest) = _one_partition_step(
                 spec, inner, {**ev, **prm})
@@ -1002,9 +1157,27 @@ def build_bank_step(spec: NfaSpec, ring: int = 0):
                 hit = jnp.any(mm)
                 lmt = jnp.where(hit, ev["__ts"], lmt)
                 lmk = jnp.where(hit, jnp.argmax(mm).astype(jnp.int32), lmk)
-            return (inner2, acc2, lmt, lmk), None
+            return (inner2, acc2, lmt, lmk)
         init = (carry_p, jnp.int32(0), jnp.int32(0), jnp.int32(0))
-        (c2, acc, lmt, lmk), _ = jax.lax.scan(step, init, events_p)
+        if B == 1:
+            def step(c, ev):
+                return sub_step(c, ev), None
+            (c2, acc, lmt, lmk), _ = jax.lax.scan(step, init, events_p)
+            return c2, acc, lmt, lmk
+        # fatter ticks (see build_block_step): hoist capture-free gates
+        # for the whole lane, then consume B events per scan tick
+        events_p = {**events_p,
+                    **_hoist_cond_gates(spec, events_p, extra=prm)}
+        events_p, _T, ticks = _pad_block_t(events_p, B)
+        chunks = {k: v.reshape((ticks, B) + v.shape[1:])
+                  for k, v in events_p.items()}
+
+        def tick(c, evs):
+            def inner(c2, ev):
+                return sub_step(c2, ev), None
+            c2, _ = jax.lax.scan(inner, c, evs, unroll=B)
+            return c2, None
+        (c2, acc, lmt, lmk), _ = jax.lax.scan(tick, init, chunks)
         return c2, acc, lmt, lmk
 
     def pattern_step(carry_n, prm, block):

@@ -33,7 +33,8 @@ import numpy as np
 
 from ..compiler import SiddhiCompiler
 from ..ops.nfa import (COUNT_INF, NfaSpec, UnitSpec, build_block_step,
-                       make_carry, make_timer_block, pack_blocks)
+                       make_carry, make_timer_block, pack_blocks,
+                       resolve_batch_b)
 from ..query_api import (AbsentStreamStateElement, CountStateElement,
                          EveryStateElement, Filter, LogicalOp,
                          LogicalStateElement, NextStateElement, Query,
@@ -577,7 +578,8 @@ class CompiledPatternNFA:
     def __init__(self, app_string, n_partitions: int,
                  n_slots: int = 8, query_name: Optional[str] = None,
                  parameterize: bool = False, query: Optional[Query] = None,
-                 mesh: Any = "auto", prune: Optional[bool] = None):
+                 mesh: Any = "auto", prune: Optional[bool] = None,
+                 batch_b: Optional[int] = None):
         """mesh: "auto" (default) shards the partition axis over all local
         devices when more than one exists (parallel/mesh.auto_mesh); a
         jax.sharding.Mesh pins an explicit mesh; None forces single-device.
@@ -587,7 +589,11 @@ class CompiledPatternNFA:
         SIDDHI_TPU_NFA_PRUNE=0 disables globally — the unpruned baseline
         the equivalence tests diff against).  Pattern-bank mode
         (parameterize=True) always compiles unpruned: folding constants
-        out of filters would desync the per-pattern parameter lanes."""
+        out of filters would desync the per-pattern parameter lanes.
+
+        batch_b: events consumed per scan tick (ops/nfa fatter-tick
+        restructuring; default resolves SIDDHI_TPU_NFA_BATCH, 1 = legacy
+        one-event ticks — the kill switch)."""
         app = (SiddhiCompiler.parse(app_string)
                if isinstance(app_string, str) else app_string)
         self.app = app
@@ -943,6 +949,7 @@ class CompiledPatternNFA:
 
         # ---- compile per-side condition programs against jnp
         cond_fns: List[Callable] = []
+        cond_free: List[bool] = []
         unit_specs: List[UnitSpec] = []
         self._n_lane = n_lane
         self._matched_lane = matched_lane
@@ -950,8 +957,10 @@ class CompiledPatternNFA:
             ids = []
             for side in u.sides:
                 side.cond_id = len(cond_fns)
-                cond_fns.append(self._compile_condition(side, n_slots,
-                                                        n_lane, matched_lane))
+                fn, free = self._compile_condition(side, n_slots,
+                                                   n_lane, matched_lane)
+                cond_fns.append(fn)
+                cond_free.append(free)
                 ids.append(side.cond_id)
             a = u.sides[0]
             b = u.sides[1] if len(u.sides) > 1 else None
@@ -971,6 +980,10 @@ class CompiledPatternNFA:
         # (the accumulator chain is shared with the re-arm clones)
         arm_once = (not is_every) or \
             (not self.is_sequence and self.units[0].kind == "count")
+        # fatter scan ticks (ops/nfa round 6): pinned at compile so every
+        # consumer of this spec (engine step, mesh step, bank step, jaxpr
+        # sanitizer, cost model, profiler) sees one consistent B
+        self.batch_b = resolve_batch_b(batch_b)
         self.spec = NfaSpec(
             units=tuple(unit_specs), n_rows=len(rows), n_caps=C,
             n_slots=n_slots, within_ms=within_ms,
@@ -986,7 +999,8 @@ class CompiledPatternNFA:
             lead_absent=self.units[0].kind == "absent",
             dead_start=self.seq_dead_start,
             n_last=tuple(n_last), idx_banks=tuple(idx_banks),
-            lastk_banks=tuple(lastk_banks), m_src=tuple(m_src))
+            lastk_banks=tuple(lastk_banks), m_src=tuple(m_src),
+            cond_free=tuple(cond_free), batch_b=self.batch_b)
         self.has_absent = any(u.kind == "absent" for u in self.units)
         self.last_min_deadline: Optional[int] = None
         from ..parallel.mesh import auto_mesh, round_up_partitions
@@ -1294,11 +1308,18 @@ class CompiledPatternNFA:
         return rw(expr), used
 
     def _compile_condition(self, side: _Side, n_slots: int,
-                           n_lane, matched_lane) -> Callable:
+                           n_lane, matched_lane) -> Tuple[Callable, bool]:
+        """Compile one side's condition → (fn, capture_free).
+
+        ``capture_free`` is True when the program provably reads ONLY the
+        current event (no cross-state captures, no self-[last] bank, no
+        __cnt chain-length lanes, no nullable-row validity gates) — the
+        static license ops/nfa needs to hoist the condition out of the
+        scan chain and evaluate it block-wide (spec.cond_free)."""
         if not side.filters:
             def true_fn(event, captures):
                 return jnp.ones((captures.shape[0],), bool)
-            return true_fn
+            return true_fn, True
         from ..query_api.expression import And
         expr = side.filters[0]
         for fe in side.filters[1:]:
@@ -1314,6 +1335,27 @@ class CompiledPatternNFA:
                     s2.row in self.nullable_rows:
                 gate_rows.add(s2.row)
         _scan_vars(expr, note_gate)
+
+        # capture-freeness: any reference resolving to another state's
+        # captures, or a self-[last] bank read, pins the condition to the
+        # per-slot in-scan evaluation (conservative: unresolvable refs
+        # reject elsewhere; marking not-free is always semantics-safe)
+        free_flag = [not gate_rows and not cnt_rows]
+
+        def note_free(v: Variable):
+            sid = v.stream_id
+            if sid is None:
+                return
+            s2 = self.ref_to_side.get(sid)
+            if s2 is None:
+                cands = [s for s in self.rows if s.stream_id == sid]
+                if len(cands) == 1 and cands[0] is not side:
+                    s2 = cands[0]
+            if s2 is None:
+                return
+            if s2 is not side or v.stream_index not in (None, 0):
+                free_flag[0] = False
+        _scan_vars(expr, note_free)
 
         scope = Scope()
         # current event attributes (scalars broadcast over K); encoded
@@ -1423,7 +1465,7 @@ class CompiledPatternNFA:
                     else self._matched_lane[r]
                 out = out & (captures[:, r, vlane] > 0)
             return out
-        return fn
+        return fn, free_flag[0]
 
     def extract_params(self, app_string: str,
                        query_name: Optional[str] = None) -> Dict[str, float]:
@@ -1476,19 +1518,25 @@ class CompiledPatternNFA:
         from ..core.profiling import wrap_kernel
         batch_of = (lambda carry, block:
                     int(block["__ts"].size) if "__ts" in block else 0)
+        B = max(self.batch_b, 1)
+        # sequential ticks per dispatch: ⌈T/B⌉ (the fatter-tick win the
+        # profiler exposes as scan_ticks next to batch_b)
+        ticks_of = (lambda carry, block:
+                    (-(-int(block["__ts"].shape[-1]) // B), B)
+                    if "__ts" in block else (0, B))
         if self.mesh is None:
             # no donation: the engine path replays a chunk from the
             # pre-chunk carry after a slot overflow (grow-and-replay), so
             # the input carry must survive the step
             return wrap_kernel("nfa.step",
                                jax.jit(build_block_step(self.spec)),
-                               batch_of=batch_of)
+                               batch_of=batch_of, ticks_of=ticks_of)
         from ..parallel.mesh import jit_engine_step
         return wrap_kernel(
             "nfa.mesh_step",
             jit_engine_step(self.spec, self.mesh,
                             donate=not self.spec.mid_every),
-            batch_of=batch_of)
+            batch_of=batch_of, ticks_of=ticks_of)
 
     def grow(self, n_partitions: int) -> None:
         """Widen the partition axis (slab growth for keyed partitioning);
@@ -2050,7 +2098,7 @@ class CompiledPatternBank:
 
     def __init__(self, apps: Sequence[str], n_partitions: int,
                  n_slots: int = 8, pattern_chunk: Optional[int] = None,
-                 ring: int = 0):
+                 ring: int = 0, batch_b: Optional[int] = None):
         import jax
         from ..ops.nfa import build_bank_step, make_bank_carry
         # the bank carries its own [N, P, ...] state and steps it with its
@@ -2058,7 +2106,7 @@ class CompiledPatternBank:
         # DistributedPatternBank, so the inner NFA stays single-device
         self.nfa = CompiledPatternNFA(apps[0], n_partitions=n_partitions,
                                       n_slots=n_slots, parameterize=True,
-                                      mesh=None)
+                                      mesh=None, batch_b=batch_b)
         self.n_patterns = len(apps)
         self.n_partitions = n_partitions
         # top_k over the per-partition counts caps the ring at P
@@ -2095,12 +2143,16 @@ class CompiledPatternBank:
                 "nfa.bank_step",
                 sum(int(getattr(v, "nbytes", 0))
                     for c in self.carries for v in c.values()))
+        B = max(self.nfa.batch_b, 1)
         self._step = wrap_kernel(
             "nfa.bank_step",
             jax.jit(build_bank_step(self.nfa.spec, ring=self.ring),
                     donate_argnums=0),
             batch_of=lambda carry, block, params:
-                int(block["__ts"].size) if "__ts" in block else 0)
+                int(block["__ts"].size) if "__ts" in block else 0,
+            ticks_of=lambda carry, block, params:
+                (-(-int(block["__ts"].shape[-1]) // B), B)
+                if "__ts" in block else (0, B))
         self.base_ts: Optional[int] = None
 
     def _default_chunk(self, n_partitions: int, n_slots: int) -> int:

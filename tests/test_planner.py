@@ -427,12 +427,20 @@ def test_window_agg_query_compiles_to_device():
     assert rt2.query_runtimes["q"].backend == "device"
     assert "dwin" in (rt2.query_runtimes["q"].backend_reason or "")
     rt2.shutdown()
-    # genuinely unsupported window kinds still fall back with a reason
+    # sort windows gained a device kernel in round 5 (plan/dwin_compiler
+    # DEVICE_KINDS) — they now route to the device window path too
     m3 = SiddhiManager()
     rt3 = m3.create_siddhi_app_runtime(app.replace(
         "window.length(3)", "window.sort(3, v)"))
-    assert rt3.query_runtimes["q"].backend == "host"
+    assert rt3.query_runtimes["q"].backend == "device"
+    assert "dwin" in (rt3.query_runtimes["q"].backend_reason or "")
     rt3.shutdown()
+    # genuinely unsupported window kinds still fall back with a reason
+    m4 = SiddhiManager()
+    rt4 = m4.create_siddhi_app_runtime(app.replace(
+        "window.length(3)", "window.frequent(3)"))
+    assert rt4.query_runtimes["q"].backend == "host"
+    rt4.shutdown()
 
 
 def test_slot_overflow_grow_and_replay_exact():
