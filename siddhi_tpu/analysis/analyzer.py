@@ -155,12 +155,46 @@ def analyze(app: Union[str, SiddhiApp],
         qidx += 1
 
     deadcode_pass(table, insert_targets, sink)
+    _fault_tolerance_pass(app, sink)
     order = {Severity.ERROR: 0, Severity.WARNING: 1, Severity.INFO: 2}
     res.diagnostics = sorted(
         sink.diagnostics,
         key=lambda d: (order[d.severity],
                        d.line if d.line >= 0 else 1 << 30, d.code))
     return res
+
+
+# ========================================================= fault tolerance
+
+_ONERROR_ACTIONS = {"LOG", "STREAM", "STORE", "WAIT"}
+
+
+def _fault_tolerance_pass(app: SiddhiApp, sink: DiagnosticSink) -> None:
+    """SA050/SA051: @OnError configuration hazards (core/resilience.py).
+
+    STORE routes failed events into the runtime's error store; without
+    one configured — `@app:errorStore(...)` on the app (or
+    `SiddhiManager.set_error_store`, invisible to static analysis, hence
+    a warning not an error) — those events degrade to LOG and are
+    lost."""
+    has_app_store = (
+        find_annotation(app.annotations, "app:errorstore") is not None
+        or find_annotation(app.annotations, "errorstore") is not None)
+    for sid, d in app.stream_definitions.items():
+        on_err = find_annotation(d.annotations, "onerror")
+        if on_err is None:
+            continue
+        action = (on_err.get("action", "LOG") or "LOG").upper()
+        if action not in _ONERROR_ACTIONS:
+            sink.emit("SA051",
+                      f"stream '{sid}': @OnError action '{action}' is not "
+                      f"one of LOG/STREAM/STORE/WAIT; it will fall back "
+                      f"to LOG", pos=pos_of(d))
+        elif action == "STORE" and not has_app_store:
+            sink.emit("SA050",
+                      f"stream '{sid}' uses @OnError(action='STORE') but "
+                      f"the app configures no error store; failed events "
+                      f"will be logged and lost", pos=pos_of(d))
 
 
 # ============================================================ aggregations

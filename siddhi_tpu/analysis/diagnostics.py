@@ -149,6 +149,21 @@ CATALOG: Dict[str, CatalogEntry] = {e.code: e for e in [
        "insert).  It still costs a column in every batch.",
        "Drop the attribute from the definition, or project it where "
        "intended."),
+    # ---- fault tolerance ------------------------------------------------
+    _C("SA050", _W, "onerror-store-without-error-store",
+       "A stream declares `@OnError(action='STORE')` but neither the app "
+       "(`@app:errorStore(...)`) nor the SiddhiManager "
+       "(`set_error_store`) configures an error store — failed events "
+       "will fall back to LOG and be lost instead of captured for "
+       "replay.",
+       "Add `@app:errorStore(type='memory')` (or type='sqlite') to the "
+       "app, or call `SiddhiManager.set_error_store(...)` before "
+       "creating the runtime."),
+    _C("SA051", _W, "unknown-onerror-action",
+       "`@OnError(action=...)` names an action other than "
+       "LOG/STREAM/STORE/WAIT; the junction will fall back to LOG at "
+       "runtime.",
+       "Use one of the supported actions: LOG, STREAM, STORE, WAIT."),
     # ---- TPU performance hazards ---------------------------------------
     _C("SP001", _W, "retrace-slot-growth",
        "A device-eligible `every` pattern without `within` will grow its "
@@ -322,6 +337,7 @@ _FAMILIES = (
     ("SA02", "Unbounded state"),
     ("SA03", "Partition safety"),
     ("SA04", "Dead code"),
+    ("SA05", "Fault tolerance"),
     ("SP0", "TPU performance hazards"),
     ("PV00", "Plan verifier — automaton"),
     ("PV01", "Plan verifier — jaxpr kernel sanitizer"),

@@ -22,6 +22,7 @@ class SiddhiContext:
         self.extensions: Dict[str, Any] = {}
         self.persistence_store = None
         self.incremental_persistence_store = None
+        self.error_store = None             # manager-level default
         self.config_manager = None
         self.attributes: Dict[str, Any] = {}
 

@@ -8,6 +8,7 @@ trigger wiring; util/parser/SiddhiAppParser.java — @app annotations.)
 """
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, List, Optional, Union
 
 import numpy as np
@@ -27,6 +28,8 @@ from .statistics import StatisticsManager
 from .stream import InputHandler, QueryCallback, StreamCallback, StreamJunction
 from .table import InMemoryTable
 from .trigger import TriggerRuntime, trigger_stream_definition
+
+log = logging.getLogger(__name__)
 
 
 class ScriptFunction:
@@ -118,6 +121,14 @@ class SiddhiAppRuntime:
         self._store_query_cache: "OrderedDict[str, Any]" = OrderedDict()
         self._store_query_cache_size = 50
 
+        # resilience: always-on counters, optional error store and
+        # periodic checkpointing (see core/resilience.py)
+        from .resilience import ResilienceMetrics
+        self.resilience_metrics = ResilienceMetrics(self.name)
+        self.error_store = getattr(siddhi_context, "error_store", None)
+        self.checkpoint_scheduler = None
+        self.recovered_revision: Optional[str] = None
+
         self.snapshot_service = SnapshotService(self.app_ctx)
         self.app_ctx.snapshot_service = self.snapshot_service
         self.snapshot_service.pre_snapshot = self.flush
@@ -164,6 +175,37 @@ class SiddhiAppRuntime:
         if tracing_on:
             from .tracing import tracer
             tracer().enable()
+        # @app:persist(interval='30 sec', incremental='true') — periodic
+        # checkpointing through the app scheduler (playback-aware)
+        pers = find_annotation(self.app.annotations, "app:persist")
+        if pers is None:
+            pers = find_annotation(self.app.annotations, "persist")
+        if pers is not None:
+            pos = pers.positional()
+            interval = pers.get("interval") or (pos[0] if pos else "30 sec")
+            inc = str(pers.get("incremental", "false")).lower() == "true"
+            from .resilience import CheckpointScheduler
+            self.checkpoint_scheduler = CheckpointScheduler(
+                self, _parse_time_str(str(interval)), incremental=inc)
+            self.checkpoint_scheduler.metrics = self.resilience_metrics
+        # @app:errorStore(type='memory'|'sqlite') — app-level error store
+        # for @OnError(action='STORE') and sink-exhausted events
+        es = find_annotation(self.app.annotations, "app:errorstore")
+        if es is None:
+            es = find_annotation(self.app.annotations, "errorstore")
+        if es is not None:
+            etype = (es.get("type", "memory") or "memory").lower()
+            if etype in ("memory", "inmemory"):
+                from .resilience import InMemoryErrorStore
+                self.error_store = InMemoryErrorStore(
+                    capacity=int(es.get("capacity", "10000")))
+            elif etype == "sqlite":
+                from ..stores.sqlite import SqliteErrorStore
+                self.error_store = SqliteErrorStore(
+                    es.get("database", ":memory:"))
+            else:
+                raise SiddhiAppCreationError(
+                    f"Unknown error store type '{etype}'")
 
     def _build(self):
         from .source_sink import attach_sources_and_sinks
@@ -370,6 +412,8 @@ class SiddhiAppRuntime:
             s.connect_with_retry()
         if self.app_ctx.stats_enabled:
             self.app_ctx.statistics_manager.start_reporting()
+        if self.checkpoint_scheduler is not None:
+            self.checkpoint_scheduler.start()
 
     def start_without_sources(self):
         self._started = True
@@ -399,6 +443,8 @@ class SiddhiAppRuntime:
         dbg = getattr(self.app_ctx, "debugger", None)
         if dbg is not None:
             dbg.detach()
+        if self.checkpoint_scheduler is not None:
+            self.checkpoint_scheduler.stop()
         for s in self.sources:
             s.shutdown()
         for s in self.sinks:
@@ -456,6 +502,58 @@ class SiddhiAppRuntime:
 
     def restore(self, snapshot: bytes):
         self.snapshot_service.restore(snapshot)
+
+    def recover(self) -> Optional[str]:
+        """Restore the last persisted revision (crash recovery).  Returns
+        the revision restored (None when the store has none) and records
+        it as ``recovered_revision`` + the ``siddhi_recovered`` gauge."""
+        rev = self.restore_last_revision()
+        self.recovered_revision = rev
+        if rev is not None:
+            self.resilience_metrics.recovered.set(1)
+            log.info("app %s recovered from revision %s", self.name, rev)
+        return rev
+
+    # ------------------------------------------------------------ error store
+
+    def replay_errors(self, stream_id: Optional[str] = None,
+                      ids: Optional[list] = None) -> int:
+        """Re-deliver error-store entries for this app through their
+        original path: sink-origin entries re-publish via that stream's
+        sinks, stream-origin entries re-enter the junction.  Successful
+        entries are purged; returns the number of events replayed
+        (at-least-once — a replay that fails again re-enters the store
+        through the normal failure path)."""
+        store = self.error_store
+        if store is None:
+            return 0
+        from .event import EventChunk
+        id_set = set(ids) if ids is not None else None
+        replayed = 0
+        for entry in store.list(app_name=self.name, stream_id=stream_id):
+            if id_set is not None and entry.id not in id_set:
+                continue
+            d = self.stream_definitions.get(entry.stream_id)
+            if d is None:
+                continue
+            rows = [list(data) for _, data in entry.events]
+            stamps = [ts for ts, _ in entry.events]
+            chunk = EventChunk.from_rows(d, rows, stamps)
+            if entry.origin == "sink":
+                targets = [s for s in self.sinks
+                           if s.stream_def.id == entry.stream_id]
+                for s in targets:
+                    s.receive_chunk(chunk)
+            else:
+                junction = self.junctions.get(entry.stream_id)
+                if junction is None:
+                    continue
+                junction.send(chunk)
+            store.purge(app_name=self.name, ids=[entry.id])
+            replayed += len(entry.events)
+            self.resilience_metrics.errors_replayed_total.inc(
+                len(entry.events), stream=entry.stream_id)
+        return replayed
 
     # ------------------------------------------------------------ playback & stats
 
@@ -541,13 +639,19 @@ class SiddhiManager:
 
     def create_siddhi_app_runtime(
             self, app: Union[str, SiddhiApp],
-            strict: bool = False) -> SiddhiAppRuntime:
+            strict: bool = False,
+            recover: bool = False) -> SiddhiAppRuntime:
         """Parse → analyze → plan.  The semantic analyzer
         (siddhi_tpu.analysis) always runs and its diagnostics ride the
         returned runtime as ``rt.analysis`` (and GET /stats on the REST
         service); with ``strict=True`` any error OR warning diagnostic
         raises SiddhiAppValidationException before anything is built —
-        fail-fast for deployments that refuse hazardous apps."""
+        fail-fast for deployments that refuse hazardous apps.
+
+        ``recover=True`` restores the app's last persisted revision from
+        the manager's persistence store before returning (crash
+        recovery); the revision restored is reported on
+        ``rt.recovered_revision`` (None when the store holds none)."""
         from .tracing import trace_span
         app_string = app if isinstance(app, str) else None
         if isinstance(app, str):
@@ -588,6 +692,12 @@ class SiddhiManager:
             except Exception:
                 rt.shutdown()
                 raise
+        if recover:
+            try:
+                rt.recover()
+            except Exception:
+                rt.shutdown()
+                raise
         self.runtimes[rt.name] = rt
         return rt
 
@@ -607,6 +717,13 @@ class SiddhiManager:
 
     def set_persistence_store(self, store: PersistenceStore):
         self.siddhi_context.persistence_store = store
+
+    def set_error_store(self, store):
+        """Manager-level default ErrorStore (core/resilience.py) for
+        @OnError(action='STORE') and sink-exhausted events; an
+        @app:errorStore annotation overrides it per app.  Applies to
+        runtimes created after this call."""
+        self.siddhi_context.error_store = store
 
     def set_config_manager(self, config_manager):
         """System-parameter source for extensions (reference

@@ -16,6 +16,32 @@ import time
 from typing import Dict, Optional
 
 
+def _loads(snapshot: bytes):
+    """Unpickle snapshot bytes; torn/corrupt bytes surface as a typed
+    CannotRestoreStateError instead of a raw pickle exception."""
+    from ..utils.errors import CannotRestoreStateError
+    try:
+        return pickle.loads(snapshot)
+    except CannotRestoreStateError:
+        raise
+    except Exception as e:      # noqa: BLE001 — any unpickle failure
+        raise CannotRestoreStateError(
+            f"snapshot bytes are corrupt or truncated: "
+            f"{type(e).__name__}: {e}") from e
+
+
+def _rev_key(revision: str):
+    """Numeric-aware revision sort key: revisions are
+    ``{millis}_{app}_{full|inc}`` — order by the leading integer, then
+    the string, so ordering survives millis-width changes (lexicographic
+    sorting would put 999... after 1000...)."""
+    head, _, _ = revision.partition("_")
+    try:
+        return (0, int(head), revision)
+    except ValueError:
+        return (1, 0, revision)
+
+
 class PersistenceStore:
     def save(self, app_name: str, revision: str, snapshot: bytes):
         raise NotImplementedError
@@ -44,11 +70,11 @@ class InMemoryPersistenceStore(PersistenceStore):
         return self._data.get(app_name, {}).get(revision)
 
     def last_revision(self, app_name):
-        revs = sorted(self._data.get(app_name, {}).keys())
+        revs = self.revisions(app_name)
         return revs[-1] if revs else None
 
     def revisions(self, app_name):
-        return sorted(self._data.get(app_name, {}).keys())
+        return sorted(self._data.get(app_name, {}).keys(), key=_rev_key)
 
     def clear_all_revisions(self, app_name):
         self._data.pop(app_name, None)
@@ -64,8 +90,17 @@ class FileSystemPersistenceStore(PersistenceStore):
         return d
 
     def save(self, app_name, revision, snapshot):
-        with open(os.path.join(self._dir(app_name), revision), "wb") as f:
+        # crash-safe: write to a temp file in the same directory, then
+        # os.replace (atomic on POSIX) — a kill mid-write leaves either
+        # the old revision set or the new one, never a torn file
+        d = self._dir(app_name)
+        tmp = os.path.join(d, f".{revision}.tmp")
+        final = os.path.join(d, revision)
+        with open(tmp, "wb") as f:
             f.write(snapshot)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
 
     def load(self, app_name, revision):
         p = os.path.join(self._dir(app_name), revision)
@@ -75,11 +110,12 @@ class FileSystemPersistenceStore(PersistenceStore):
             return f.read()
 
     def last_revision(self, app_name):
-        revs = sorted(os.listdir(self._dir(app_name)))
+        revs = self.revisions(app_name)
         return revs[-1] if revs else None
 
     def revisions(self, app_name):
-        return sorted(os.listdir(self._dir(app_name)))
+        return sorted((f for f in os.listdir(self._dir(app_name))
+                       if not f.startswith(".")), key=_rev_key)
 
     def clear_all_revisions(self, app_name):
         d = self._dir(app_name)
@@ -93,7 +129,14 @@ class SnapshotService:
     def __init__(self, app_ctx):
         self.app_ctx = app_ctx
         self._elements: Dict[str, object] = {}
-        self._lock = threading.Lock()
+        # ONE lock serializes every persist: external persist() callers,
+        # worker-callback persists, and the periodic CheckpointScheduler
+        # all funnel through it.  Re-entrant so a persist triggered from
+        # inside another persist's flush cannot self-deadlock.
+        self._lock = threading.RLock()
+        self._persist_owner = None   # thread ident of the in-flight persist
+        self._active_revision = None
+        self._last_rev_ms = 0
         # set by SiddhiAppRuntime: drains async junction queues + retires
         # pipelined device work so a snapshot deterministically includes
         # every event sent before persist() was called
@@ -130,7 +173,7 @@ class SnapshotService:
             barrier.unlock()
 
     def restore(self, snapshot: bytes):
-        state = pickle.loads(snapshot)
+        state = _loads(snapshot)
         barrier = self.app_ctx.thread_barrier
         barrier.lock()
         try:
@@ -179,6 +222,15 @@ class SnapshotService:
         """Full revisions end `_full`; incremental deltas end `_inc` and are
         replayed on top of the latest full base at restore (reference
         IncrementalFileSystemPersistenceStore revision chains)."""
+        # Re-entrant persist: capturing a snapshot can retire pipelined
+        # device output, which delivers events synchronously — and a
+        # callback on that path may call persist() again on this very
+        # thread.  The in-flight snapshot already covers that state;
+        # flushing here would deadlock (the junction worker is parked on
+        # the thread barrier the outer capture holds, and the nested
+        # flush would wait on that worker forever).
+        if self._persist_owner == threading.get_ident():
+            return self._active_revision
         # Flush BEFORE taking the lock: pre_snapshot waits on junction
         # flush barriers, and a worker-callback persist() blocked on the
         # lock would never consume its barrier copy (deadlock cycle:
@@ -186,17 +238,26 @@ class SnapshotService:
         if self.pre_snapshot is not None:
             self.pre_snapshot()
         with self._lock:      # serialize concurrent persist callers
-            now = int(time.time() * 1000)
-            if incremental and self._last_digest:
-                revision = f"{now}_{app_name}_inc"
-                store.save(app_name, revision,
-                           self.incremental_snapshot(flush=False))
-            else:
-                revision = f"{now}_{app_name}_full"
-                snap = self.full_snapshot(flush=False)
-                self._mark_digests(snap)
-                store.save(app_name, revision, snap)
-            return revision
+            # strictly-monotonic revision stamp: two persists inside the
+            # same millisecond must not collide on the same revision name
+            now = max(int(time.time() * 1000), self._last_rev_ms + 1)
+            self._last_rev_ms = now
+            self._persist_owner = threading.get_ident()
+            try:
+                if incremental and self._last_digest:
+                    revision = f"{now}_{app_name}_inc"
+                    self._active_revision = revision
+                    store.save(app_name, revision,
+                               self.incremental_snapshot(flush=False))
+                else:
+                    revision = f"{now}_{app_name}_full"
+                    self._active_revision = revision
+                    snap = self.full_snapshot(flush=False)
+                    self._mark_digests(snap)
+                    store.save(app_name, revision, snap)
+                return revision
+            finally:
+                self._persist_owner = None
 
     def restore_revision(self, app_name: str, store: PersistenceStore,
                          revision: str):
@@ -204,18 +265,20 @@ class SnapshotService:
         snap = store.load(app_name, revision)
         if snap is None:
             raise CannotRestoreStateError(f"No revision {revision}")
-        state = pickle.loads(snap)
+        state = _loads(snap)
         if isinstance(state, dict) and state.get("__incremental__"):
             # replay: latest full base before this revision, then every
-            # increment up to and including it
-            revisions = sorted(r for r in store.revisions(app_name)
-                               if r <= revision)
+            # increment up to and including it (numeric-aware ordering)
+            rk = _rev_key(revision)
+            revisions = sorted((r for r in store.revisions(app_name)
+                                if _rev_key(r) <= rk), key=_rev_key)
             base = None
             for r in revisions:
                 if r.endswith("_full"):
                     base = r
+            bk = _rev_key(base) if base is not None else None
             chain = [r for r in revisions
-                     if base is None or r >= base]
+                     if bk is None or _rev_key(r) >= bk]
             barrier = self.app_ctx.thread_barrier
             barrier.lock()
             try:
@@ -223,7 +286,7 @@ class SnapshotService:
                     blob = store.load(app_name, r)
                     if blob is None:
                         continue
-                    st = pickle.loads(blob)
+                    st = _loads(blob)
                     if isinstance(st, dict) and st.get("__incremental__"):
                         st = st["state"]
                     for eid, s in st.items():

@@ -23,6 +23,8 @@ from ..query_api.annotation import Annotation, find_all, find_annotation
 from ..utils.errors import (ConnectionUnavailableError, MappingFailedError,
                             SiddhiAppCreationError)
 from .event import CURRENT, Event, EventChunk
+from .resilience import (CircuitBreaker, RetryPolicy, SinkRetryWorker,
+                         make_entry)
 
 log = logging.getLogger(__name__)
 
@@ -168,9 +170,11 @@ class SinkHandlerManager:
 
 class Source:
     """Base source with connect-retry lifecycle
-    (reference Source.connectWithRetry:128-157 + BackoffRetryCounter)."""
+    (reference Source.connectWithRetry:128-157 + BackoffRetryCounter).
 
-    RETRIES = [0.0, 0.05, 0.1, 0.5, 1.0, 2.0]
+    The old fixed ``RETRIES`` ladder is replaced by a per-source
+    ``RetryPolicy`` (exponential backoff + jitter) configurable through
+    ``retry.*`` annotation options."""
 
     def __init__(self, stream_def, options: Dict[str, str],
                  mapper: SourceMapper, input_handler):
@@ -179,6 +183,8 @@ class Source:
         self.mapper = mapper
         self.input_handler = input_handler
         self.connected = False
+        self.retry_policy = RetryPolicy.from_options(options)
+        self._stop_retry = threading.Event()
 
     def connect(self):
         raise NotImplementedError
@@ -187,9 +193,11 @@ class Source:
         pass
 
     def connect_with_retry(self):
-        for i, delay in enumerate(self.RETRIES):
+        delays = [0.0] + self.retry_policy.delays()
+        for i, delay in enumerate(delays):
             if delay:
-                time.sleep(delay)
+                if self._stop_retry.wait(delay):
+                    return
             try:
                 self.connect()
                 self.connected = True
@@ -199,6 +207,7 @@ class Source:
         log.error("source for %s could not connect", self.stream_def.id)
 
     def shutdown(self):
+        self._stop_retry.set()
         try:
             self.disconnect()
         finally:
@@ -239,15 +248,54 @@ _TEMPLATE_RE = re.compile(r"\{\{(\w+)\}\}")
 
 class Sink:
     """Base sink; junction subscriber publishing mapped events
-    (reference Sink.java:49-167)."""
+    (reference Sink.java:49-167).
 
-    RETRIES = Source.RETRIES
+    Publish resilience: the first attempt runs inline on the junction
+    thread; a ``ConnectionUnavailableError`` hands the payload to this
+    sink's bounded retry worker (exponential backoff, off-thread) and a
+    ``CircuitBreaker`` turns a persistently dead endpoint into fast-fail
+    (events → error store when one is configured, else a counted drop).
+    Knobs ride the ``@sink`` annotation: ``retry.max.attempts``,
+    ``retry.base.delay.ms``, ``retry.max.delay.ms``, ``retry.multiplier``,
+    ``retry.budget.ms``, ``retry.queue.size``,
+    ``circuit.failure.threshold``, ``circuit.reset.ms``."""
 
     def __init__(self, stream_def, options: Dict[str, str], mapper: SinkMapper):
         self.stream_def = stream_def
         self.options = options
         self.mapper = mapper
         self.connected = False
+        self.retry_policy = RetryPolicy.from_options(options)
+        self.breaker = CircuitBreaker.from_options(options)
+        self._retry_capacity = int(options.get("retry.queue.size", "1024"))
+        self._retry_worker_inst = None
+        self._retry_lock = threading.Lock()
+        self._runtime = None      # set by attach_sources_and_sinks
+
+    # ---- runtime binding (error store + metrics) ----------------------
+
+    def bind_runtime(self, app_runtime):
+        self._runtime = app_runtime
+        m = self.resilience
+        if m is not None:
+            sid = self.stream_def.id
+            m.circuit_state.set_fn(
+                lambda b=self.breaker: b.state_code, sink=sid)
+            self.breaker.on_transition = (
+                lambda old, new, m=m, sid=sid:
+                m.circuit_transitions_total.inc(sink=sid, to=new))
+
+    @property
+    def app_name(self) -> str:
+        return self._runtime.name if self._runtime is not None else ""
+
+    @property
+    def error_store(self):
+        return getattr(self._runtime, "error_store", None)
+
+    @property
+    def resilience(self):
+        return getattr(self._runtime, "resilience_metrics", None)
 
     # dynamic option templating: topic='{{symbol}}' resolved per event
     def resolve_option(self, key: str, event: Event) -> Optional[str]:
@@ -270,7 +318,8 @@ class Sink:
         pass
 
     def connect_with_retry(self):
-        for i, delay in enumerate(self.RETRIES):
+        delays = [0.0] + self.retry_policy.delays()
+        for i, delay in enumerate(delays):
             if delay:
                 time.sleep(delay)
             try:
@@ -281,6 +330,14 @@ class Sink:
                 log.warning("sink connect failed (attempt %d): %s", i + 1, e)
 
     def shutdown(self):
+        worker = self._retry_worker_inst
+        if worker is not None:
+            # graceful drain: let pending retry ladders run their natural
+            # backoff course (they self-terminate on max_attempts/budget)
+            # so a transiently-down endpoint still gets every attempt;
+            # only then interrupt, giving stragglers one final attempt.
+            worker.join(timeout=5.0)
+            worker.stop()
         try:
             self.disconnect()
         finally:
@@ -296,31 +353,83 @@ class Sink:
             return
         if self._is_dynamic():
             for e in events:
-                self._publish_with_retry(self.mapper.map([e]), e)
+                self._publish_with_retry(self.mapper.map([e]), e, [e])
         else:
-            self._publish_with_retry(self.mapper.map(events), events[0])
+            self._publish_with_retry(self.mapper.map(events), events[0],
+                                     events)
 
     def _is_dynamic(self) -> bool:
         return any(isinstance(v, str) and _TEMPLATE_RE.search(v)
                    for v in self.options.values())
 
-    def _publish_with_retry(self, payload, event):
+    def _publish_with_retry(self, payload, event, events=None):
+        """First attempt inline; failures go to the off-thread retry
+        worker so the junction never blocks on a sick endpoint."""
         handler = getattr(self, "handler", None)
         if handler is not None:
             payload = handler.handle(payload, event)
             if payload is None:
                 return
-        for i, delay in enumerate(self.RETRIES):
-            if delay:
-                time.sleep(delay)
-            try:
-                self.publish(payload, event)
-                return
-            except ConnectionUnavailableError as e:
-                self.connected = False
-                log.warning("sink publish failed (attempt %d): %s", i + 1, e)
-        log.error("sink for %s dropped events after retries",
-                  self.stream_def.id)
+        events = events if events is not None else [event]
+        if not self.breaker.allow():
+            # OPEN circuit: fast-fail without touching the endpoint
+            self._terminal_failure(events, ConnectionUnavailableError(
+                f"circuit open for sink on {self.stream_def.id}"))
+            return
+        try:
+            self.publish(payload, event)
+            self.breaker.record_success()
+        except ConnectionUnavailableError as e:
+            self.connected = False
+            self.breaker.record_failure()
+            m = self.resilience
+            if m is not None:
+                m.sink_publish_failed_total.inc(sink=self.stream_def.id)
+            log.warning("sink publish failed on %s (queued for retry): %s",
+                        self.stream_def.id, e)
+            if not self._retry_worker().submit(payload, event, events, e):
+                self._terminal_failure(events, e)
+
+    def _retry_worker(self) -> SinkRetryWorker:
+        with self._retry_lock:
+            if self._retry_worker_inst is None:
+                m = self.resilience
+                sid = self.stream_def.id
+
+                def on_retry(task, m=m, sid=sid):
+                    if m is not None:
+                        m.sink_retry_total.inc(sink=sid)
+
+                self._retry_worker_inst = SinkRetryWorker(
+                    name=sid,
+                    publish_fn=self.publish,
+                    policy=self.retry_policy,
+                    breaker=self.breaker,
+                    on_exhausted=lambda task: self._terminal_failure(
+                        task.events, task.last_error, attempts=task.attempt),
+                    on_retry=on_retry,
+                    capacity=self._retry_capacity)
+            return self._retry_worker_inst
+
+    def _terminal_failure(self, events, error, attempts: int = 0):
+        """All retries spent (or circuit open / queue full): error store
+        when configured, otherwise a counted, logged drop."""
+        store = self.error_store
+        m = self.resilience
+        sid = self.stream_def.id
+        if store is not None:
+            store.store(make_entry(self.app_name, sid, "sink",
+                                   error or ConnectionUnavailableError(
+                                       "publish failed"),
+                                   events, attempts=attempts))
+            if m is not None:
+                m.errors_stored_total.inc(len(events), stream=sid,
+                                          origin="sink")
+        else:
+            if m is not None:
+                m.sink_dropped_total.inc(len(events), sink=sid)
+            log.error("sink for %s dropped %d events after retries: %s",
+                      sid, len(events), error)
 
 
 class InMemorySink(Sink):
@@ -422,6 +531,9 @@ def attach_sources_and_sinks(app_runtime):
             sink = _build_sink(app_runtime, d, ann)
             if khm is not None:
                 sink.handler = khm.generate_sink_handler(sink)
+            sink.bind_runtime(app_runtime)
+            for dest in getattr(sink, "destinations", []):
+                dest.bind_runtime(app_runtime)
             app_runtime.sinks.append(sink)
             app_runtime.junctions[sid].subscribe(sink)
 

@@ -501,15 +501,19 @@ _TYPES = [
 
 
 def prometheus_text(managers: List[StatisticsManager],
-                    kernel_profiler=None) -> str:
+                    kernel_profiler=None, resilience=None) -> str:
     """Full Prometheus/OpenMetrics text exposition over any number of app
-    StatisticsManagers plus the (process-global) kernel profiler."""
+    StatisticsManagers plus the (process-global) kernel profiler and the
+    per-runtime ResilienceMetrics (core/resilience.py)."""
+    from .resilience import RESILIENCE_TYPES
     lines: List[str] = []
-    for name, typ, help_ in _TYPES:
+    for name, typ, help_ in _TYPES + RESILIENCE_TYPES:
         lines.append(f"# HELP {name} {help_}")
         lines.append(f"# TYPE {name} {typ}")
     for sm in managers:
         lines.extend(sm.prometheus_lines())
     if kernel_profiler is not None:
         lines.extend(kernel_profiler.prometheus_lines())
+    for rm in (resilience or []):
+        lines.extend(rm.prometheus_lines())
     return "\n".join(lines) + "\n"

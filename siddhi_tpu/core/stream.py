@@ -150,6 +150,12 @@ class StreamJunction:
         on_err = find_annotation(self.definition.annotations, "onerror")
         if on_err is not None:
             self.on_error_action = (on_err.get("action", "LOG") or "LOG").upper()
+            if self.on_error_action == "WAIT":
+                from .resilience import RetryPolicy
+                self.wait_policy = RetryPolicy.from_options(
+                    on_err.as_dict(),
+                    RetryPolicy(max_attempts=8, base_delay_s=0.01,
+                                max_delay_s=0.5, budget_s=10.0))
 
     # ------------------------------------------------------------ lifecycle
 
@@ -311,10 +317,18 @@ class StreamJunction:
                 else:
                     r.receive_chunk(chunk)
             except Exception as e:  # noqa: BLE001 — @OnError boundary
-                self._handle_error(chunk, e)
+                self._handle_error(chunk, e, receiver=r)
 
-    def _handle_error(self, chunk: EventChunk, e: Exception):
-        if self.on_error_action == "STREAM" and self.fault_junction is not None:
+    def _handle_error(self, chunk: EventChunk, e: Exception, receiver=None):
+        action = self.on_error_action
+        if action == "WAIT" and receiver is not None:
+            # bounded blocking until downstream recovers: retry THIS
+            # receiver with backoff; on budget/attempt exhaustion fall
+            # through to STORE (when configured) else LOG
+            if self._wait_retry(chunk, e, receiver):
+                return
+            action = "STORE"
+        if action == "STREAM" and self.fault_junction is not None:
             # route into !stream with an extra _error attribute
             fault_def = self.fault_junction.definition
             cols = dict(chunk.columns)
@@ -322,11 +336,58 @@ class StreamJunction:
             fchunk = EventChunk(fault_def.attribute_names, chunk.timestamps,
                                 chunk.types, cols)
             self.fault_junction.send(fchunk)
-        else:
-            log.error("Error processing stream '%s': %s\n%s",
-                      self.definition.id, e, traceback.format_exc())
-            for listener in self.app_ctx.exception_listeners:
-                listener(e)
+            return
+        if action == "STORE" and self._error_store() is not None:
+            from .resilience import make_entry
+            rt = getattr(self.app_ctx, "runtime", None)
+            app_name = rt.name if rt is not None else ""
+            self._error_store().store(make_entry(
+                app_name, self.definition.id, "stream", e,
+                chunk.to_events()))
+            m = self._metrics()
+            if m is not None:
+                m.errors_stored_total.inc(len(chunk),
+                                          stream=self.definition.id,
+                                          origin="stream")
+            return
+        log.error("Error processing stream '%s': %s\n%s",
+                  self.definition.id, e, traceback.format_exc())
+        for listener in self.app_ctx.exception_listeners:
+            listener(e)
+
+    def _error_store(self):
+        rt = getattr(self.app_ctx, "runtime", None)
+        return getattr(rt, "error_store", None)
+
+    def _metrics(self):
+        rt = getattr(self.app_ctx, "runtime", None)
+        return getattr(rt, "resilience_metrics", None)
+
+    def _wait_retry(self, chunk: EventChunk, first_err: Exception,
+                    receiver) -> bool:
+        """@OnError(action='WAIT'): block (bounded) re-offering the chunk
+        to the failed receiver until it recovers.  Returns True when the
+        delivery eventually succeeded."""
+        policy = getattr(self, "wait_policy", None)
+        if policy is None:
+            from .resilience import RetryPolicy
+            policy = self.wait_policy = RetryPolicy(
+                max_attempts=8, base_delay_s=0.01, max_delay_s=0.5,
+                budget_s=10.0)
+        m = self._metrics()
+        for delay in policy.delays():
+            if self._stop.wait(delay):
+                return False
+            if m is not None:
+                m.onerror_wait_retries_total.inc(stream=self.definition.id)
+            try:
+                receiver.receive_chunk(chunk)
+                return True
+            except Exception as e:  # noqa: BLE001 — keep waiting
+                first_err = e
+        log.error("@OnError(WAIT) on '%s' gave up after %d attempts: %s",
+                  self.definition.id, policy.max_attempts, first_err)
+        return False
 
 
 class InputHandler:

@@ -117,6 +117,23 @@ class SiddhiService:
                 return h._send(404, {"error": f"no app '{parts[2]}'"})
             rev = rt.persist()
             return h._send(200, {"revision": rev})
+        if len(parts) == 5 and parts[:2] == ["siddhi", "apps"] and \
+                parts[3] == "errors" and parts[4] in ("replay", "purge"):
+            rt = self.manager.get_siddhi_app_runtime(parts[2])
+            if rt is None:
+                return h._send(404, {"error": f"no app '{parts[2]}'"})
+            if rt.error_store is None:
+                return h._send(409, {"error": "no error store configured"})
+            body = h._body()
+            opts = json.loads(body) if body else {}
+            if parts[4] == "replay":
+                n = rt.replay_errors(stream_id=opts.get("stream"),
+                                     ids=opts.get("ids"))
+                rt.flush()
+                return h._send(200, {"replayed": n})
+            n = rt.error_store.purge(app_name=rt.name, ids=opts.get("ids"))
+            rt.resilience_metrics.errors_purged_total.inc(n)
+            return h._send(200, {"purged": n})
         h._send(404, {"error": f"no route {h.path}"})
 
     def _get(self, h):
@@ -131,12 +148,46 @@ class SiddhiService:
         if parts == ["siddhi", "apps"]:
             return h._send(200, {"apps": sorted(self.manager.runtimes)})
         if parts == ["health"]:
-            return h._send(200, {"status": "up"})
+            return h._send(200, self._health_json())
         if parts == ["metrics"]:
             return self._send_metrics(h)
         if parts == ["stats"]:
             return h._send(200, self._stats_json())
+        if len(parts) == 4 and parts[:2] == ["siddhi", "apps"] and \
+                parts[3] == "errors":
+            rt = self.manager.get_siddhi_app_runtime(parts[2])
+            if rt is None:
+                return h._send(404, {"error": f"no app '{parts[2]}'"})
+            if rt.error_store is None:
+                return h._send(200, {"errors": [], "store": None})
+            return h._send(200, {"errors": [
+                e.summary() for e in rt.error_store.list(app_name=rt.name)],
+                "store": type(rt.error_store).__name__})
         h._send(404, {"error": f"no route {h.path}"})
+
+    # ------------------------------------------------------------ health
+
+    def _health_json(self) -> dict:
+        """Liveness + per-sink circuit readiness: ``status`` stays "up"
+        while the process serves; ``ready`` drops to False when any
+        deployed sink's circuit is OPEN (fast-failing)."""
+        apps, ready = {}, True
+        for name, rt in self.manager.runtimes.items():
+            sinks = {}
+            for s in rt.sinks:
+                breaker = getattr(s, "breaker", None)
+                if breaker is None:
+                    continue
+                state = breaker.state
+                sinks[s.stream_def.id] = {"circuit": state,
+                                          "ready": state != "open"}
+                if state == "open":
+                    ready = False
+            apps[name] = {"started": rt._started, "sinks": sinks,
+                          "errors_stored": (rt.error_store.count(rt.name)
+                                            if rt.error_store is not None
+                                            else 0)}
+        return {"status": "up", "ready": ready, "apps": apps}
 
     # ------------------------------------------------------------ metrics
 
@@ -146,7 +197,10 @@ class SiddhiService:
         managers = [rt.app_ctx.statistics_manager
                     for rt in self.manager.runtimes.values()
                     if rt.app_ctx.statistics_manager is not None]
-        body = prometheus_text(managers, profiler()).encode()
+        resilience = [rt.resilience_metrics
+                      for rt in self.manager.runtimes.values()
+                      if getattr(rt, "resilience_metrics", None) is not None]
+        body = prometheus_text(managers, profiler(), resilience).encode()
         h.send_response(200)
         h.send_header("Content-Type",
                       "text/plain; version=0.0.4; charset=utf-8")

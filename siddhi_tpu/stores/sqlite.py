@@ -301,3 +301,89 @@ class SQLiteStore(AbstractQueryableRecordTable):
                 if d[b] is not None:
                     d[b] = bool(d[b])
             yield d
+
+
+# ===================================================================== errors
+
+class SqliteErrorStore:
+    """SQLite-backed ErrorStore (core/resilience.py): failed events
+    survive a process restart — pair it with a FileSystemPersistenceStore
+    for a fully durable recover-and-replay loop.  Events are pickled
+    (timestamp, data-row) pairs; listing/purging filter server-side."""
+
+    _SCHEMA = """CREATE TABLE IF NOT EXISTS siddhi_error_store (
+        id INTEGER PRIMARY KEY AUTOINCREMENT,
+        app_name TEXT NOT NULL,
+        stream_id TEXT NOT NULL,
+        origin TEXT NOT NULL,
+        error TEXT NOT NULL,
+        timestamp_ms INTEGER NOT NULL,
+        attempts INTEGER NOT NULL,
+        events BLOB NOT NULL)"""
+
+    def __init__(self, database: str = ":memory:"):
+        import threading
+        self.database = database
+        self._conn = sqlite3.connect(database, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.execute(self._SCHEMA)
+            self._conn.commit()
+
+    def store(self, entry) -> int:
+        from ..core.resilience import pickle_events
+        with self._lock:
+            cur = self._conn.execute(
+                "INSERT INTO siddhi_error_store (app_name, stream_id, "
+                "origin, error, timestamp_ms, attempts, events) "
+                "VALUES (?, ?, ?, ?, ?, ?, ?)",
+                (entry.app_name, entry.stream_id, entry.origin, entry.error,
+                 entry.timestamp_ms, entry.attempts,
+                 pickle_events(entry.events)))
+            self._conn.commit()
+            entry.id = cur.lastrowid
+            return entry.id
+
+    def list(self, app_name=None, stream_id=None):
+        from ..core.resilience import ErrorEntry, unpickle_events
+        sql = ("SELECT id, app_name, stream_id, origin, error, "
+               "timestamp_ms, attempts, events FROM siddhi_error_store")
+        conds, params = [], []
+        if app_name is not None:
+            conds.append("app_name = ?")
+            params.append(app_name)
+        if stream_id is not None:
+            conds.append("stream_id = ?")
+            params.append(stream_id)
+        if conds:
+            sql += " WHERE " + " AND ".join(conds)
+        sql += " ORDER BY id"
+        with self._lock:
+            rows = self._conn.execute(sql, params).fetchall()
+        return [ErrorEntry(id=r[0], app_name=r[1], stream_id=r[2],
+                           origin=r[3], error=r[4], timestamp_ms=r[5],
+                           attempts=r[6], events=unpickle_events(r[7]))
+                for r in rows]
+
+    def purge(self, app_name=None, ids=None) -> int:
+        sql = "DELETE FROM siddhi_error_store"
+        conds, params = [], []
+        if app_name is not None:
+            conds.append("app_name = ?")
+            params.append(app_name)
+        if ids is not None:
+            conds.append("id IN (%s)" % ",".join("?" * len(list(ids))))
+            params.extend(ids)
+        if conds:
+            sql += " WHERE " + " AND ".join(conds)
+        with self._lock:
+            cur = self._conn.execute(sql, params)
+            self._conn.commit()
+            return cur.rowcount
+
+    def count(self, app_name=None) -> int:
+        return len(self.list(app_name))
+
+    def close(self):
+        with self._lock:
+            self._conn.close()

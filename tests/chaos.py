@@ -1,0 +1,281 @@
+"""Deterministic chaos harness for the resilience suite.
+
+Seeded fault injectors used by tests/test_resilience.py to prove the
+no-event-loss contracts end-to-end.  Everything here is deterministic:
+failure scripts are fixed sequences or seeded `random.Random` draws,
+delivery scrambles are seeded permutations, and clocks are virtual —
+no assertion in the suite depends on wall-clock sleeps.
+
+Pieces:
+
+  * ``FailureScript`` — per-call fail/succeed decisions (``fail_n``,
+    ``fail_always``, ``fail_rate``).
+  * ``ChaosSink`` / ``ChaosSource`` — engine-buildable transports
+    (register via :func:`register`, then ``@sink(type='chaos',
+    chaos.id='x')``) whose publish/connect consult a script; delivered
+    payloads are recorded per ``chaos.id`` for assertions.
+  * ``ChunkScrambler`` — junction receiver wrapper that buffers, then
+    releases deliveries in a seeded order with seeded duplicates
+    (delay/duplicate/reorder chaos without timers).
+  * ``TearingStore`` — persistence-store wrapper that truncates/corrupts
+    chosen saves, simulating torn writes; plus the raw :func:`tear`.
+  * ``inject_fault`` — monkeypatch any bound method (e.g. a device-step
+    wrapper) to raise per a script.
+  * ``VirtualClock`` — manual monotonic clock for CircuitBreaker tests.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional
+
+from siddhi_tpu.core.snapshot import PersistenceStore
+from siddhi_tpu.core.source_sink import Sink, Source
+from siddhi_tpu.utils.errors import ConnectionUnavailableError
+
+
+class ChaosError(ConnectionUnavailableError):
+    """Injected failure (subclasses ConnectionUnavailableError so the
+    engine's retry machinery engages)."""
+
+
+# ------------------------------------------------------------------ scripts
+
+
+class FailureScript:
+    """Decides, per call, whether to inject a failure.  Thread-safe;
+    mutate ``self`` mid-test (e.g. ``script.heal()``) to model recovery."""
+
+    def __init__(self, fail_first_n: int = 0, fail_forever: bool = False,
+                 fail_rate: float = 0.0, seed: int = 0):
+        self.fail_first_n = fail_first_n
+        self.fail_forever = fail_forever
+        self.fail_rate = fail_rate
+        self._rng = random.Random(seed)
+        self.calls = 0
+        self.failures = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def fail_n(cls, n: int) -> "FailureScript":
+        return cls(fail_first_n=n)
+
+    @classmethod
+    def fail_always(cls) -> "FailureScript":
+        return cls(fail_forever=True)
+
+    @classmethod
+    def healthy(cls) -> "FailureScript":
+        return cls()
+
+    def heal(self):
+        """Stop injecting failures from now on."""
+        with self._lock:
+            self.fail_first_n = 0
+            self.fail_forever = False
+            self.fail_rate = 0.0
+
+    def check(self, what: str = "call"):
+        """Raise ChaosError when the script says this call fails."""
+        with self._lock:
+            self.calls += 1
+            fail = (self.fail_forever or self.calls <= self.fail_first_n
+                    or (self.fail_rate > 0.0
+                        and self._rng.random() < self.fail_rate))
+            if fail:
+                self.failures += 1
+        if fail:
+            raise ChaosError(f"chaos: injected {what} failure "
+                             f"#{self.failures} (call {self.calls})")
+
+
+# ------------------------------------------------------------------ transports
+
+#: per-chaos.id state, shared between the engine-built instances and tests
+SCRIPTS: Dict[str, FailureScript] = {}
+DELIVERED: Dict[str, List] = {}
+INSTANCES: Dict[str, Sink] = {}
+
+
+def reset():
+    SCRIPTS.clear()
+    DELIVERED.clear()
+    INSTANCES.clear()
+
+
+def script_for(chaos_id: str) -> FailureScript:
+    return SCRIPTS.setdefault(chaos_id, FailureScript.healthy())
+
+
+def delivered(chaos_id: str) -> List:
+    return DELIVERED.setdefault(chaos_id, [])
+
+
+class ChaosSink(Sink):
+    """``@sink(type='chaos', chaos.id='x', ...)`` — publish consults
+    SCRIPTS['x']; successful payload events append to DELIVERED['x']."""
+
+    def __init__(self, stream_def, options, mapper):
+        super().__init__(stream_def, options, mapper)
+        self.chaos_id = options.get("chaos.id", stream_def.id)
+        INSTANCES[self.chaos_id] = self
+
+    def publish(self, payload, event):
+        script_for(self.chaos_id).check("publish")
+        sink_log = delivered(self.chaos_id)
+        if isinstance(payload, list):
+            sink_log.extend(payload)
+        else:
+            sink_log.append(payload)
+
+    def retry_join(self, timeout: float = 30.0) -> bool:
+        """Sleep-free rendezvous: wait until every queued retry for this
+        sink has been resolved (delivered or exhausted)."""
+        worker = self._retry_worker_inst
+        return worker.join(timeout) if worker is not None else True
+
+
+class ChaosSource(Source):
+    """``@source(type='chaos', chaos.id='x')`` — connect consults the
+    script; tests push events with ``emit``."""
+
+    def __init__(self, stream_def, options, mapper, input_handler):
+        super().__init__(stream_def, options, mapper, input_handler)
+        self.chaos_id = options.get("chaos.id", stream_def.id)
+        self.connect_attempts = 0
+        INSTANCES[self.chaos_id] = self
+
+    def connect(self):
+        self.connect_attempts += 1
+        script_for(self.chaos_id).check("connect")
+
+    def emit(self, obj):
+        self.deliver(obj)
+
+
+def register(manager):
+    """Make type='chaos' resolvable for @sink/@source on this manager."""
+    manager.set_extension("sink:chaos", ChaosSink)
+    manager.set_extension("source:chaos", ChaosSource)
+
+
+# ------------------------------------------------------------------ delivery
+
+class ChunkScrambler:
+    """Junction receiver that buffers chunks, then ``release()``s them to
+    the wrapped receiver in a seeded order with seeded duplicates —
+    delay/duplicate/reorder chaos with zero timers."""
+
+    def __init__(self, inner, seed: int = 0, duplicate_rate: float = 0.0,
+                 reorder: bool = True):
+        self.inner = inner
+        self.rng = random.Random(seed)
+        self.duplicate_rate = duplicate_rate
+        self.reorder = reorder
+        self.held: List = []
+        self._lock = threading.Lock()
+
+    def receive_chunk(self, chunk):
+        with self._lock:
+            self.held.append(chunk)
+
+    def release(self):
+        with self._lock:
+            batch, self.held = self.held, []
+        order = list(range(len(batch)))
+        if self.reorder:
+            self.rng.shuffle(order)
+        for i in order:
+            self.inner.receive_chunk(batch[i])
+            if self.duplicate_rate > 0.0 and \
+                    self.rng.random() < self.duplicate_rate:
+                self.inner.receive_chunk(batch[i])
+
+
+# ------------------------------------------------------------------ storage
+
+def tear(blob: bytes, seed: int = 0, mode: str = "truncate") -> bytes:
+    """Corrupt snapshot bytes deterministically: ``truncate`` keeps a
+    seeded prefix (torn write), ``flip`` xors a few seeded bytes."""
+    rng = random.Random(seed)
+    if not blob:
+        return blob
+    if mode == "truncate":
+        return blob[:rng.randrange(1, max(len(blob), 2))]
+    out = bytearray(blob)
+    for _ in range(3):
+        i = rng.randrange(len(out))
+        out[i] ^= 0xFF
+    return bytes(out)
+
+
+class TearingStore(PersistenceStore):
+    """Wraps a real store; saves listed in ``tear_revisions`` (by 1-based
+    save ordinal) write corrupted bytes — the pre-atomic-rename failure
+    mode, reproduced deterministically."""
+
+    def __init__(self, inner: PersistenceStore, tear_ordinals=(1,),
+                 seed: int = 0, mode: str = "truncate"):
+        self.inner = inner
+        self.tear_ordinals = set(tear_ordinals)
+        self.seed = seed
+        self.mode = mode
+        self.saves = 0
+
+    def save(self, app_name, revision, snapshot):
+        self.saves += 1
+        if self.saves in self.tear_ordinals:
+            snapshot = tear(snapshot, seed=self.seed + self.saves,
+                            mode=self.mode)
+        self.inner.save(app_name, revision, snapshot)
+
+    def load(self, app_name, revision):
+        return self.inner.load(app_name, revision)
+
+    def last_revision(self, app_name):
+        return self.inner.last_revision(app_name)
+
+    def revisions(self, app_name):
+        return self.inner.revisions(app_name)
+
+    def clear_all_revisions(self, app_name):
+        return self.inner.clear_all_revisions(app_name)
+
+
+# ------------------------------------------------------------------ faults
+
+def inject_fault(obj, attr: str, script: FailureScript,
+                 error_cls=RuntimeError):
+    """Wrap ``obj.attr`` so each call first consults ``script`` (raising
+    ``error_cls``), e.g. a device-step wrapper.  Returns a restore()."""
+    original = getattr(obj, attr)
+
+    def wrapped(*a, **kw):
+        try:
+            script.check(attr)
+        except ChaosError as e:
+            raise error_cls(str(e)) from e
+        return original(*a, **kw)
+
+    setattr(obj, attr, wrapped)
+
+    def restore():
+        setattr(obj, attr, original)
+    return restore
+
+
+# ------------------------------------------------------------------ clock
+
+class VirtualClock:
+    """Manual monotonic clock: inject as CircuitBreaker(clock=vc) and
+    drive state transitions with ``advance`` — no sleeps."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> "VirtualClock":
+        self.t += dt
+        return self

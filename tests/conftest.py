@@ -51,6 +51,12 @@ if os.path.exists(_src) and (
 _COLLECTED_FILES = set()
 
 
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow'; long chaos soaks opt out with it
+    config.addinivalue_line(
+        "markers", "slow: long-running chaos soak (excluded from tier-1)")
+
+
 def pytest_collection_modifyitems(session, config, items):
     for it in items:
         _COLLECTED_FILES.add(it.nodeid.split("::")[0].split("/")[-1])

@@ -233,15 +233,28 @@ def test_external_persist_races_worker_persist():
 
 
 def test_engine_device_rejects_host_only_window_projection():
-    """engine('device') stays strict for plain-projection queries over a
-    window kind with no device kernel (no silent host fallback)."""
+    """Sort windows gained a device kernel (plan/dwin_compiler
+    DEVICE_KINDS, round 5), so engine('device') now routes the
+    projection instead of rejecting it — assert the device plan.  The
+    strict no-silent-host-fallback contract still holds for window
+    kinds without a device kernel (window.frequent)."""
     from siddhi_tpu.utils.errors import SiddhiAppCreationError
     m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "@app:engine('device') define stream s (v int);\n"
+        "@info(name='q') from s#window.sort(5, v) "
+        "select v insert into out;")
+    try:
+        qr = rt.query_runtimes["q"]
+        assert qr.backend == "device"
+        assert "dwin" in (qr.backend_reason or "")
+    finally:
+        rt.shutdown()
     with pytest.raises(SiddhiAppCreationError):
         m.create_siddhi_app_runtime(
-            "@app:engine('device') define stream s (v int);\n"
-            "@info(name='q') from s#window.sort(5, v) "
-            "select v insert into out;")
+            "@app:engine('device') define stream s2 (v int);\n"
+            "@info(name='q2') from s2#window.frequent(3) "
+            "select v insert into out2;")
 
 
 def test_persist_from_worker_callback_no_deadlock():

@@ -1,5 +1,6 @@
 """REST service tests (reference model: siddhi-service deploy/undeploy API)."""
 import json
+import urllib.error
 import urllib.request
 
 from siddhi_tpu.service import SiddhiService
@@ -28,7 +29,9 @@ def test_deploy_send_query_undeploy():
         assert _req("GET", f"{base}/siddhi/apps")["apps"] == ["restapp"]
         _req("POST", f"{base}/siddhi/apps/restapp/streams/S",
              [{"data": ["IBM", 50.0]}, {"data": ["X", 5.0]}])
-        assert _req("GET", f"{base}/health") == {"status": "up"}
+        health = _req("GET", f"{base}/health")
+        assert health["status"] == "up" and health["ready"] is True
+        assert health["apps"]["restapp"]["started"] is True
         out = _req("GET", f"{base}/siddhi/artifact/undeploy/restapp")
         assert out["status"] == "undeployed"
         assert _req("GET", f"{base}/siddhi/apps")["apps"] == []
@@ -51,5 +54,87 @@ def test_store_query_over_http():
         out = _req("POST", f"{base}/siddhi/apps/tapp/query",
                    "from T select symbol, price")
         assert out["events"][0]["data"] == ["IBM", 42.0]
+    finally:
+        svc.stop()
+
+
+ERR_APP = """
+@app:name('errapp')
+@app:errorStore(type='memory')
+define stream S (v int);
+@sink(type='chaos', chaos.id='resterr', retry.max.attempts='2',
+      retry.base.delay.ms='1', retry.jitter='0', circuit.reset.ms='0')
+define stream O (v int);
+@info(name='q') from S select v insert into O;
+"""
+
+
+def _raw(url):
+    with urllib.request.urlopen(url) as r:
+        return r.status, r.read().decode()
+
+
+def test_health_error_store_and_metrics_endpoints():
+    """Resilience surface over HTTP: /health readiness, error-store
+    list/replay/purge, and the siddhi_* resilience series on /metrics."""
+    import chaos
+    chaos.reset()
+    chaos.SCRIPTS["resterr"] = chaos.FailureScript.fail_always()
+    svc = SiddhiService(port=0).start()
+    chaos.register(svc.manager)
+    base = f"http://127.0.0.1:{svc.port}"
+    try:
+        _req("POST", f"{base}/siddhi/artifact/deploy", ERR_APP)
+        _req("POST", f"{base}/siddhi/apps/errapp/streams/S",
+             [{"data": [i]} for i in range(5)])
+        assert chaos.INSTANCES["resterr"].retry_join(30.0)
+
+        out = _req("GET", f"{base}/siddhi/apps/errapp/errors")
+        assert out["store"] == "InMemoryErrorStore"
+        assert sum(e["events"] for e in out["errors"]) == 5
+        assert all(e["origin"] == "sink" for e in out["errors"])
+
+        health = _req("GET", f"{base}/health")
+        assert health["status"] == "up"
+        assert health["apps"]["errapp"]["errors_stored"] == len(
+            out["errors"])
+
+        status, text = _raw(f"{base}/metrics")
+        assert status == 200
+        assert "# TYPE siddhi_errors_stored_total counter" in text
+        assert 'siddhi_errors_stored_total{app="errapp"' in text
+        assert 'siddhi_circuit_state{app="errapp",sink="O"}' in text
+
+        # endpoint heals → replay over HTTP drains the store
+        chaos.SCRIPTS["resterr"].heal()
+        out = _req("POST", f"{base}/siddhi/apps/errapp/errors/replay", {})
+        assert out["replayed"] == 5
+        assert chaos.INSTANCES["resterr"].retry_join(30.0)
+        assert sorted(e.data[0] for e in chaos.delivered("resterr")) == \
+            list(range(5))
+        out = _req("GET", f"{base}/siddhi/apps/errapp/errors")
+        assert out["errors"] == []
+
+        # purge path (nothing left → purged 0)
+        out = _req("POST", f"{base}/siddhi/apps/errapp/errors/purge", {})
+        assert out["purged"] == 0
+    finally:
+        svc.stop()
+
+
+def test_error_endpoints_409_without_store():
+    svc = SiddhiService(port=0).start()
+    base = f"http://127.0.0.1:{svc.port}"
+    try:
+        _req("POST", f"{base}/siddhi/artifact/deploy", APP)
+        try:
+            _req("POST", f"{base}/siddhi/apps/restapp/errors/replay", {})
+            raise AssertionError("expected HTTP 409")
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+            assert json.loads(e.read())["error"] == \
+                "no error store configured"
+        out = _req("GET", f"{base}/siddhi/apps/restapp/errors")
+        assert out == {"errors": [], "store": None}
     finally:
         svc.stop()
