@@ -156,7 +156,7 @@ def _total_dropped(bank) -> int:
 
 def _make_bank(thresholds=THRESHOLDS, e2_floor=E2_FLOOR, batch_b=None,
                n_partitions=N_PARTITIONS, n_slots=N_SLOTS,
-               pattern_chunk=PATTERN_CHUNK, ring=MATCH_RING):
+               pattern_chunk=PATTERN_CHUNK, ring=MATCH_RING, stack=None):
     from siddhi_tpu.plan.nfa_compiler import CompiledPatternBank
     rng = np.random.default_rng(0)
     apps = [app_for(thr, e2_floor=e2_floor) for thr in thresholds]
@@ -164,7 +164,7 @@ def _make_bank(thresholds=THRESHOLDS, e2_floor=E2_FLOOR, batch_b=None,
                                n_slots=n_slots,
                                pattern_chunk=min(pattern_chunk,
                                                  len(thresholds)),
-                               ring=ring, batch_b=batch_b)
+                               ring=ring, batch_b=batch_b, stack=stack)
     bank.base_ts = 1_000_000
     return bank, rng
 
@@ -552,6 +552,86 @@ def bench_bsweep(n_patterns=200, t_blk=T_PER_BLOCK, depth=8, trains=10,
     return {"b_sweep": rows}
 
 
+def bench_dsweep(n_patterns=N_PATTERNS, t_blk=T_PER_BLOCK, depth=8,
+                 trains=10, n_partitions=N_PARTITIONS,
+                 pattern_chunk=PATTERN_CHUNK, assert_equal_counts=False):
+    """Dispatch-consolidation sweep (round 7): the SAME bank of
+    n_patterns run chunk-SEQUENTIAL (C separate jitted dispatches per
+    block — the pre-round-7 path, SIDDHI_TPU_NFA_STACK=0) vs STACKED
+    (all chunks vmapped into one [C, N, ...] super-dispatch).  Each
+    chunk is the 200-pattern x 10k-partition roofline shape from
+    docs/perf_notes.md, so the sequential row reproduces the measured
+    per-dispatch overhead exactly C times.  Reports ms/block,
+    PROFILER-MEASURED device dispatches per block (dispatch_count
+    deltas — the mechanical side of the C-to-1 claim), match-count
+    parity, and XLA cost_analysis of each executable."""
+    import jax
+    from siddhi_tpu.core.profiling import profiler
+    profiler().enable()
+    rows = []
+    counts_by_mode = {}
+    for mode, stack in (("sequential", False), ("stacked", True)):
+        bank, rng = _make_bank(np.linspace(5.0, 95.0, n_patterns),
+                               e2_floor=GATE_E2_FLOOR,
+                               n_partitions=n_partitions,
+                               pattern_chunk=pattern_chunk, stack=stack)
+        base = 1_000_000
+        t0 = base
+        blocks = []
+        for _ in range(depth * trains + 1):
+            b, _n, _flat = gen_block(rng, base, t0, n_partitions, t_blk)
+            blocks.append(jax.device_put(b))
+            t0 += t_blk * GAP_MS
+        d0 = profiler().total_dispatches()
+        out = bank.process_block(blocks[0])
+        np.asarray(out[0])                      # warmup barrier
+        disp_per_block = profiler().total_dispatches() - d0
+        total_counts = np.asarray(out[0], np.int64).copy()
+        means = []
+        for tr in range(trains):
+            t1 = time.perf_counter()
+            for i in range(depth):
+                out = bank.process_block(blocks[1 + tr * depth + i])
+            total_counts += np.asarray(out[0], np.int64)  # closing D2H
+            means.append((time.perf_counter() - t1) / depth)
+        counts_by_mode[mode] = int(total_counts.sum())
+        flops = bytes_acc = None
+        try:
+            if bank.stacked:
+                lowered = bank._step.fn.lower(
+                    bank._stack_carry, blocks[0], bank._stack_params)
+            else:
+                lowered = bank._step.fn.lower(
+                    bank._carries[0], blocks[0], bank.params[0])
+            ca = lowered.compile().cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            flops = float(ca.get("flops", 0.0))
+            bytes_acc = float(ca.get("bytes accessed", 0.0))
+        except Exception as e:   # noqa: BLE001 — metric is best-effort
+            sys.stderr.write(f"[dsweep] cost_analysis unavailable: {e}\n")
+        rows.append({
+            "mode": mode,
+            "n_chunks": bank.n_chunks,
+            "dispatches_per_block": int(disp_per_block),
+            "block_ms_median": round(float(np.median(means)) * 1000, 2),
+            "events_per_sec": round(
+                n_partitions * t_blk / float(np.median(means)), 1),
+            "matches_counted": counts_by_mode[mode],
+            "xla_flops_per_step": flops,
+            "xla_bytes_per_step": bytes_acc})
+        sys.stderr.write(f"[dsweep] {rows[-1]}\n")
+    if assert_equal_counts:
+        want = counts_by_mode["sequential"]
+        assert counts_by_mode["stacked"] == want, \
+            f"dispatch sweep match counts diverged: {counts_by_mode}"
+    seq = next(r for r in rows if r["mode"] == "sequential")
+    for r in rows:
+        r["speedup_vs_sequential"] = round(
+            seq["block_ms_median"] / r["block_ms_median"], 2) \
+            if r["block_ms_median"] else None
+    return {"d_sweep": rows}
+
+
 def bench_engine():
     """ENGINE-path phase (VERDICT r3 #1 'done' criterion): the public
     SiddhiManager API — @Async junction → pipelined DevicePatternRuntime
@@ -878,13 +958,57 @@ def bench_smoke():
                             depth=2, trains=2, b_values=(1, 2, 4),
                             n_partitions=SMOKE_PARTITIONS,
                             assert_equal_counts=True))
+
+    # ---- dispatch consolidation, tiny shape: a C=2-chunk bank stacked
+    # into one super-dispatch must agree exactly (counts, payloads,
+    # dropped) with the chunk-sequential path, and the profiler's
+    # dispatch_count must SEE the C-to-1 drop
+    d_rows = {}
+    for mode, stack in (("sequential", False), ("stacked", True)):
+        dbank, drng = _make_bank(thrs, e2_floor=GATE_E2_FLOOR,
+                                 n_partitions=SMOKE_PARTITIONS,
+                                 pattern_chunk=SMOKE_PATTERNS // 2,
+                                 ring=4, stack=stack)
+        t0d = base
+        cnts = np.zeros(SMOKE_PATTERNS, np.int64)
+        pays = []
+        disp = 0
+        for _ in range(2):
+            block, _n, _flat = gen_block(drng, base, t0d,
+                                         SMOKE_PARTITIONS, SMOKE_T)
+            t0d += SMOKE_T * GAP_MS
+            d0 = profiler().total_dispatches()
+            out = dbank.process_block(block)
+            cnts += np.asarray(out[0], np.int64)
+            disp = profiler().total_dispatches() - d0
+            pays.append(sorted(map(tuple, zip(
+                *[np.asarray(c) for c in
+                  dbank.decode_ring(*out[1:]).values()]))))
+        d_rows[mode] = {"counts": cnts, "payloads": pays,
+                        "dropped": _total_dropped(dbank),
+                        "dispatches_per_block": int(disp)}
+    seq_d, stk_d = d_rows["sequential"], d_rows["stacked"]
+    assert (stk_d["counts"] == seq_d["counts"]).all(), \
+        f"smoke dsweep count parity FAILED: {d_rows}"
+    assert stk_d["payloads"] == seq_d["payloads"], \
+        "smoke dsweep payload parity FAILED"
+    assert stk_d["dropped"] == seq_d["dropped"]
+    assert stk_d["dispatches_per_block"] == 1, stk_d
+    assert seq_d["dispatches_per_block"] == 2, seq_d
+    res["d_sweep_smoke"] = {
+        m: {"dispatches_per_block": d_rows[m]["dispatches_per_block"],
+            "matches": int(d_rows[m]["counts"].sum())}
+        for m in d_rows}
+
     snap = profiler().snapshot()
     bank_st = snap.get("nfa.bank_step", {})
     assert bank_st.get("scan_ticks", 0) > 0, \
         "profiler recorded no scan_ticks for the bank step"
+    assert bank_st.get("dispatch_count", 0) > 0, \
+        "profiler recorded no dispatches for the bank step"
     res["kernel_profile"] = {
         k: {f: v[f] for f in ("calls", "compile_count", "scan_ticks",
-                              "batch_b") if f in v}
+                              "batch_b", "dispatch_count") if f in v}
         for k, v in snap.items() if k.startswith("nfa.")}
     res["smoke_wall_s"] = round(time.perf_counter() - t_start, 2)
     return res
@@ -979,6 +1103,15 @@ def main():
     if "--fail-on-hbm-budget" in sys.argv:
         fail_on_hbm = float(
             sys.argv[sys.argv.index("--fail-on-hbm-budget") + 1])
+    # --fail-on-dispatches N: exit non-zero when the stacked bank's
+    # MEASURED device dispatches per ingest block exceed N — the
+    # mechanical gate of the round-7 dispatch consolidation (a
+    # regression here means chunk stacking silently fell back to the
+    # sequential path or a runtime grew an extra per-block dispatch)
+    fail_on_dispatches = None
+    if "--fail-on-dispatches" in sys.argv:
+        fail_on_dispatches = int(
+            sys.argv[sys.argv.index("--fail-on-dispatches") + 1])
     if "--phase" in sys.argv:
         phase = sys.argv[sys.argv.index("--phase") + 1]
         if phase == "gate":
@@ -992,6 +1125,8 @@ def main():
             print(json.dumps(bench_latsweep()))
         elif phase == "bsweep":
             print(json.dumps(bench_bsweep(assert_equal_counts=True)))
+        elif phase == "dsweep":
+            print(json.dumps(bench_dsweep(assert_equal_counts=True)))
         elif phase == "engine":
             print(json.dumps(_with_profile(bench_engine)))
         elif phase == "engine_wagg":
@@ -1006,6 +1141,7 @@ def main():
     lat = _run_phase("lat")
     sweep = _run_phase("latsweep")["sweep"]
     bsweep = _run_phase("bsweep")["b_sweep"]
+    dsweep = _run_phase("dsweep")["d_sweep"]
     eng = _run_phase("engine")
     eng_wagg = _run_phase("engine_wagg")
     eng_absent = _run_phase("engine_absent")
@@ -1073,6 +1209,10 @@ def main():
         # fatter-scan-tick sweep (round 6): ms/chunk-step per B at the
         # roofline shape, B=1 = SIDDHI_TPU_NFA_BATCH=1 kill switch
         "nfa_batch_sweep": bsweep,
+        # dispatch-consolidation sweep (round 7): ms/block and measured
+        # dispatches/block for C-chunk sequential vs one stacked
+        # super-dispatch, match parity asserted in-phase
+        "dispatch_sweep": dsweep,
         "latency_blocks": LAT_BLOCKS,
         "latency_block_events": N_PARTITIONS * T_LAT_BLOCK,
         "throughput_block_events": N_PARTITIONS * T_PER_BLOCK,
@@ -1116,6 +1256,18 @@ def main():
             f"recompilation regression (see kernel_profile_* "
             f"compile_count for the guilty kernel)\n")
         sys.exit(1)
+    if fail_on_dispatches is not None:
+        stacked_row = next(
+            (r for r in dsweep if r["mode"] == "stacked"), None)
+        measured = stacked_row["dispatches_per_block"] \
+            if stacked_row else None
+        if measured is not None and measured > fail_on_dispatches:
+            sys.stderr.write(
+                f"[bench] FAIL: stacked bank measured {measured} device "
+                f"dispatches per block, exceeds --fail-on-dispatches "
+                f"{fail_on_dispatches} — dispatch consolidation "
+                f"regressed (see dispatch_sweep)\n")
+            sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -117,6 +117,63 @@ def bank_state_bytes(a: AutomatonIR, n_patterns: int,
     return n_patterns * sum(nfa_state_bytes(a, n_partitions).values())
 
 
+def stacked_bank_state_bytes(a: AutomatonIR, n_chunks: int, chunk: int,
+                             n_partitions: Optional[int] = None) -> int:
+    """The stacked super-dispatch carry ([C, N, ...], one array per
+    leaf) holds exactly the same elements as C separate [N, ...] chunk
+    carries — stacking changes dispatch count, never bytes.  Asserted
+    against both ``bank_state_bytes`` and the real stacked carry in
+    tests/test_dispatch_stack.py."""
+    return n_chunks * bank_state_bytes(a, chunk, n_partitions)
+
+
+#: Measured round 6 (docs/perf_notes.md): XLA's fusion of the hoisted
+#: gate tensors back into the unrolled inner scan duplicates step
+#: intermediates ~3.2x per B-doubling (cost_analysis bytes, v5e + CPU).
+BATCH_FUSION_GROWTH = 3.2
+
+#: Transient-over-carry multiplier measured on v5e at B=1 (N=1000
+#: P=10k K=8 S=2 C=1 wants ~22G → ~16x the carry bytes).
+SCAN_TEMP_FACTOR = 16
+
+#: Chunk-size budget: leave headroom below ~16G HBM.
+CHUNK_HBM_BUDGET = 8 << 30
+
+
+def bank_chunk_bytes_per_pattern(n_partitions: int, n_slots: int,
+                                 n_rows: int, n_caps: int,
+                                 batch_b: int = 1,
+                                 ring: bool = False) -> int:
+    """Transient HBM a single bank pattern costs during one step —
+    carry bytes x scan/vmap intermediate factor, doubled when a decode
+    ring keeps the per-step match_caps alive, and scaled by the
+    B-batching fusion duplication (~3.2x per B-doubling: B=4 ≈ 10.24x).
+    ``CompiledPatternBank._default_chunk`` sizes chunks against exactly
+    this formula (asserted in tests)."""
+    b = n_partitions * n_slots * (
+        I32 + I32 + F32 * max(n_rows, 1) * max(n_caps, 1)) * \
+        SCAN_TEMP_FACTOR
+    if ring:
+        b *= 2
+    doublings = max(int(batch_b).bit_length() - 1, 0)
+    return int(b * BATCH_FUSION_GROWTH ** doublings)
+
+
+def default_pattern_chunk(n_patterns: int, n_partitions: int,
+                          n_slots: int, n_rows: int, n_caps: int,
+                          batch_b: int = 1, ring: bool = False,
+                          budget: int = CHUNK_HBM_BUDGET) -> int:
+    """Largest divisor-ladder chunk whose per-step transients fit the
+    HBM budget at the given batch factor."""
+    per = bank_chunk_bytes_per_pattern(n_partitions, n_slots, n_rows,
+                                       n_caps, batch_b, ring)
+    chunk = max(1, budget // max(per, 1))
+    for c in (500, 250, 200, 125, 100, 50, 25, 20, 10, 5, 4, 2, 1):
+        if c <= chunk and n_patterns % c == 0:
+            return c
+    return 1
+
+
 @dataclass
 class CostEntry:
     query: str

@@ -90,6 +90,10 @@ class AutomatonIR:
     meshed: bool = False
     batch_b: int = 1              # events per scan tick (ops/nfa fatter
     #                               ticks; 1 = legacy one-event chain)
+    stacked: bool = False         # pattern-bank chunks vmapped into one
+    #                               super-dispatch (round 7)
+    dispatches_per_block: int = 1  # device executions per ingest block
+    #                                (n_chunks when sequential, 1 stacked)
 
     @property
     def accept(self) -> int:
@@ -103,6 +107,8 @@ class AutomatonIR:
             "n_rows": self.n_rows, "n_caps": self.n_caps,
             "within_ms": self.within_ms,
             "batch_b": self.batch_b,
+            "stacked": self.stacked,
+            "dispatches_per_block": self.dispatches_per_block,
             "pruned_states": self.pruned_states,
             "simplified_conditions": self.simplified_conditions,
             "statically_dead": self.statically_dead,
@@ -158,6 +164,7 @@ class PlanIR:
                 f"P={a.n_partitions} K={a.n_slots} B={a.batch_b} "
                 f"R={a.n_rows} C={a.n_caps} within={a.within_ms} "
                 f"pruned={a.pruned_states} "
+                f"stacked={int(a.stacked)} dpb={a.dispatches_per_block} "
                 f"flags=[{','.join(flags)}]")
             for s in a.states:
                 extra = ""
@@ -283,6 +290,8 @@ def automaton_ir_from_nfa(nfa, query: str) -> AutomatonIR:
         pruned_states=int(report.get("pruned_states", 0)),
         simplified_conditions=int(report.get("simplified", 0)),
         statically_dead=bool(getattr(nfa, "statically_dead", False)),
+        stacked=bool(getattr(nfa, "_stacked", False)),
+        dispatches_per_block=int(getattr(nfa, "_dispatches_per_block", 1)),
         prune_notes=tuple(report.get("notes", ())),
         egress_cap=int(getattr(nfa, "_egress_cap", 1024)),
         meshed=getattr(nfa, "mesh", None) is not None,

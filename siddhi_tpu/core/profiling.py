@@ -35,7 +35,7 @@ class KernelStats:
     __slots__ = ("name", "calls", "compile_count", "dispatch_ns",
                  "device_ns", "batch_events", "h2d_bytes", "d2h_bytes",
                  "max_batch", "signatures", "live_bytes", "scan_ticks",
-                 "batch_b")
+                 "batch_b", "dispatch_count")
 
     def __init__(self, name: str):
         self.name = name
@@ -58,9 +58,15 @@ class KernelStats:
         # here (and is asserted in tests/test_nfa_batch.py)
         self.scan_ticks = 0
         self.batch_b = 0
+        # device executions launched (counter).  Usually == calls, but a
+        # site that launches several executables per wrapper call (or
+        # none, e.g. a cache hit) can correct it via record_dispatches;
+        # the C→1 claim of the stacked bank is asserted against this
+        self.dispatch_count = 0
 
     def as_dict(self) -> Dict[str, Any]:
         return {"calls": self.calls,
+                "dispatch_count": self.dispatch_count,
                 "compile_count": self.compile_count,
                 "dispatch_time_s": self.dispatch_ns / 1e9,
                 "device_time_s": self.device_ns / 1e9,
@@ -141,6 +147,7 @@ class ProfiledKernel:
         compiled = False
         with prof._lock:
             st.calls += 1
+            st.dispatch_count += 1
             st.dispatch_ns += t1 - t0
             if self._cache_size_fn is not None:
                 try:
@@ -194,6 +201,10 @@ class ProfiledKernel:
 class KernelProfiler:
     def __init__(self):
         self.kernels: Dict[str, KernelStats] = {}
+        # per-app {name: [dispatches, ingest_blocks]} — the runtimes
+        # report the device-dispatch delta of every ingest block here;
+        # the exported gauge is the running dispatches/block average
+        self.app_blocks: Dict[str, List[int]] = {}
         self.enabled = False
         self.device_timing = False
         self._lock = threading.Lock()
@@ -211,6 +222,7 @@ class KernelProfiler:
     def reset(self):
         with self._lock:
             self.kernels.clear()
+            self.app_blocks.clear()
 
     # ------------------------------------------------------------ recording
 
@@ -229,6 +241,36 @@ class KernelProfiler:
         if not self.enabled:
             return
         self.stats(name).d2h_bytes += int(nbytes)
+
+    def record_dispatches(self, name: str, n: int):
+        """Adjust a kernel's device-execution counter out-of-band: a
+        site that re-launches (egress overflow re-pack) adds, a cached
+        result subtracts nothing — __call__ already counted one."""
+        if not self.enabled:
+            return
+        self.stats(name).dispatch_count += int(n)
+
+    def total_dispatches(self) -> int:
+        """Sum of every kernel's dispatch_count — the runtimes diff this
+        around an ingest block to report dispatches/block per app."""
+        with self._lock:
+            return sum(st.dispatch_count for st in self.kernels.values())
+
+    def record_app_block(self, app: str, dispatches: int):
+        """One ingest block for `app` cost `dispatches` device launches."""
+        if not self.enabled:
+            return
+        with self._lock:
+            tot = self.app_blocks.setdefault(app, [0, 0])
+            tot[0] += int(dispatches)
+            tot[1] += 1
+
+    def dispatches_per_block(self, app: str) -> float:
+        with self._lock:
+            tot = self.app_blocks.get(app)
+        if not tot or not tot[1]:
+            return 0.0
+        return tot[0] / tot[1]
 
     def set_live_bytes(self, name: str, nbytes: int):
         """Gauge: current persistent device state owned by a kernel
@@ -263,6 +305,13 @@ class KernelProfiler:
             lines.append(
                 f"siddhi_kernel_scan_ticks_total{lb} {st.scan_ticks}")
             lines.append(f"siddhi_kernel_batch_b{lb} {st.batch_b}")
+            lines.append(
+                f"siddhi_kernel_dispatches_total{lb} {st.dispatch_count}")
+        for app, (disp, blocks) in list(self.app_blocks.items()):
+            if not blocks:
+                continue
+            lines.append('siddhi_app_dispatches_per_block{app="' + app +
+                         f'"}} {disp / blocks:.9g}')
         return lines
 
 

@@ -281,6 +281,12 @@ class QueryRuntime:
             if mode == "device":
                 raise
             return None
+        # NOTE: dwin egress is deliberately NOT routed through the app's
+        # EgressFuser.  Window steps (timer ticks especially) dispatch and
+        # read back synchronously, so there is never a second runtime's
+        # buffer to share the slab with — fusing would only add the
+        # seal/rotate device ops per tick.  Fusion covers the per-block
+        # pattern/filter/wagg/gagg egress (see plan/planner.py).
         self.backend = "device"
         self.backend_reason = ("hybrid: window state/evictions on device "
                                "(dwin kernel), selector host")

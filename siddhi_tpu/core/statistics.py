@@ -497,6 +497,10 @@ _TYPES = [
      "counter", "Device->host bytes retired from a kernel"),
     ("siddhi_kernel_batch_events_total",
      "counter", "Events carried through a kernel"),
+    ("siddhi_kernel_dispatches_total",
+     "counter", "Device executions launched by a kernel"),
+    ("siddhi_app_dispatches_per_block",
+     "gauge", "Device dispatches per ingest block (running average)"),
 ]
 
 

@@ -233,9 +233,21 @@ def build_dwin_step(spec: DwinSpec):
                                                 W, F, I)
             new_carry.update(ring_f=sf, ring_i=si, ring_ts=sts,
                              fill=nfill)
-            # min live ts drives the host's next gap timer
-            live_min = jnp.min(jnp.where(
-                jnp.arange(W)[None, :] < nfill[:, None], sts, TS_NONE))
+            # the host re-arms its gap timer at (reported min + gap), so
+            # report the min over live entries of their KEY'S last
+            # activity in the post-step ring — a session expires at
+            # last+gap, not at its oldest event + gap.  Reporting the
+            # min event ts re-armed the timer at an instant where
+            # nothing can expire (oldest event's key stayed active),
+            # which in playback degenerated to 1 ms timer crawl —
+            # 50k+ dispatches on a 60-event stream.
+            w_live = jnp.arange(W)[None, :] < nfill[:, None]
+            k_new = si[:, :, spec.skey_lane]
+            same_new = (k_new[:, None, :] == k_new[:, :, None]) & \
+                w_live[:, None, :]
+            last_new = jnp.max(jnp.where(same_new, sts[:, None, :], NEG),
+                               axis=2)
+            live_min = jnp.min(jnp.where(w_live, last_new, TS_NONE))
             buf = _pack_egress(expired, j, evict_ts, cause, pts, pf, pi,
                                (jnp.max(nfill), jnp.int32(0), live_min,
                                 jnp.max(ovf.astype(jnp.int32))), cap)

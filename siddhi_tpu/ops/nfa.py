@@ -74,6 +74,22 @@ def resolve_batch_b(batch_b: Optional[int] = None) -> int:
     return max(1, int(batch_b))
 
 
+#: Chunk stacking (round 7): a bank of C homogeneous-shape pattern
+#: chunks runs as ONE jitted super-dispatch (vmap over the chunk axis)
+#: instead of C sequential device calls.  ``=0``/``off`` restores the
+#: legacy sequential chunk loop.
+STACK_ENV = "SIDDHI_TPU_NFA_STACK"
+
+
+def resolve_stack(stack: Optional[bool] = None) -> bool:
+    """Effective chunk-stacking switch: explicit argument wins, else the
+    STACK_ENV value (default on; 0/false/off disables)."""
+    if stack is None:
+        raw = os.environ.get(STACK_ENV, "").strip().lower()
+        return raw not in ("0", "false", "off", "no")
+    return bool(stack)
+
+
 class UnitSpec(NamedTuple):
     """One chain position (≙ one Pre/PostStateProcessor pair)."""
     kind: str                 # 'simple' | 'count' | 'logical' | 'absent'
@@ -1200,6 +1216,27 @@ def build_bank_step(spec: NfaSpec, ring: int = 0,
                                                             block)
 
     return bank_step
+
+
+def build_super_bank_step(spec: NfaSpec, ring: int = 0,
+                          batch_b: Optional[int] = None):
+    """C homogeneous pattern chunks stepped as ONE dispatch.
+
+    Returns jittable fn(carry, block, params):
+      carry:  stacked bank carry [C, N, P, ...] (one array per leaf)
+      block:  one [P, T] event block, shared by every chunk
+      params: {param_name: [C, N]} stacked per-pattern constant lanes
+
+    Semantically identical to running ``build_bank_step`` C times on the
+    per-chunk slices (patterns never interact), but XLA sees a single
+    executable and the runtime pays one launch per ingest block instead
+    of C — the dispatch-side half of "fewer, fatter steps"."""
+    bank = build_bank_step(spec, ring=ring, batch_b=batch_b)
+
+    def super_step(carry, block, params):
+        return jax.vmap(bank, in_axes=(0, None, 0))(carry, block, params)
+
+    return super_step
 
 
 def make_bank_carry(spec: NfaSpec, n_patterns: int,

@@ -238,6 +238,10 @@ class DeviceWindowProcessor(WindowProcessor):
         fn = self._steps.get(key)
         if fn is None:
             from ..core.profiling import wrap_kernel
+            # NO carry donation here: _step_work keeps a pre-carry
+            # reference per work item and _read_work rewinds to it on
+            # ring overflow (grow-and-replay), so the input buffers must
+            # outlive the step.
             fn = wrap_kernel(
                 f"dwin.{self.kind}.step",
                 jax.jit(build_dwin_step(self._spec()), static_argnums=7))
@@ -489,11 +493,12 @@ class DeviceWindowProcessor(WindowProcessor):
                                jnp.asarray(ev_i), jnp.asarray(ts_off),
                                jnp.asarray(valid), jnp.asarray(now_arr),
                                jnp.asarray(directive), cap)
+        work["buf"] = buf
+        work["buf_host"] = None             # invalidate any prior read
         try:
             buf.copy_to_host_async()
         except Exception:       # backends without async copy
             pass
-        work["buf"] = buf
 
     def _read_work(self, work: dict):
         """Block on a work item's egress; on ring overflow rewind to ITS
@@ -501,7 +506,7 @@ class DeviceWindowProcessor(WindowProcessor):
         drained any later in-flight work).  Updates the host fill mirrors
         and splits the egress rows."""
         while True:
-            buf = np.asarray(work["buf"])
+            buf = self._host_buf(work)
             tail = buf[-1]
             if int(tail[4]) == 0:         # no overflow
                 break
@@ -573,8 +578,18 @@ class DeviceWindowProcessor(WindowProcessor):
                 self._retire_work(self._inflight.popleft())
         self._locked(run)
 
+    def _host_buf(self, work: dict) -> np.ndarray:
+        """Host copy of a work item's egress buffer, cached per step so
+        the retire-time overflow pre-check and the decode share one
+        transfer; _step_work invalidates on replay."""
+        buf = work.get("buf_host")
+        if buf is None:
+            buf = np.asarray(work["buf"])
+            work["buf_host"] = buf
+        return buf
+
     def _retire_work(self, work: dict) -> None:
-        buf = np.asarray(work["buf"])
+        buf = self._host_buf(work)
         if int(buf[-1][4]) != 0:
             # ring overflow: later in-flight steps ran on the overflowed
             # carry — rewind to this work's pre-carry, grow, replay all
@@ -648,12 +663,14 @@ class DeviceWindowProcessor(WindowProcessor):
             # due sessions emit BEFORE the chunk (the host expires first,
             # so same-key chunk events start a fresh session), grouped in
             # session-first-arrival order; the EXPIRED timestamp is
-            # last-activity + gap (the kernel's evict column)
-            out = chunk.with_types(CURRENT)
+            # last-activity + gap (the kernel's evict column).  The host
+            # emits that expiry batch as its OWN callback (its
+            # _expire_sessions runs before the append), so the split —
+            # not a concat — is what parity observes
             if len(rf):
-                expired = self._session_expired_chunk(evt, rf, ri, base)
-                out = EventChunk.concat([expired, out])
-            self.send_next(out)
+                self.send_next(self._session_expired_chunk(evt, rf, ri,
+                                                           base))
+            self.send_next(chunk.with_types(CURRENT))
         elif self.kind == "delay":
             if len(rf):
                 self.send_next(self._rows_to_chunk(
@@ -786,11 +803,25 @@ class DeviceWindowProcessor(WindowProcessor):
                 if self.next_emit is not None:
                     self.app_ctx.scheduler.notify_at(self.next_emit,
                                                      self._on_timer)
-            elif self._fill_host:
+            elif self._fill_host and self.kind != "session":
+                # no re-arm for session: every data chunk already
+                # schedules chunk_end + gap (on_data), which covers all
+                # its sessions (last activity <= chunk end), and the
+                # reference SessionWindowProcessor observes expiry ONLY
+                # at those instants — a min-activity re-arm would emit
+                # the same rows grouped at instants the host never fires
                 mn = self._last_min_live
                 if mn is not None:
-                    self.app_ctx.scheduler.notify_at(
-                        mn + self.window_ms, self._on_timer)
+                    nxt = mn + self.window_ms
+                    if nxt <= now:
+                        # the kernel evicts strictly AFTER the gap, so a
+                        # wakeup at exactly min+gap re-observes the same
+                        # min and would re-arm at the same instant — in
+                        # playback advance_to() that is an infinite loop
+                        # at one virtual ms (seen: 300k+ dispatches on a
+                        # 60-event session stream)
+                        nxt = now + 1
+                    self.app_ctx.scheduler.notify_at(nxt, self._on_timer)
         self._locked(run)
 
     _last_min_live: Optional[int] = None

@@ -277,13 +277,20 @@ class CompiledGroupedAgg:
     def _build_step(self):
         from ..core.profiling import wrap_kernel
         if self.window_kind == "time":
+            # no donation: decode's GaggOverflow rewind replays from the
+            # chunk's pre-carry, which must survive the step
             self._step = wrap_kernel("gagg.time.step", jax.jit(
                 build_grouped_time_step(
                     self.window_ms, self.window, self.want_forever)))
         else:
+            # length/running carries donate (XLA aliases the [P, G, V]
+            # slabs in place) UNLESS exact int sums are wanted — their
+            # bound trips in decode and rewinds to the pre-carry
+            donate = () if self._int_sum_needed else (0,)
             self._step = wrap_kernel("gagg.step", jax.jit(
                 build_grouped_step(
-                    self.window, self.want_minmax, self.want_forever)))
+                    self.window, self.want_minmax, self.want_forever),
+                donate_argnums=donate))
 
     def _make_carry(self, n_lanes: int, n_groups: Optional[int] = None):
         g = self.n_groups if n_groups is None else n_groups
@@ -477,14 +484,27 @@ class CompiledGroupedAgg:
     def redispatch(self, work: Dict[str, Any]) -> None:
         """(Re)run a work item's kernel step on the CURRENT carry —
         used at dispatch and when replaying in-flight chunks after a
-        ring growth rewind."""
-        work["pre_carry"] = self.carry
+        ring growth rewind.  Donated configs (length/running without
+        exact int sums — see _build_step) never rewind, so pre_carry is
+        None there: touching it is a bug, not a stale read."""
+        donated = (self.window_kind != "time" and
+                   not self._int_sum_needed)
+        work["pre_carry"] = None if donated else self.carry
         self.carry, outs = self._step(self.carry, *work["planes"])
-        for o in outs:
-            try:
-                o.copy_to_host_async()
-            except Exception:   # backends without async copy
-                break
+        fuser = getattr(self, "egress_fuser", None)
+        if fuser is not None:
+            # outputs (and the time ring's overflow flag, read first in
+            # decode) ride the app's per-ingest-block slab
+            extra = ([self.carry.overflow]
+                     if self.window_kind == "time" else [])
+            work["fuse"] = fuser.register(self, list(outs) + extra)
+        else:
+            work["fuse"] = None
+            for o in outs:
+                try:
+                    o.copy_to_host_async()
+                except Exception:   # backends without async copy
+                    break
         work["outs"] = outs
         work["post_carry"] = self.carry
 
@@ -510,12 +530,21 @@ class CompiledGroupedAgg:
         @OnError continuation must not see the chunk half-applied)."""
         data, ok = work["data"], work["ok"]
         lanes32, row = work["lanes32"], work["row"]
-        if self.window_kind == "time" and \
-                bool(np.asarray(work["post_carry"].overflow).any()):
-            raise GaggOverflow()
+        token = work.get("fuse")
+        if token is not None:
+            fetched = token.fetch()
+            if self.window_kind == "time":
+                if bool(np.asarray(fetched[-1]).any()):
+                    raise GaggOverflow()
+                fetched = fetched[:-1]
+            outs_host = fetched
+        else:
+            if self.window_kind == "time" and \
+                    bool(np.asarray(work["post_carry"].overflow).any()):
+                raise GaggOverflow()
+            outs_host = [np.asarray(o) for o in work["outs"]]
         (fhi, flo, ihi, ilo, cnt, w_mnf, w_mxf, w_mni, w_mxi,
-         a_mnf, a_mxf, a_mni, a_mxi) = [np.asarray(o)
-                                        for o in work["outs"]]
+         a_mnf, a_mxf, a_mni, a_mxi) = outs_host
         sel_l, sel_r = lanes32[ok], row[ok]
 
         def pick(a):

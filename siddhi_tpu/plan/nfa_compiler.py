@@ -43,7 +43,7 @@ from ..query_api.definition import AttrType
 from ..query_api.expression import (And, Compare, CompareOp, Constant, IsNull,
                                     Not, Or, TimeConstant, Variable,
                                     variables_of)
-from ..utils.errors import SiddhiAppCreationError
+from ..utils.errors import SiddhiAppCreationError, SiddhiAppRuntimeException
 from .expr_compiler import EvalCtx, ExprCompiler, Scope
 
 _NUMERIC = (AttrType.INT, AttrType.LONG, AttrType.FLOAT, AttrType.DOUBLE)
@@ -579,7 +579,8 @@ class CompiledPatternNFA:
                  n_slots: int = 8, query_name: Optional[str] = None,
                  parameterize: bool = False, query: Optional[Query] = None,
                  mesh: Any = "auto", prune: Optional[bool] = None,
-                 batch_b: Optional[int] = None):
+                 batch_b: Optional[int] = None,
+                 donate: Optional[bool] = None):
         """mesh: "auto" (default) shards the partition axis over all local
         devices when more than one exists (parallel/mesh.auto_mesh); a
         jax.sharding.Mesh pins an explicit mesh; None forces single-device.
@@ -593,10 +594,19 @@ class CompiledPatternNFA:
 
         batch_b: events consumed per scan tick (ops/nfa fatter-tick
         restructuring; default resolves SIDDHI_TPU_NFA_BATCH, 1 = legacy
-        one-event ticks — the kill switch)."""
+        one-event ticks — the kill switch).
+
+        donate: donate the carry to the jitted step so XLA aliases it in
+        place instead of copying every block.  A donated input buffer is
+        invalidated by the step, which forfeits grow-and-replay — the
+        default (None) therefore resolves per path: single-device engine
+        steps stay undonated (they replay overflowing chunks from the
+        pre-chunk carry), mesh steps donate unless mid-chain `every`
+        forces replayability (parallel/mesh.py round 5 semantics)."""
         app = (SiddhiCompiler.parse(app_string)
                if isinstance(app_string, str) else app_string)
         self.app = app
+        self.donate = donate
         if query is None:
             query = self._pick_query(app, query_name)
         sis = query.input_stream
@@ -1505,14 +1515,24 @@ class CompiledPatternNFA:
                 sum(int(getattr(v, "nbytes", 0)) for v in placed.values()))
         return placed
 
+    def _effective_donate(self) -> bool:
+        """Resolved carry-donation policy (see __init__ docstring):
+        explicit `donate` wins; otherwise single-device engine steps stay
+        undonated (grow-and-replay reads the pre-chunk carry) and mesh
+        steps donate unless mid-chain `every` forces replayability."""
+        if self.donate is not None:
+            return bool(self.donate)
+        return self.mesh is not None and not self.spec.mid_every
+
     @property
     def replayable(self) -> bool:
         """True when grow-and-replay is available (the input carry
         survives the step).  Mid-chain `every` forks clones, so the live
         partial population has no static per-chunk bound — the mesh
         path's proactive slot growth cannot guarantee no drops, and the
-        step must stay undonated so overflowing chunks can replay."""
-        return self.mesh is None or bool(self.spec.mid_every)
+        step must stay undonated so overflowing chunks can replay.
+        Donating the carry (donate=True) forfeits replay symmetrically."""
+        return not self._effective_donate()
 
     def _jit_step(self):
         from ..core.profiling import wrap_kernel
@@ -1525,17 +1545,20 @@ class CompiledPatternNFA:
                     (-(-int(block["__ts"].shape[-1]) // B), B)
                     if "__ts" in block else (0, B))
         if self.mesh is None:
-            # no donation: the engine path replays a chunk from the
-            # pre-chunk carry after a slot overflow (grow-and-replay), so
-            # the input carry must survive the step
+            # default: no donation — the engine path replays a chunk from
+            # the pre-chunk carry after a slot overflow (grow-and-replay),
+            # so the input carry must survive the step; donate=True
+            # (standalone non-replaying drivers) aliases it in place
+            donate = (0,) if self._effective_donate() else ()
             return wrap_kernel("nfa.step",
-                               jax.jit(build_block_step(self.spec)),
+                               jax.jit(build_block_step(self.spec),
+                                       donate_argnums=donate),
                                batch_of=batch_of, ticks_of=ticks_of)
         from ..parallel.mesh import jit_engine_step
         return wrap_kernel(
             "nfa.mesh_step",
             jit_engine_step(self.spec, self.mesh,
-                            donate=not self.spec.mid_every),
+                            donate=self._effective_donate()),
             batch_of=batch_of, ticks_of=ticks_of)
 
     def grow(self, n_partitions: int) -> None:
@@ -1706,12 +1729,20 @@ class CompiledPatternNFA:
         dl = self.carry.get("deadline") if self.has_absent else None
         buf = self._egress_jit(mask, caps, ts, enter, seq, dropped,
                                dl_st, dl, self._egress_cap)
-        try:
-            buf.copy_to_host_async()
-        except Exception:       # backends without async copy: retire blocks
-            pass
-        return {"buf": buf, "cap": self._egress_cap, "outs": outs,
-                "dropped": dropped, "dl_st": dl_st, "dl": dl,
+        fuser = getattr(self, "egress_fuser", None)
+        token = None
+        if fuser is not None:
+            # per-app fused egress (plan/pipeline.EgressFuser): the buffer
+            # rides the app's per-ingest-block slab — ONE D2H per block
+            # shared with every other device runtime, no per-buffer copy
+            token = fuser.register(self, [buf])
+        else:
+            try:
+                buf.copy_to_host_async()
+            except Exception:   # backends without async copy: retire blocks
+                pass
+        return {"buf": buf, "fuse": token, "cap": self._egress_cap,
+                "outs": outs, "dropped": dropped, "dl_st": dl_st, "dl": dl,
                 "dl_base": self.base_ts, "tk": (T, K)}
 
     def egress_retire(self, handle):
@@ -1719,9 +1750,15 @@ class CompiledPatternNFA:
         match count overflowed (one retrace, results exact).  Side effect:
         sets self.last_dropped_total (drives grow-and-replay without an
         extra sync)."""
-        buf = np.asarray(handle["buf"])
-        from ..core.profiling import profiler
-        profiler().record_d2h("nfa.egress_pack", buf.nbytes)
+        token = handle.get("fuse")
+        if token is not None:
+            # the slab read (one per ingest block, all runtimes) is
+            # accounted by the fuser under "egress.fuse"
+            buf = token.fetch()[0]
+        else:
+            buf = np.asarray(handle["buf"])
+            from ..core.profiling import profiler
+            profiler().record_d2h("nfa.egress_pack", buf.nbytes)
         count = int(buf[-1, 0])
         self.last_dropped_total = int(buf[-1, 1])
         while count > handle["cap"]:
@@ -2098,9 +2135,25 @@ class CompiledPatternBank:
 
     def __init__(self, apps: Sequence[str], n_partitions: int,
                  n_slots: int = 8, pattern_chunk: Optional[int] = None,
-                 ring: int = 0, batch_b: Optional[int] = None):
+                 ring: int = 0, batch_b: Optional[int] = None,
+                 stack: Optional[bool] = None, replayable: bool = False):
+        """stack: run all homogeneous pattern chunks as ONE jitted
+        super-dispatch ([C, N, ...] stacked carry, vmap over the chunk
+        axis — ops/nfa.build_super_bank_step) instead of C sequential
+        device calls.  Default resolves SIDDHI_TPU_NFA_STACK (on; =0 is
+        the kill switch restoring the legacy chunk loop).  Chunks are
+        homogeneous by construction (same NfaSpec geometry, constants
+        live in parameter lanes); a heterogeneous bank would fall back
+        to the sequential path the kill switch keeps alive.
+
+        replayable: keep the step undonated and snapshot the pre-block
+        carry so process_block_replayed can rewind + grow the slot ring
+        + replay a whole block after an overflow — rewind happens at
+        super-dispatch granularity (the full stacked bank as one unit).
+        Default False: the bank donates its carry (XLA aliases it in
+        place) and drops overflowing partials into `dropped`."""
         import jax
-        from ..ops.nfa import build_bank_step, make_bank_carry
+        from ..ops.nfa import make_bank_carry, resolve_stack
         # the bank carries its own [N, P, ...] state and steps it with its
         # own jit; multi-device banks go through parallel/distributed.
         # DistributedPatternBank, so the inner NFA stays single-device
@@ -2131,61 +2184,193 @@ class CompiledPatternBank:
             sl = slice(ci * self.chunk, (ci + 1) * self.chunk)
             self.params.append({k: jnp.asarray(v[sl], jnp.float32)
                                 for k, v in lanes.items()})
-        self.carries = [make_bank_carry(self.nfa.spec, self.chunk,
-                                        n_partitions)
-                        for _ in range(self.n_chunks)]
-        from ..core.profiling import profiler, wrap_kernel
-        if profiler().enabled:
-            # logical carry footprint (broadcast views materialize dense
-            # on the first donated step) — the measured side of the cost
-            # model's bank_state_bytes prediction
-            profiler().set_live_bytes(
-                "nfa.bank_step",
-                sum(int(getattr(v, "nbytes", 0))
-                    for c in self.carries for v in c.values()))
+        # stacking only pays (and only changes shapes) with >1 chunk; a
+        # single chunk is already one dispatch per block
+        self.stacked = resolve_stack(stack) and self.n_chunks > 1
+        self.replayable = bool(replayable)
+        carries = [make_bank_carry(self.nfa.spec, self.chunk, n_partitions)
+                   for _ in range(self.n_chunks)]
+        if self.stacked:
+            # ONE [C, N, ...] array per leaf — element-identical to the C
+            # separate chunk carries (cost_model.stacked_bank_state_bytes
+            # asserts the byte equality)
+            self._stack_carry = {
+                k: jnp.stack([c[k] for c in carries]) for k in carries[0]}
+            self._stack_params = {
+                k: jnp.stack([p[k] for p in self.params])
+                for k in self.params[0]}
+            self._carries = None
+        else:
+            self._stack_carry = self._stack_params = None
+            self._carries = carries
+        # surfaced in Plan-IR dumps (analysis/plan_ir.automaton_ir_from_nfa)
+        self.nfa._stacked = self.stacked
+        self.nfa._dispatches_per_block = 1 if self.stacked else self.n_chunks
+        self._set_live_bytes()
+        self._build_step()
+        self.base_ts: Optional[int] = None
+
+    @property
+    def carries(self):
+        """Per-chunk carry dicts ([N, P, ...] leaves).  Stacked banks
+        serve read-only views into the [C, N, ...] super-carry; mutate
+        through process_block / grow_slots, not through these."""
+        if self.stacked:
+            return [{k: v[ci] for k, v in self._stack_carry.items()}
+                    for ci in range(self.n_chunks)]
+        return self._carries
+
+    def _set_live_bytes(self):
+        from ..core.profiling import profiler
+        if not profiler().enabled:
+            return
+        # logical carry footprint (broadcast views materialize dense on
+        # the first donated step) — the measured side of the cost model's
+        # bank_state_bytes / stacked_bank_state_bytes prediction
+        if self.stacked:
+            nbytes = sum(int(v.nbytes) for v in self._stack_carry.values())
+        else:
+            nbytes = sum(int(getattr(v, "nbytes", 0))
+                         for c in self._carries for v in c.values())
+        profiler().set_live_bytes("nfa.bank_step", nbytes)
+
+    def _build_step(self):
+        import jax
+        from ..ops.nfa import build_bank_step, build_super_bank_step
+        from ..core.profiling import wrap_kernel
+        build = build_super_bank_step if self.stacked else build_bank_step
+        # replayable banks rewind to the pre-block carry after a slot
+        # overflow, so the input carry must survive the step; otherwise
+        # donate — XLA aliases the carry slabs in place
+        donate = () if self.replayable else (0,)
         B = max(self.nfa.batch_b, 1)
         self._step = wrap_kernel(
             "nfa.bank_step",
-            jax.jit(build_bank_step(self.nfa.spec, ring=self.ring),
-                    donate_argnums=0),
+            jax.jit(build(self.nfa.spec, ring=self.ring),
+                    donate_argnums=donate),
             batch_of=lambda carry, block, params:
                 int(block["__ts"].size) if "__ts" in block else 0,
             ticks_of=lambda carry, block, params:
                 (-(-int(block["__ts"].shape[-1]) // B), B)
                 if "__ts" in block else (0, B))
-        self.base_ts: Optional[int] = None
 
     def _default_chunk(self, n_partitions: int, n_slots: int) -> int:
+        # carry bytes × ~16 for scan/vmap intermediates, ×2 for a decode
+        # ring, ×~3.2 per B-doubling for XLA's fusion duplication of the
+        # hoisted gate tensors (measured round 6: defaults must not spill
+        # at SIDDHI_TPU_NFA_BATCH=4) — the formula lives in
+        # analysis/cost_model so tests can assert this sizing against it
+        from ..analysis.cost_model import default_pattern_chunk
         spec = self.nfa.spec
-        # carry bytes × ~16 for scan/vmap intermediates (measured on v5e:
-        # N=1000 P=10k K=8 S=2 C=1 wants ~22G); a decode ring consumes the
-        # per-step match_caps (no longer DCE'd), roughly doubling caps temps
-        bytes_per_pattern = n_partitions * n_slots * (
-            4 + 4 + 4 * max(spec.n_rows, 1) * max(spec.n_caps, 1)) * 16
-        if self.ring:
-            bytes_per_pattern *= 2
-        budget = 8 << 30      # leave headroom below ~16G HBM
-        chunk = max(1, budget // max(bytes_per_pattern, 1))
-        for c in (500, 250, 200, 125, 100, 50, 25, 20, 10, 5, 4, 2, 1):
-            if c <= chunk and self.n_patterns % c == 0:
-                return c
-        return 1
+        return default_pattern_chunk(
+            self.n_patterns, n_partitions, n_slots, spec.n_rows,
+            spec.n_caps, batch_b=max(self.nfa.batch_b, 1),
+            ring=bool(self.ring))
 
     def process_block(self, block):
         """ring == 0 → per-pattern match counts for this block ([N] int32).
 
         ring > 0 → (counts [N], ring_cnt [N, ring], ring_pid [N, ring],
         ring_caps [N, ring, R, C], ring_ts [N, ring], ring_ok [N, ring]) —
-        the bounded match payload buffer (see ops/nfa.build_bank_step)."""
+        the bounded match payload buffer (see ops/nfa.build_bank_step).
+
+        Stacked banks (SIDDHI_TPU_NFA_STACK, the default with >1 chunk)
+        pay ONE device dispatch here; the legacy path dispatches once per
+        chunk."""
+        if self.stacked:
+            self._stack_carry, res = self._step(self._stack_carry, block,
+                                                self._stack_params)
+            if not self.ring:
+                return res.reshape(-1)                # [C, n] → [N]
+            return tuple(r.reshape((-1,) + r.shape[2:]) for r in res)
         outs = []
         for ci in range(self.n_chunks):
-            self.carries[ci], res = self._step(self.carries[ci], block,
-                                               self.params[ci])
+            self._carries[ci], res = self._step(self._carries[ci], block,
+                                                self.params[ci])
             outs.append(res)
         if not self.ring:
             return jnp.concatenate(outs)
         return tuple(jnp.concatenate([o[i] for o in outs])
                      for i in range(6))
+
+    def total_dropped(self) -> int:
+        """Cumulative slot-ring evictions over all patterns (syncs)."""
+        if self.stacked:
+            return int(np.asarray(self._stack_carry["dropped"]).sum())
+        return sum(int(np.asarray(c["dropped"]).sum())
+                   for c in self._carries)
+
+    def grow_slots(self, n_slots: int) -> None:
+        """Widen the K (concurrent-partials) axis of every chunk carry
+        and rebuild the step — the bank analogue of
+        CompiledPatternNFA.grow_slots."""
+        if n_slots <= self.nfa.spec.n_slots:
+            return
+        pad = n_slots - self.nfa.spec.n_slots
+        R = max(self.nfa.spec.n_rows, 1)
+        C = max(self.nfa.spec.n_caps, 1)
+
+        def widen(c, axis):
+            c = {k: np.asarray(v) for k, v in c.items()}
+            lead = c["slot_state"].shape[:axis]
+
+            def cat(key, fill, dt, extra=()):
+                c[key] = np.concatenate(
+                    [c[key], np.full(lead + (pad,) + extra, fill, dt)],
+                    axis=axis)
+            cat("slot_state", -1, np.int32)
+            cat("slot_start", 0, np.int32)
+            cat("slot_enter", 0, np.int32)
+            cat("slot_seq", 0, np.int32)
+            cat("captures", 0.0, np.float32, (R, C))
+            if "cnt_cur" in c:
+                cat("cnt_cur", 0, np.int32)
+                cat("cnt_prev", -1, np.int32)
+            if "lmask" in c:
+                cat("lmask", 0, np.int32)
+            if "deadline" in c:
+                cat("deadline", 0, np.int32)
+            return {k: jnp.asarray(v) for k, v in c.items()}
+
+        if self.stacked:
+            # slot axis of the [C, N, P, K, ...] super-carry
+            self._stack_carry = widen(self._stack_carry, 3)
+        else:
+            self._carries = [widen(c, 2) for c in self._carries]
+        # keep the inner (parameterized) NFA's spec/step consistent —
+        # it owns the NfaSpec the bank compiles against
+        self.nfa.grow_slots(n_slots)
+        self._set_live_bytes()
+        self._build_step()
+
+    def process_block_replayed(self, block):
+        """process_block with grow-and-replay at SUPER-DISPATCH
+        granularity: snapshot the pre-block carry, step the whole bank
+        as one unit, and if the slot ring evicted partials, rewind the
+        ENTIRE bank to the snapshot, double K, and replay the same block
+        (one re-dispatch, not per-chunk bookkeeping).  Requires
+        replayable=True (undonated step — the snapshot must survive)."""
+        if not self.replayable:
+            raise SiddhiAppCreationError(
+                "process_block_replayed needs a CompiledPatternBank "
+                "built with replayable=True (undonated step)")
+        for _ in range(16):         # 2^16 x slots: far past any real feed
+            if self.stacked:
+                pre = dict(self._stack_carry)
+            else:
+                pre = [dict(c) for c in self._carries]
+            before = self.total_dropped()
+            res = self.process_block(block)
+            if self.total_dropped() == before:
+                return res
+            # rewind the whole super-dispatch, grow, replay
+            if self.stacked:
+                self._stack_carry = pre
+            else:
+                self._carries = pre
+            self.grow_slots(self.nfa.spec.n_slots * 2)
+        raise SiddhiAppRuntimeException(
+            "pattern bank slot ring failed to stabilise after 16 growths")
 
     def decode_ring(self, ring_cnt, ring_pid, ring_caps, ring_ts, ring_ok):
         """Vectorised host decode of a block's match-ring payloads.

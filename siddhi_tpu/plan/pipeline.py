@@ -25,12 +25,27 @@ worker's idle/drain hooks); ``@app:pipeline('D')`` forces a depth.
 """
 from __future__ import annotations
 
+import os
+import threading
 from collections import deque
-from typing import Any, Dict, Iterable
+from typing import Any, Dict, Iterable, List, Optional
 
 from ..query_api.annotation import find_annotation
 
 DEFAULT_DEPTH = 4
+
+#: Fused per-app egress (round 7): every device runtime's compacted
+#: match/output buffers for one ingest block concatenate into ONE int32
+#: slab read back with a single D2H.  ``=0``/``off`` restores the
+#: per-runtime reads.
+EGRESS_FUSE_ENV = "SIDDHI_TPU_EGRESS_FUSE"
+
+
+def resolve_egress_fuse(fuse: Optional[bool] = None) -> bool:
+    if fuse is None:
+        raw = os.environ.get(EGRESS_FUSE_ENV, "").strip().lower()
+        return raw not in ("0", "false", "off", "no")
+    return bool(fuse)
 
 
 def resolve_depth(app, junctions: Iterable[Any]) -> int:
@@ -68,3 +83,147 @@ class PipelinedDeviceIngest:
 
     def _retire(self, work: Dict[str, Any]) -> None:
         raise NotImplementedError
+
+
+class _FuseToken:
+    """One runtime's registration in a fuse group: fetch() returns the
+    registered buffers as host ndarrays, decoded from the group's slab."""
+
+    __slots__ = ("group", "index")
+
+    def __init__(self, group: "_FuseGroup", index: int):
+        self.group = group
+        self.index = index
+
+    def fetch(self) -> List[Any]:
+        return self.group.fetch(self.index)
+
+
+class _FuseGroup:
+    """The buffers every device runtime registered during ONE ingest
+    block.  seal() packs them into a single int32 slab on device (floats
+    bitcast, bools widened) and starts its async D2H; the first fetch()
+    blocks on that one transfer and serves per-registration host views."""
+
+    __slots__ = ("fuser", "entries", "owners", "sealed", "_slab", "_host")
+
+    def __init__(self, fuser: "EgressFuser"):
+        self.fuser = fuser
+        self.entries: List[List[Any]] = []   # per-registration buffer list
+        self.owners: set = set()
+        self.sealed = False
+        self._slab = None
+        self._host = None
+
+    def seal(self) -> None:
+        if self.sealed:
+            return
+        self.sealed = True
+        import jax
+        import jax.numpy as jnp
+        pieces = []
+        for bufs in self.entries:
+            for b in bufs:
+                dt = str(b.dtype)
+                if dt == "float32":
+                    pieces.append(jax.lax.bitcast_convert_type(
+                        b, jnp.int32).reshape(-1))
+                elif dt == "int32":
+                    pieces.append(b.reshape(-1))
+                elif dt == "uint32":
+                    pieces.append(jax.lax.bitcast_convert_type(
+                        b, jnp.int32).reshape(-1))
+                elif dt == "bool":
+                    pieces.append(b.reshape(-1).astype(jnp.int32))
+                else:
+                    # no 4-byte view (x64 lanes etc.): read it separately
+                    pieces.append(None)
+        fusible = [p for p in pieces if p is not None]
+        if fusible:
+            self._slab = (jnp.concatenate(fusible) if len(fusible) > 1
+                          else fusible[0])
+            try:
+                self._slab.copy_to_host_async()
+            except Exception:   # backends without async copy: fetch blocks
+                pass
+
+    def fetch(self, index: int) -> List[Any]:
+        import numpy as np
+        with self.fuser._lock:
+            if self is self.fuser._current:
+                # a retire caught up with the open block (depth-0 lag):
+                # close it so the slab covers what was registered
+                self.fuser._rotate()
+            self.seal()
+            if self._host is None and self._slab is not None:
+                self._host = np.asarray(self._slab)       # the ONE D2H
+                self.fuser.d2h_count += 1
+                from ..core.profiling import profiler
+                profiler().record_d2h("egress.fuse", self._host.nbytes)
+            out: List[Any] = []
+            off = 0
+            host = self._host
+            for ri, bufs in enumerate(self.entries):
+                for b in bufs:
+                    dt = str(b.dtype)
+                    n = int(np.prod(b.shape)) if b.shape else 1
+                    if dt in ("float32", "int32", "uint32"):
+                        view = host[off:off + n].view(dt).reshape(b.shape)
+                        off += n
+                    elif dt == "bool":
+                        view = host[off:off + n].astype(
+                            bool).reshape(b.shape)
+                        off += n
+                    else:
+                        view = np.asarray(b)          # unfused extra read
+                    if ri == index:
+                        out.append(view)
+            return out
+
+
+class EgressFuser:
+    """Per-app egress consolidation: device runtimes register the un-read
+    output buffers of each dispatched block; registrations between block
+    boundaries form a group, and each group is read back as one slab.
+
+    Block boundaries need no junction hook: a runtime registers exactly
+    once per ingest block, so a repeat registration by the same owner IS
+    the next block — the open group seals (slab concat + async D2H
+    start, overlapping later dispatches) and a fresh one opens.  With
+    pipelining depth 0 a runtime retires inside its own ingest and
+    groups degenerate to singletons — exactly the per-runtime reads the
+    legacy path pays, never worse."""
+
+    def __init__(self, name: str = "app"):
+        self.name = name
+        self._lock = threading.RLock()
+        self._current = _FuseGroup(self)
+        self.d2h_count = 0
+        self.blocks = 0
+
+    def _rotate(self) -> None:
+        grp = self._current
+        self._current = _FuseGroup(self)
+        self.blocks += 1
+        grp.seal()
+
+    def register(self, owner: Any, buffers: List[Any]) -> _FuseToken:
+        with self._lock:
+            if id(owner) in self._current.owners:
+                self._rotate()
+            grp = self._current
+            grp.owners.add(id(owner))
+            grp.entries.append(list(buffers))
+            return _FuseToken(grp, len(grp.entries) - 1)
+
+
+def egress_fuser_for(app) -> Optional[EgressFuser]:
+    """The app runtime's shared fuser (lazily created), or None when
+    EGRESS_FUSE_ENV disables fusion."""
+    if app is None or not resolve_egress_fuse():
+        return None
+    fuser = getattr(app, "_egress_fuser", None)
+    if fuser is None:
+        fuser = EgressFuser(getattr(app, "name", None) or "app")
+        app._egress_fuser = fuser
+    return fuser

@@ -202,11 +202,15 @@ class DevicePatternRuntime:
         # deadline passes keep deadline-vs-event ordering exact for
         # deadlines that expire during later chunks, and idle/drain
         # flushes bound the wall-clock tail
-        from .pipeline import resolve_depth
+        from .pipeline import resolve_depth, egress_fuser_for
         self._inflight: "deque" = deque()
         self.pipeline_depth = resolve_depth(
             app.app, [app.junction_of(sid)
                       for sid in self.nfa.stream_codes])
+        # fused per-app egress: the NFA's compacted match buffers ride
+        # the app-wide slab — one D2H per ingest block across runtimes
+        self.app_name = app.name
+        self.nfa.egress_fuser = egress_fuser_for(app)
 
     # ------------------------------------------------------------ ingest
 
@@ -222,9 +226,12 @@ class DevicePatternRuntime:
 
     def ingest(self, stream_code: int, stream_id: str, chunk) -> None:
         from ..core.event import CURRENT, EventChunk
+        from ..core.profiling import profiler
         data = chunk.only(CURRENT)
         if data.is_empty:
             return
+        prof = profiler()
+        disp0 = prof.total_dispatches() if prof.enabled else 0
         n = len(data)
         if self.keyed:
             ex = self.key_executors.get(stream_id)
@@ -292,6 +299,12 @@ class DevicePatternRuntime:
         # stream/StreamJunction.java:280-316)
         while len(self._inflight) > self.pipeline_depth:
             self._retire_one()
+        if prof.enabled:
+            # the measured side of the consolidation claim: device
+            # launches this ingest block cost (exported as the per-app
+            # siddhi_app_dispatches_per_block gauge)
+            prof.record_app_block(self.app_name,
+                                  prof.total_dispatches() - disp0)
 
     def _retire_one(self) -> None:
         """Block on the oldest in-flight chunk, handle slot-ring overflow
@@ -555,6 +568,9 @@ class DeviceWindowedAggRuntime(PipelinedDeviceIngest):
         app.junction_of(self.cwa.stream_id).subscribe(recv)
         qr.receivers[self.cwa.stream_id] = recv
         self._init_pipeline(app, [self.cwa.stream_id])
+        from .pipeline import egress_fuser_for
+        self.app_name = app.name
+        self._fuser = egress_fuser_for(app)
 
     # ------------------------------------------------------------ ingest
 
@@ -566,10 +582,13 @@ class DeviceWindowedAggRuntime(PipelinedDeviceIngest):
 
     def ingest(self, stream_code: int, stream_id: str, chunk) -> None:
         from ..core.event import CURRENT
+        from ..core.profiling import profiler
         from ..ops.nfa import pack_blocks
         data = chunk.only(CURRENT)
         if data.is_empty:
             return
+        prof = profiler()
+        disp0 = prof.total_dispatches() if prof.enabled else 0
         keys = self.key_executor.keys(data)
         keep = np.asarray([k is not None for k in keys], bool)
         if not keep.all():
@@ -600,23 +619,36 @@ class DeviceWindowedAggRuntime(PipelinedDeviceIngest):
             ts64[lanes, rows] = src
             block["__ts64"] = ts64
         outs = self.cwa.process_block(block)
-        for o in outs:
-            try:
-                o.copy_to_host_async()
-            except Exception:   # backends without async copy
-                break
-        self._submit({"outs": outs, "data": data, "lanes": lanes,
-                      "rows": rows})
+        token = None
+        if self._fuser is not None:
+            # outputs ride the app's per-ingest-block slab: one shared
+            # D2H at retire instead of a read per runtime
+            token = self._fuser.register(self, list(outs))
+        else:
+            for o in outs:
+                try:
+                    o.copy_to_host_async()
+                except Exception:   # backends without async copy
+                    break
+        self._submit({"outs": outs, "fuse": token, "data": data,
+                      "lanes": lanes, "rows": rows})
+        if prof.enabled:
+            prof.record_app_block(self.app_name,
+                                  prof.total_dispatches() - disp0)
 
     def _retire(self, work) -> None:
         from ..core.event import EventChunk
         outs, data = work["outs"], work["data"]
         lanes, rows = work["lanes"], work["rows"]
         n = len(data)
-        sums = np.asarray(outs[0])
-        counts = np.asarray(outs[1])
-        mins = np.asarray(outs[2]) if len(outs) > 2 else None
-        maxs = np.asarray(outs[3]) if len(outs) > 3 else None
+        if work.get("fuse") is not None:
+            outs = work["fuse"].fetch()
+        else:
+            outs = [np.asarray(o) for o in outs]
+        sums = outs[0]
+        counts = outs[1]
+        mins = outs[2] if len(outs) > 2 else None
+        maxs = outs[3] if len(outs) > 3 else None
 
         # host-side twin filter decides which input events emit output rows
         from .expr_compiler import EvalCtx
@@ -748,6 +780,11 @@ class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
         qr.receivers[self.cga.stream_id] = recv
         self._init_pipeline(app, [self.cga.stream_id])
         self.cga.flush_hook = self.flush
+        from .pipeline import egress_fuser_for
+        self.app_name = app.name
+        # the compiler owns dispatch/decode, so it registers its own
+        # output buffers on the app slab
+        self.cga.egress_fuser = egress_fuser_for(app)
 
     # ------------------------------------------------------------ ingest
 
@@ -759,9 +796,12 @@ class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
 
     def ingest(self, stream_code: int, stream_id: str, chunk) -> None:
         from ..core.event import CURRENT
+        from ..core.profiling import profiler
         data = chunk.only(CURRENT)
         if data.is_empty:
             return
+        prof = profiler()
+        disp0 = prof.total_dispatches() if prof.enabled else 0
         if self.keyed:
             keys = self.key_executor.keys(data)
             keep = np.asarray([k is not None for k in keys], bool)
@@ -779,6 +819,9 @@ class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
         if work is None:
             return
         self._submit(work)
+        if prof.enabled:
+            prof.record_app_block(self.app_name,
+                                  prof.total_dispatches() - disp0)
 
     def _retire(self, work) -> None:
         from .gagg_compiler import GaggOverflow
@@ -1052,14 +1095,20 @@ class DeviceFilterRuntime(PipelinedDeviceIngest):
                         sis.is_fault).subscribe(recv)
         qr.receivers[sis.stream_id] = recv
         self._init_pipeline(app, [sis.stream_id])
+        from .pipeline import egress_fuser_for
+        self.app_name = app.name
+        self._fuser = egress_fuser_for(app)
 
     # ------------------------------------------------------------ ingest
 
     def ingest(self, stream_code: int, stream_id: str, chunk) -> None:
         import jax.numpy as jnp
+        from ..core.profiling import profiler
         n = len(chunk)
         if n == 0:
             return
+        prof = profiler()
+        disp0 = prof.total_dispatches() if prof.enabled else 0
         n_pad = 1 << (n - 1).bit_length()
         cols = {}
         for a in self.numeric:
@@ -1080,22 +1129,37 @@ class DeviceFilterRuntime(PipelinedDeviceIngest):
         valid = np.zeros(n_pad, bool)
         valid[:n] = True
         ok, outs = self._program(cols, jnp.asarray(ts), jnp.asarray(valid))
-        for o in [ok] + list(outs):
-            try:
-                o.copy_to_host_async()
-            except Exception:   # backends without async copy
-                break
-        self._submit({"ok": ok, "outs": outs, "chunk": chunk, "n": n})
+        token = None
+        if self._fuser is not None:
+            # mask + device columns ride the app's per-ingest-block slab
+            token = self._fuser.register(self, [ok] + list(outs))
+        else:
+            for o in [ok] + list(outs):
+                try:
+                    o.copy_to_host_async()
+                except Exception:   # backends without async copy
+                    break
+        self._submit({"ok": ok, "outs": outs, "fuse": token,
+                      "chunk": chunk, "n": n})
+        if prof.enabled:
+            prof.record_app_block(self.app_name,
+                                  prof.total_dispatches() - disp0)
 
     def _retire(self, work) -> None:
         from ..core.event import TIMER, RESET, EventChunk
         from ..core.profiling import profiler
         chunk, n, outs = work["chunk"], work["n"], work["outs"]
-        ok = np.asarray(work["ok"])[:n]
         prof = profiler()
-        if prof.enabled:
-            prof.record_d2h("filter.program", ok.nbytes + sum(
-                getattr(o, "nbytes", 0) for o in outs))
+        if work.get("fuse") is not None:
+            fetched = work["fuse"].fetch()
+            ok = fetched[0][:n]
+            outs = fetched[1:]
+        else:
+            ok = np.asarray(work["ok"])[:n]
+            outs = [np.asarray(o) for o in outs]
+            if prof.enabled:
+                prof.record_d2h("filter.program", ok.nbytes + sum(
+                    getattr(o, "nbytes", 0) for o in outs))
         # TIMER/RESET rows always pass (host FilterProcessor parity)
         ok = ok | (chunk.types == TIMER) | (chunk.types == RESET)
         if not ok.any():

@@ -45,8 +45,15 @@ def test_bench_smoke_runs_clean():
     # ticks really drop T -> ceil(T/B)
     for r in sweep:
         assert r["scan_ticks_per_block"] == -(-8 // r["batch_b"])
+    # dispatch consolidation (round 7): the tiny C=2-chunk bank really
+    # drops to ONE measured device dispatch per block, at equal matches
+    dsm = out["d_sweep_smoke"]
+    assert dsm["sequential"]["dispatches_per_block"] == 2
+    assert dsm["stacked"]["dispatches_per_block"] == 1
+    assert dsm["stacked"]["matches"] == dsm["sequential"]["matches"] > 0
     prof = out["kernel_profile"]
     assert prof["nfa.bank_step"]["scan_ticks"] > 0
+    assert prof["nfa.bank_step"]["dispatch_count"] > 0
 
 
 def test_bench_skips_on_unreachable_backend():

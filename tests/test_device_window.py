@@ -82,6 +82,33 @@ def test_randomized_chunked_parity(kind):
     assert dev == host
 
 
+def test_session_timer_dispatch_bounded():
+    """Regression: the session gap timer must not re-arm at an instant
+    <= the one it just processed.  A min-live re-arm at exactly
+    min+gap — where the kernel evicts nothing — made playback
+    advance_to() fire the same virtual ms forever (300k+ device
+    dispatches on this 60-event stream before the fix).  Bound the
+    MEASURED dispatch count, not wall time."""
+    from siddhi_tpu.core.profiling import profiler
+    app = CSE + f"@info(name='q') from cse{KIND_QUERIES['session']} " \
+        "select symbol, price, volume insert all events into out;"
+    chunks = _random_chunks(seed=zlib.crc32(b"session"))
+    prof = profiler()
+    was = prof.enabled
+    prof.enable()
+    try:
+        d0 = prof.total_dispatches()
+        bd, _ = _run(app, chunks)
+        n_steps = prof.total_dispatches() - d0
+    finally:
+        if not was:
+            prof.disable()
+    assert bd == "device"
+    # 18 chunks + one timer per chunk-end+gap instant, plus compile-time
+    # warmup steps: orders of magnitude below the runaway regime
+    assert 0 < n_steps < 500, n_steps
+
+
 def test_ring_growth_preserves_contents():
     """Start capacity is 16; a 200-deep length window must grow the ring
     slabs without losing or reordering entries."""
