@@ -794,6 +794,114 @@ def bench_engine_absent():
         "engine_absent", "alert-rate arm + trailing `not ... for 3 sec`")
 
 
+def bench_overload(n_events=4000, buffer_chunks=64,
+                   consumer_sleep_s=0.0002):
+    """Ingest-armor phase (round 9): per-event sends at full speed
+    against a deliberately slow @Async consumer (~1/consumer_sleep_s
+    chunks/s), once per overload policy.  SHED_OLDEST keeps the send
+    path flat (evicts the oldest queued chunks at the high watermark);
+    BLOCK converges the producer onto the consumer rate with a bounded
+    per-send wait.  Host-side only — no device work; the counters are
+    the always-on IngestMetrics series exported on /metrics.  The
+    admitted == delivered + shed accounting is asserted exactly."""
+    import logging
+    import threading
+
+    from siddhi_tpu import SiddhiManager
+
+    # overflow under BLOCK logs one error per dropped chunk by design;
+    # the sweep drives thousands of chunks, so keep the bench log quiet
+    logging.getLogger("siddhi_tpu.core.stream").setLevel(logging.CRITICAL)
+
+    class _SlowReceiver:
+        def __init__(self, sleep_s):
+            self.sleep_s = sleep_s
+            self.count = 0
+            self.done = threading.Event()
+
+        def receive_chunk(self, chunk):
+            time.sleep(self.sleep_s)
+            self.count += len(chunk.timestamps)
+
+    out = {"metric": (f"ingest overload: {n_events} per-event sends vs "
+                      f"a ~{1 / consumer_sleep_s:.0f} chunks/s consumer "
+                      f"({buffer_chunks}-chunk @Async buffer)"),
+           "policies": {}}
+    for policy, extra in (("SHED_OLDEST",
+                           "overload.high='0.8', overload.low='0.5'"),
+                          ("BLOCK", "block.timeout.ms='50'")):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(
+            f"@Async(buffer.size='{buffer_chunks}', batch.size.max='1', "
+            f"overload='{policy}', {extra}) "
+            "define stream S (sym string, price float); "
+            "@info(name='q') from S select sym, price insert into Out;")
+        slow = _SlowReceiver(consumer_sleep_s)
+        rt.junctions["S"].subscribe(slow)
+        rt.start()
+        h = rt.get_input_handler("S")
+        lat = []
+        t0 = time.perf_counter()
+        for i in range(n_events):
+            t1 = time.perf_counter()
+            h.send(["A", float(i)], 1_000_000 + i)
+            lat.append(time.perf_counter() - t1)
+        offered_wall = time.perf_counter() - t0
+        rt.junctions["S"].flush()           # barrier: queue fully drained
+        im = rt.ingest_metrics
+        admitted = int(im.ingest_admitted_total.value(stream="S"))
+        shed = int(sum(im.ingest_shed_total.series().values()))
+        overflow = int(im.ingest_overflow_total.value(stream="S"))
+        assert admitted == slow.count + shed, \
+            f"{policy}: admitted {admitted} != delivered {slow.count} " \
+            f"+ shed {shed}"
+        assert admitted + overflow == n_events
+        la = np.asarray(lat)
+        out["policies"][policy] = {
+            "offered_events_per_sec": round(n_events / offered_wall, 1),
+            "admitted": admitted,
+            "delivered": slow.count,
+            "shed": shed,
+            "overflow": overflow,
+            "send_p50_us": round(float(np.percentile(la, 50)) * 1e6, 1),
+            "send_p99_us": round(float(np.percentile(la, 99)) * 1e6, 1),
+            "send_max_ms": round(float(la.max()) * 1e3, 2),
+        }
+        rt.shutdown()
+        m.shutdown()
+
+    # validator overhead: the SAME clean batched feed through a
+    # @quarantine stream vs an unguarded one — the per-event cost of the
+    # NaN/type/ts32 admission checks on the batch path
+    n_batch, rounds = 5000, 10
+    rng = np.random.default_rng(9)
+    cols = {"sym": np.asarray(["A"] * n_batch, object),
+            "price": rng.uniform(0, 100, n_batch).astype(np.float32)}
+    for label, prefix in (("unguarded", ""),
+                          ("quarantined",
+                           "@quarantine(ts.slack.ms='1000') ")):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(
+            prefix + "define stream S (sym string, price float); "
+            "@info(name='q') from S select sym, price insert into Out;")
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send_batch(cols, timestamps=np.arange(n_batch, dtype=np.int64))
+        rt.flush()                          # warmup: first-use costs out
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            h.send_batch(
+                cols, timestamps=1_000_000 + r * n_batch +
+                np.arange(n_batch, dtype=np.int64))
+        rt.flush()
+        wall = time.perf_counter() - t0
+        out[f"validator_{label}_events_per_sec"] = round(
+            n_batch * rounds / wall, 1)
+        rt.shutdown()
+        m.shutdown()
+    return out
+
+
 def bench_oracle():
     from siddhi_tpu import SiddhiManager
     rng = np.random.default_rng(1)
@@ -1000,6 +1108,52 @@ def bench_smoke():
             "matches": int(d_rows[m]["counts"].sum())}
         for m in d_rows}
 
+    # ---- ingest armor (round 9): SHED_OLDEST under a wedged consumer —
+    # the send path must stay alive and admitted == delivered + shed
+    # must hold to the event (real assertions)
+    import threading
+    m3 = SiddhiManager()
+    rt3 = m3.create_siddhi_app_runtime(
+        "@Async(buffer.size='8', batch.size.max='1', "
+        "overload='SHED_OLDEST', overload.high='0.75', "
+        "overload.low='0.25') define stream S (sym string, price float); "
+        "@info(name='q') from S select sym, price insert into Out;")
+
+    class _WedgedReceiver:
+        def __init__(self):
+            self.gate = threading.Event()
+            self.count = 0
+
+        def receive_chunk(self, chunk):
+            self.gate.wait()
+            self.count += len(chunk.timestamps)
+
+    wedge = _WedgedReceiver()
+    rt3.junctions["S"].subscribe(wedge)
+    rt3.start()
+    h3 = rt3.get_input_handler("S")
+    t2 = time.perf_counter()
+    for i in range(200):                    # 25x the 8-chunk buffer
+        h3.send(["A", float(i)], 1_000_000 + i)
+    send_wall = time.perf_counter() - t2
+    assert send_wall < 30.0, \
+        f"smoke overload FAILED: sends took {send_wall:.1f}s (wedged?)"
+    wedge.gate.set()
+    rt3.junctions["S"].flush()
+    im3 = rt3.ingest_metrics
+    o_admitted = int(im3.ingest_admitted_total.value(stream="S"))
+    o_shed = int(im3.ingest_shed_total.value(stream="S",
+                                             reason="shed_oldest"))
+    assert o_admitted == 200, o_admitted
+    assert o_shed > 0 and o_admitted == wedge.count + o_shed, \
+        f"smoke overload accounting FAILED: admitted={o_admitted} " \
+        f"delivered={wedge.count} shed={o_shed}"
+    assert int(im3.ingest_overflow_total.value(stream="S")) == 0
+    rt3.shutdown()
+    res["overload_smoke"] = {"admitted": o_admitted, "shed": o_shed,
+                             "delivered": wedge.count,
+                             "send_wall_s": round(send_wall, 3)}
+
     snap = profiler().snapshot()
     bank_st = snap.get("nfa.bank_step", {})
     assert bank_st.get("scan_ticks", 0) > 0, \
@@ -1133,6 +1287,8 @@ def main():
             print(json.dumps(_with_profile(bench_engine_wagg)))
         elif phase == "engine_absent":
             print(json.dumps(_with_profile(bench_engine_absent)))
+        elif phase == "overload":
+            print(json.dumps(bench_overload()))
         return
 
     import jax
@@ -1145,6 +1301,7 @@ def main():
     eng = _run_phase("engine")
     eng_wagg = _run_phase("engine_wagg")
     eng_absent = _run_phase("engine_absent")
+    overload = _run_phase("overload")
     tpu_rate = thru["thru_rate"]
     p99_ms, p50_ms = lat["p99_ms"], lat["p50_ms"]
     matches, payloads, sample = (thru["matches"], thru["payloads"],
@@ -1234,6 +1391,10 @@ def main():
         "kernel_profile_thru": thru.get("kernel_profile"),
         "kernel_profile_engine": eng.get("kernel_profile"),
         "retrace_total": retraces,
+        # ingest armor (round 9): offered load vs a slow consumer per
+        # overload policy + the @quarantine validator's batch-path cost;
+        # admitted == delivered + shed asserted in-phase
+        "ingest_overload": overload,
         # static cost model: predicted persistent HBM next to the
         # profiler-measured live bytes (acceptance: within 2x)
         "cost_model": {

@@ -156,6 +156,7 @@ def analyze(app: Union[str, SiddhiApp],
 
     deadcode_pass(table, insert_targets, sink)
     _fault_tolerance_pass(app, sink)
+    _ingest_protection_pass(app, sink)
     order = {Severity.ERROR: 0, Severity.WARNING: 1, Severity.INFO: 2}
     res.diagnostics = sorted(
         sink.diagnostics,
@@ -195,6 +196,91 @@ def _fault_tolerance_pass(app: SiddhiApp, sink: DiagnosticSink) -> None:
                       f"stream '{sid}' uses @OnError(action='STORE') but "
                       f"the app configures no error store; failed events "
                       f"will be logged and lost", pos=pos_of(d))
+
+
+# ====================================================== ingest protection
+
+_OVERLOAD_POLICIES = {"BLOCK", "SHED_OLDEST", "SHED_NEW", "STORE"}
+
+
+def _ingest_protection_pass(app: SiddhiApp, sink: DiagnosticSink) -> None:
+    """SA060-SA063: overload/quarantine annotation hazards
+    (core/overload.py).  The runtime never crashes on bad config — it
+    clamps to defaults with a log warning — so these diagnostics are the
+    only place the author learns the option was ignored."""
+    has_app_store = (
+        find_annotation(app.annotations, "app:errorstore") is not None
+        or find_annotation(app.annotations, "errorstore") is not None)
+
+    def num(ann, key):
+        raw = ann.get(key, None)
+        if raw is None:
+            return None, False
+        try:
+            return float(raw), False
+        except (TypeError, ValueError):
+            return None, True
+
+    for sid, d in app.stream_definitions.items():
+        a = find_annotation(d.annotations, "async")
+        if a is not None:
+            policy = a.get("overload", None)
+            if policy is not None \
+                    and policy.upper() not in _OVERLOAD_POLICIES:
+                sink.emit("SA060",
+                          f"stream '{sid}': @Async overload policy "
+                          f"'{policy}' is not one of BLOCK/SHED_OLDEST/"
+                          f"SHED_NEW/STORE; it will fall back to BLOCK",
+                          pos=pos_of(d))
+            elif policy is not None and policy.upper() == "STORE" \
+                    and not has_app_store:
+                sink.emit("SA062",
+                          f"stream '{sid}' uses @Async(overload='STORE') "
+                          f"but the app configures no error store; above "
+                          f"the high watermark admission degrades to "
+                          f"bounded BLOCK", pos=pos_of(d))
+            high, bad_h = num(a, "overload.high")
+            low, bad_l = num(a, "overload.low")
+            bt, bad_bt = num(a, "block.timeout.ms")
+            dt, bad_dt = num(a, "drain.timeout.ms")
+            bad = bad_h or bad_l or bad_bt or bad_dt
+            if not bad:
+                h = high if high is not None else 0.8
+                lo = low if low is not None else 0.5
+                bad = (not (0.0 < h <= 1.0) or not (0.0 <= lo <= 1.0)
+                       or lo >= h
+                       or (bt is not None and bt <= 0)
+                       or (dt is not None and dt <= 0))
+            if bad:
+                sink.emit("SA061",
+                          f"stream '{sid}': @Async overload options are "
+                          f"invalid (need 0 < overload.low < "
+                          f"overload.high <= 1 and positive timeouts); "
+                          f"the runtime will clamp them to defaults",
+                          pos=pos_of(d))
+        q = find_annotation(d.annotations, "quarantine")
+        if q is not None:
+            bad = False
+            raw = q.get("ts.slack.ms", None)
+            if raw is not None:
+                try:
+                    if int(raw) < 0:
+                        bad = True
+                except (TypeError, ValueError):
+                    bad = True
+            for key in ("nan", "wrap"):
+                v = q.get(key, None)
+                if v is not None and str(v).strip().lower() not in (
+                        "1", "true", "on", "yes", "0", "false", "off",
+                        "no"):
+                    bad = True
+            if bad:
+                sink.emit("SA063",
+                          f"stream '{sid}': @quarantine options are "
+                          f"malformed (ts.slack.ms must be a "
+                          f"non-negative integer, nan/wrap booleans); "
+                          f"the runtime will fall back to the option's "
+                          f"default", pos=pos_of(d))
 
 
 # ============================================================ aggregations

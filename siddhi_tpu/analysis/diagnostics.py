@@ -164,6 +164,34 @@ CATALOG: Dict[str, CatalogEntry] = {e.code: e for e in [
        "LOG/STREAM/STORE/WAIT; the junction will fall back to LOG at "
        "runtime.",
        "Use one of the supported actions: LOG, STREAM, STORE, WAIT."),
+    # ---- ingest protection ---------------------------------------------
+    _C("SA060", _W, "unknown-overload-policy",
+       "`@Async(overload=...)` names a policy other than "
+       "BLOCK/SHED_OLDEST/SHED_NEW/STORE; the junction will fall back "
+       "to BLOCK (bounded blocking admission) at runtime.",
+       "Use one of the supported policies: BLOCK, SHED_OLDEST, "
+       "SHED_NEW, STORE."),
+    _C("SA061", _E, "invalid-overload-config",
+       "`@Async` overload options are out of range: watermarks must "
+       "satisfy 0 < overload.low < overload.high <= 1 and "
+       "block.timeout.ms / drain.timeout.ms must be positive numbers — "
+       "the runtime would silently clamp them to defaults.",
+       "Fix the offending option; defaults are overload.high=0.8, "
+       "overload.low=0.5, block.timeout.ms=60000, "
+       "drain.timeout.ms=600000."),
+    _C("SA062", _W, "overload-store-without-error-store",
+       "A stream declares `@Async(overload='STORE')` but the app "
+       "configures no error store — above the high watermark the "
+       "junction degrades to bounded BLOCK instead of capturing "
+       "overflow events for replay.",
+       "Add `@app:errorStore(type='memory')` (or type='sqlite'), or "
+       "call `SiddhiManager.set_error_store(...)`."),
+    _C("SA063", _E, "invalid-quarantine-config",
+       "`@quarantine` options are malformed: ts.slack.ms must be a "
+       "non-negative integer and nan/wrap must be booleans — the "
+       "runtime would silently fall back to the option's default.",
+       "Fix the option, e.g. `@quarantine(ts.slack.ms='5000', "
+       "nan='true', wrap='true')`."),
     # ---- TPU performance hazards ---------------------------------------
     _C("SP001", _W, "retrace-slot-growth",
        "A device-eligible `every` pattern without `within` will grow its "
@@ -338,6 +366,7 @@ _FAMILIES = (
     ("SA03", "Partition safety"),
     ("SA04", "Dead code"),
     ("SA05", "Fault tolerance"),
+    ("SA06", "Ingest protection"),
     ("SP0", "TPU performance hazards"),
     ("PV00", "Plan verifier — automaton"),
     ("PV01", "Plan verifier — jaxpr kernel sanitizer"),

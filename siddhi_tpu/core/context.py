@@ -66,6 +66,7 @@ class SiddhiAppContext:
         self.script_functions: Dict[str, Any] = {}
         self.exception_listeners: List[Any] = []
         self.runtime = None                 # back-pointer (set by runtime)
+        self.watchdog = None                # DispatchWatchdog (core/overload)
         self.async_mode = False
 
     def current_time(self) -> int:

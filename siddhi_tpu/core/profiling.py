@@ -322,6 +322,19 @@ def profiler() -> KernelProfiler:
     return _GLOBAL
 
 
+def storm_snapshot() -> Dict[str, Any]:
+    """Dispatch context attached to watchdog WD0xx incidents while
+    profiling is on: total kernel dispatches plus per-app
+    dispatches-per-block averages (the session-timer storm signature was
+    this ratio exploding — 300k+ dispatches on 60 events)."""
+    p = _GLOBAL
+    with p._lock:
+        per_block = {app: (tot[0] / tot[1] if tot[1] else 0.0)
+                     for app, tot in p.app_blocks.items()}
+    return {"total_dispatches": p.total_dispatches(),
+            "dispatches_per_block": per_block}
+
+
 def wrap_kernel(name: str, fn: Callable,
                 batch_of: Optional[Callable[..., int]] = None,
                 ticks_of: Optional[Callable[..., tuple]] = None

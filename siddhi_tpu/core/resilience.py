@@ -292,7 +292,7 @@ class ErrorEntry:
     id: int
     app_name: str
     stream_id: str
-    origin: str                 # 'sink' | 'stream'
+    origin: str     # 'sink' | 'stream' | 'ingest' | 'overload' | 'watchdog'
     error: str
     timestamp_ms: int
     events: List[Tuple[int, tuple]]   # (event timestamp, data row)

@@ -126,6 +126,19 @@ class SiddhiAppRuntime:
         from .resilience import ResilienceMetrics
         self.resilience_metrics = ResilienceMetrics(self.name)
         self.error_store = getattr(siddhi_context, "error_store", None)
+
+        # ingest protection: always-on counters plus (unless the
+        # SIDDHI_TPU_INGEST_GUARD kill switch is off) the dispatch-storm
+        # watchdog riding every scheduler fire (see core/overload.py)
+        from .overload import DispatchWatchdog, IngestMetrics, guard_enabled
+        self.ingest_metrics = IngestMetrics(self.name)
+        self.watchdog = None
+        if guard_enabled():
+            self.watchdog = DispatchWatchdog(self.name,
+                                             metrics=self.ingest_metrics)
+            self.watchdog.runtime = self
+            self.app_ctx.watchdog = self.watchdog
+            self.app_ctx.scheduler.watchdog = self.watchdog
         self.checkpoint_scheduler = None
         self.recovered_revision: Optional[str] = None
 
@@ -286,6 +299,12 @@ class SiddhiAppRuntime:
             qcount += 1
         # 8. sources & sinks from stream annotations
         attach_sources_and_sinks(self)
+        # always-on saturation gauges for @Async buffers (read lazily at
+        # /metrics scrape time; independent of @app:statistics)
+        for sid, j in self.junctions.items():
+            if j.is_async:
+                self.ingest_metrics.ingest_saturation.set_fn(
+                    j.saturation, stream=sid)
         # 9. statistics wiring
         if self.app_ctx.stats_enabled:
             sm = self.app_ctx.statistics_manager
@@ -538,13 +557,21 @@ class SiddhiAppRuntime:
                 continue
             rows = [list(data) for _, data in entry.events]
             stamps = [ts for ts, _ in entry.events]
-            chunk = EventChunk.from_rows(d, rows, stamps)
             if entry.origin == "sink":
+                chunk = EventChunk.from_rows(d, rows, stamps)
                 targets = [s for s in self.sinks
                            if s.stream_def.id == entry.stream_id]
                 for s in targets:
                     s.receive_chunk(chunk)
+            elif entry.origin == "ingest":
+                # quarantined events re-enter through the input handler so
+                # a replay is re-validated (a still-poison event goes
+                # straight back to the store instead of device state)
+                from .event import Event
+                self.get_input_handler(entry.stream_id).send(
+                    [Event(ts, data) for ts, data in entry.events])
             else:
+                chunk = EventChunk.from_rows(d, rows, stamps)
                 junction = self.junctions.get(entry.stream_id)
                 if junction is None:
                     continue

@@ -19,6 +19,12 @@ from .timestamp import TimestampGenerator
 
 
 class Scheduler:
+    #: Optional core/overload.py DispatchWatchdog.  When set, every fire
+    #: is consulted (`allow`) so a runaway re-arm loop trips instead of
+    #: spinning forever, and registrations for disarmed targets are
+    #: dropped at the door.
+    watchdog = None
+
     def __init__(self, ts_gen: TimestampGenerator):
         self._ts_gen = ts_gen
         self._heap: List[Tuple[int, int, Callable[[int], None]]] = []
@@ -30,6 +36,9 @@ class Scheduler:
             ts_gen.add_time_change_listener(self._on_virtual_time)
 
     def notify_at(self, ts: int, target: Callable[[int], None]):
+        wd = self.watchdog
+        if wd is not None and wd.is_disarmed(target):
+            return
         with self._lock:
             heapq.heappush(self._heap, (int(ts), self._seq, target))
             self._seq += 1
@@ -55,7 +64,10 @@ class Scheduler:
         with self._lock:
             while self._heap and self._heap[0][0] <= now:
                 due.append(heapq.heappop(self._heap))
+        wd = self.watchdog
         for _ts, _, target in due:
+            if wd is not None and not wd.allow(target, now):
+                continue
             try:
                 target(now)
             except Exception:  # noqa: BLE001 — scheduler thread must survive
@@ -78,7 +90,10 @@ class Scheduler:
                     due.append(heapq.heappop(self._heap))
             if not due:
                 return
+            wd = self.watchdog
             for ts, _, target in due:
+                if wd is not None and not wd.allow(target, ts):
+                    continue
                 target(ts)
 
     def shutdown(self):

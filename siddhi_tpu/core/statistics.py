@@ -505,13 +505,16 @@ _TYPES = [
 
 
 def prometheus_text(managers: List[StatisticsManager],
-                    kernel_profiler=None, resilience=None) -> str:
+                    kernel_profiler=None, resilience=None,
+                    ingest=None) -> str:
     """Full Prometheus/OpenMetrics text exposition over any number of app
-    StatisticsManagers plus the (process-global) kernel profiler and the
-    per-runtime ResilienceMetrics (core/resilience.py)."""
+    StatisticsManagers plus the (process-global) kernel profiler, the
+    per-runtime ResilienceMetrics (core/resilience.py) and the
+    per-runtime IngestMetrics (core/overload.py)."""
+    from .overload import INGEST_TYPES
     from .resilience import RESILIENCE_TYPES
     lines: List[str] = []
-    for name, typ, help_ in _TYPES + RESILIENCE_TYPES:
+    for name, typ, help_ in _TYPES + RESILIENCE_TYPES + INGEST_TYPES:
         lines.append(f"# HELP {name} {help_}")
         lines.append(f"# TYPE {name} {typ}")
     for sm in managers:
@@ -520,4 +523,6 @@ def prometheus_text(managers: List[StatisticsManager],
         lines.extend(kernel_profiler.prometheus_lines())
     for rm in (resilience or []):
         lines.extend(rm.prometheus_lines())
+    for im in (ingest or []):
+        lines.extend(im.prometheus_lines())
     return "\n".join(lines) + "\n"

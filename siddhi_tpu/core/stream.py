@@ -15,6 +15,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 import traceback
 from typing import Any, Callable, List, Optional, Sequence
 
@@ -22,7 +23,7 @@ import numpy as np
 
 from ..query_api.annotation import find_annotation
 from ..query_api.definition import StreamDefinition
-from ..utils.errors import SiddhiAppRuntimeException
+from ..utils.errors import BufferOverflowError, SiddhiAppRuntimeException
 from .context import SiddhiAppContext
 from .event import CURRENT, EXPIRED, Event, EventChunk
 from .tracing import tracer as _tracer
@@ -116,6 +117,10 @@ class StreamJunction:
         self.buffer_size = 1024
         self.workers = 1
         self.batch_size_max = 256
+        # ingest protection (core/overload.py; None when the
+        # SIDDHI_TPU_INGEST_GUARD kill switch is off)
+        self.overload = None        # OverloadConfig for @Async admission
+        self.validator = None       # IngestValidator from @quarantine(...)
         self._queue: Optional[queue.Queue] = None
         self._worker_threads: List[threading.Thread] = []
         self._stop = threading.Event()
@@ -141,12 +146,22 @@ class StreamJunction:
         return q.qsize() if q is not None else 0
 
     def _configure_from_annotations(self):
+        from .overload import (IngestValidator, OverloadConfig,
+                               QuarantineConfig, guard_enabled)
+        guarded = guard_enabled()
         ann = find_annotation(self.definition.annotations, "async")
         if ann is not None:
             self.is_async = True
             self.buffer_size = int(ann.get("buffer.size", "1024"))
             self.workers = int(ann.get("workers", "1"))
             self.batch_size_max = int(ann.get("batch.size.max", "256"))
+            if guarded:
+                self.overload = OverloadConfig.from_annotation(
+                    ann, self.buffer_size)
+        q_ann = find_annotation(self.definition.annotations, "quarantine")
+        if q_ann is not None and guarded:
+            self.validator = IngestValidator(
+                self.definition, QuarantineConfig.from_annotation(q_ann))
         on_err = find_annotation(self.definition.annotations, "onerror")
         if on_err is not None:
             self.on_error_action = (on_err.get("action", "LOG") or "LOG").upper()
@@ -175,17 +190,57 @@ class StreamJunction:
         exit (the reference's shutdown drains the disruptor ring; setting
         the stop flag first would drop whatever is still queued).
         Sentinel-free: workers keep consuming until the queue is empty AND
-        the drain flag is up, so no worker can starve another."""
+        the drain flag is up, so no worker can starve another.
+
+        The drain is bounded by a TOTAL deadline (@Async(drain.timeout.ms),
+        default 600s — generous because a queued first delivery can hide a
+        remote AOT compile).  A receiver wedged past the deadline gets a
+        forced stop: the stop flag goes up, leftover queued chunks are
+        discarded (counted as shed reason='drain_timeout') and barriers
+        released, so shutdown cannot loop indefinitely on a dead consumer."""
         if self._queue is not None:
+            q = self._queue
             self._drain.set()
+            total_s = (self.overload.drain_timeout_s
+                       if self.overload is not None else 600.0)
+            deadline = time.monotonic() + total_s
             for t in self._worker_threads:
-                # generous: a queued first delivery can hide a remote AOT
-                # compile; abandoning a live worker leaks it holding the
-                # query lock
-                t.join(timeout=600.0)
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+            wedged = [t for t in self._worker_threads if t.is_alive()]
+            if wedged:
+                self._stop.set()
+                dropped = self._discard_queued(q, reason="drain_timeout")
+                log.error(
+                    "@Async drain on '%s' timed out after %.1fs with %d "
+                    "wedged worker(s); force-stopped, dropping %d queued "
+                    "event(s) (%s)", self.definition.id, total_s,
+                    len(wedged), dropped, BufferOverflowError.__name__)
+                for t in wedged:
+                    t.join(timeout=0.5)
             self._worker_threads.clear()
             self._queue = None
         self._stop.set()
+
+    def _discard_queued(self, q: queue.Queue, reason: str) -> int:
+        """Empty `q`, releasing any flush barriers and counting dropped
+        events as shed; returns the dropped-event count."""
+        dropped = 0
+        while True:
+            try:
+                item = q.get_nowait()
+            except queue.Empty:
+                break
+            if isinstance(item, _FlushBarrier):
+                item.done.set()
+            else:
+                dropped += len(item)
+            q.task_done()
+        if dropped:
+            m = self._ingest_metrics()
+            if m is not None:
+                m.ingest_shed_total.inc(dropped, stream=self.definition.id,
+                                        reason=reason)
+        return dropped
 
     def _worker_loop(self):
         """Re-batches queued chunks up to batch_size_max before delivery
@@ -193,10 +248,11 @@ class StreamJunction:
         When the queue goes idle (or on drain), flushes receivers that
         pipeline device work (plan/planner.py DevicePatternRuntime) so
         deferred matches never hang waiting for the next event."""
-        delivered = False
-        while not self._stop.is_set():
+        q = self._queue     # local ref: stop() clears the attribute on a
+        delivered = False   # forced drain-timeout stop while we may still
+        while not self._stop.is_set():  # be wedged inside a receiver
             try:
-                item = self._queue.get(timeout=0.1)
+                item = q.get(timeout=0.1)
             except queue.Empty:
                 if delivered:
                     self._flush_receivers()
@@ -209,14 +265,14 @@ class StreamJunction:
                 try:
                     item.arrive(self._flush_receivers)
                 finally:
-                    self._queue.task_done()
+                    q.task_done()
                 continue
             batch = [item]
             n = len(item)
             barrier = None
             while n < self.batch_size_max:
                 try:
-                    nxt = self._queue.get_nowait()
+                    nxt = q.get_nowait()
                 except queue.Empty:
                     break
                 if isinstance(nxt, _FlushBarrier):
@@ -236,7 +292,7 @@ class StreamJunction:
                 # and a trailing barrier pop all complete here
                 for _ in range(len(batch) + (1 if barrier is not None
                                              else 0)):
-                    self._queue.task_done()
+                    q.task_done()
         if delivered:
             self._flush_receivers()
 
@@ -298,10 +354,111 @@ class StreamJunction:
             return
         if self.throughput_tracker is not None:
             self.throughput_tracker.event_in(len(chunk))
+        wd = getattr(self.app_ctx, "watchdog", None)
+        if wd is not None:
+            # any event movement counts as ingest progress: a dispatch
+            # storm is, by definition, dispatching with none
+            wd.note_progress(len(chunk))
         if self.is_async and self._queue is not None:
-            self._queue.put(chunk)
+            if self.overload is not None:
+                self._admit(chunk)
+            else:
+                # kill switch off: legacy unbounded blocking put
+                self._queue.put(chunk)
         else:
             self._deliver(chunk)
+
+    # ------------------------------------------------------ admission control
+
+    def saturation(self) -> float:
+        """@Async buffer depth as a fraction of buffer.size (0.0 sync)."""
+        q = self._queue
+        if not self.is_async or q is None or self.buffer_size <= 0:
+            return 0.0
+        return q.qsize() / self.buffer_size
+
+    def saturated(self) -> bool:
+        """Above the high watermark right now (GET /health 'degraded')."""
+        ov = self.overload
+        if ov is None or self._queue is None:
+            return False
+        return self._queue.qsize() >= ov.high_chunks
+
+    def _admit(self, chunk: EventChunk):
+        """Policy-driven admission (@Async(overload=...)).  Every path is
+        bounded: the engine can shed, store, or raise — never wedge."""
+        q = self._queue
+        ov = self.overload
+        m = self._ingest_metrics()
+        sid = self.definition.id
+        n = len(chunk)
+        if ov.policy == "SHED_OLDEST":
+            self._shed_to_low(q, m)
+        elif ov.policy == "SHED_NEW":
+            if q.qsize() >= ov.high_chunks:
+                if m is not None:
+                    m.ingest_shed_total.inc(n, stream=sid, reason="shed_new")
+                return
+        elif ov.policy == "STORE":
+            if q.qsize() >= ov.high_chunks:
+                store = self._error_store()
+                if store is not None:
+                    from .resilience import make_entry
+                    rt = getattr(self.app_ctx, "runtime", None)
+                    store.store(make_entry(
+                        rt.name if rt is not None else "", sid, "overload",
+                        BufferOverflowError(
+                            f"@Async buffer on '{sid}' above high watermark "
+                            f"({q.qsize()}/{self.buffer_size} chunks)"),
+                        chunk.to_events()))
+                    if m is not None:
+                        m.ingest_shed_total.inc(n, stream=sid,
+                                                reason="stored")
+                    return
+                # no store configured: degrade to bounded BLOCK below
+                # (the analyzer flags this config as SA062)
+        try:
+            q.put(chunk, timeout=ov.block_timeout_s)
+        except queue.Full:
+            if m is not None:
+                m.ingest_overflow_total.inc(n, stream=sid)
+            self._handle_error(chunk, BufferOverflowError(
+                f"@Async buffer on '{sid}' still full after "
+                f"{ov.block_timeout_s:.3f}s ({self.buffer_size} chunks, "
+                f"policy {ov.policy})"))
+        else:
+            if m is not None:
+                m.ingest_admitted_total.inc(n, stream=sid)
+
+    def _shed_to_low(self, q: queue.Queue, m):
+        """SHED_OLDEST: at/above the high watermark, evict queued chunks
+        down to the low watermark (hysteresis).  Flush barriers ride
+        through: they are re-enqueued behind the survivors, never shed."""
+        ov = self.overload
+        if q.qsize() < ov.high_chunks:
+            return
+        shed = 0
+        while q.qsize() > ov.low_chunks:
+            try:
+                item = q.get_nowait()
+            except queue.Empty:
+                break
+            if isinstance(item, _FlushBarrier):
+                # guaranteed room: we just popped an entry and only
+                # producers racing us could have refilled it — the put
+                # below can block at most momentarily
+                q.put(item)
+                q.task_done()
+                continue
+            shed += len(item)
+            q.task_done()
+        if shed and m is not None:
+            m.ingest_shed_total.inc(shed, stream=self.definition.id,
+                                    reason="shed_oldest")
+
+    def _ingest_metrics(self):
+        rt = getattr(self.app_ctx, "runtime", None)
+        return getattr(rt, "ingest_metrics", None)
 
     def _deliver(self, chunk: EventChunk):
         tr = _tracer()
@@ -426,11 +583,35 @@ class InputHandler:
                     f"Stream '{self.definition.id}' expects {width} "
                     f"attributes {self.definition.attribute_names}, got "
                     f"{len(r)}: {list(r)!r}")
-        for ts in stamps:
-            self.app_ctx.timestamp_generator.observe_event_time(ts)
-        chunk = EventChunk.from_rows(self.definition, rows, stamps)
+        v = self.junction.validator
+        if v is None:
+            for ts in stamps:
+                self.app_ctx.timestamp_generator.observe_event_time(ts)
+            chunk = EventChunk.from_rows(self.definition, rows, stamps)
+        else:
+            # quarantine path: coerce (with per-row salvage), split off
+            # poison, and only let ADMITTED timestamps advance the clock
+            # — a wrap-poison stamp must not drag virtual time with it
+            from .overload import route_rejects
+            rejects = []
+            try:
+                chunk = EventChunk.from_rows(self.definition, rows, stamps)
+            except (TypeError, ValueError):
+                rows, stamps, bad = v.salvage_rows(rows, stamps)
+                rejects.append((v.REASON_TYPE, bad))
+                chunk = EventChunk.from_rows(self.definition, rows, stamps)
+            chunk, chunk_rejects = v.filter_chunk(chunk)
+            rejects.extend((reason, c.to_events())
+                           for reason, c in chunk_rejects)
+            if rejects:
+                route_rejects(self.junction, rejects)
+            if chunk.is_empty:
+                return
+            stamps = chunk.timestamps.tolist()
+            for ts in stamps:
+                self.app_ctx.timestamp_generator.observe_event_time(ts)
         with _tracer().span("ingest.chunk", stream=self.definition.id,
-                            n=len(rows)):
+                            n=len(chunk)):
             self.junction.send(chunk)
         if self.app_ctx.timestamp_generator.in_playback:
             self.app_ctx.scheduler.advance_to(max(stamps))
@@ -443,10 +624,22 @@ class InputHandler:
         if timestamps is None:
             timestamps = np.full(n, self.app_ctx.current_time(), np.int64)
         ts_arr = np.asarray(timestamps, np.int64)
+        chunk = EventChunk.from_columns(names, ts_arr, dict(columns))
+        v = self.junction.validator
+        if v is not None:
+            from .overload import route_rejects
+            chunk, chunk_rejects = v.filter_chunk(chunk)
+            if chunk_rejects:
+                route_rejects(self.junction,
+                              [(reason, c.to_events())
+                               for reason, c in chunk_rejects])
+            if chunk.is_empty:
+                return
+            ts_arr = chunk.timestamps
+            n = len(chunk)
         if len(ts_arr) > 0:
             self.app_ctx.timestamp_generator.observe_event_time(
                 int(ts_arr.max()))
-        chunk = EventChunk.from_columns(names, ts_arr, dict(columns))
         with _tracer().span("ingest.chunk", stream=self.definition.id, n=n):
             self.junction.send(chunk)
         if self.app_ctx.timestamp_generator.in_playback and len(ts_arr) > 0:

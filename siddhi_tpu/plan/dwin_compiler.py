@@ -50,6 +50,15 @@ INT_NONE = np.int32(-(2 ** 31))       # null sentinel on INT lanes
 # with arithmetic-produced NaNs)
 DBL_NONE_BITS = 0x7FF8_DEAD_BEEF_0000
 
+#: TEST HOOK (tests/test_overload.py): re-introduces the session-timer
+#: re-arm pathology (fixed in the fatter-scan-ticks round: the kernel
+#: reported the min live EVENT ts instead of the min key last-activity,
+#: so the re-arm instant never advanced past live sessions and the
+#: nxt<=now guard degenerated into a 1 ms timer crawl — 50k+ dispatches
+#: on a 60-event stream) so the dispatch-storm watchdog regression test
+#: can exercise a real storm.  Never enable outside tests.
+SESSION_REARM_PATHOLOGY = False
+
 
 def _reject(msg: str):
     raise SiddhiAppCreationError("device window path: " + msg)
@@ -802,6 +811,18 @@ class DeviceWindowProcessor(WindowProcessor):
             if self.kind == "timeBatch":
                 if self.next_emit is not None:
                     self.app_ctx.scheduler.notify_at(self.next_emit,
+                                                     self._on_timer)
+            elif SESSION_REARM_PATHOLOGY and self.kind == "session":
+                # TEST HOOK ONLY (tests/test_overload.py): the pre-fix
+                # session re-arm — the old kernel reported the min live
+                # EVENT ts, whose +gap instant stays <= now while its
+                # session remains active, so the nxt<=now crawl guard
+                # re-armed at now+1 on every fire: a 1 ms timer crawl
+                # with zero ingest progress.  Re-introduced behind this
+                # flag so the dispatch-storm watchdog regression test
+                # can prove the storm now trips instead of crawling.
+                if self._fill_host:
+                    self.app_ctx.scheduler.notify_at(now + 1,
                                                      self._on_timer)
             elif self._fill_host and self.kind != "session":
                 # no re-arm for session: every data chunk already

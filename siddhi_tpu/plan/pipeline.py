@@ -67,8 +67,14 @@ class PipelinedDeviceIngest:
         self._inflight: "deque" = deque()
         self.pipeline_depth = resolve_depth(
             app.app, [app.junction_of(sid) for sid in stream_ids])
+        # dispatch-storm watchdog (core/overload.py): every device
+        # submission counts as ingest progress — a storm is timer fires
+        # with none
+        self._watchdog = getattr(app.app_ctx, "watchdog", None)
 
     def _submit(self, work: Dict[str, Any]) -> None:
+        if self._watchdog is not None:
+            self._watchdog.note_progress()
         self._inflight.append(work)
         while len(self._inflight) > self.pipeline_depth:
             self._retire(self._inflight.popleft())

@@ -170,8 +170,11 @@ class SiddhiService:
     def _health_json(self) -> dict:
         """Liveness + per-sink circuit readiness: ``status`` stays "up"
         while the process serves; ``ready`` drops to False when any
-        deployed sink's circuit is OPEN (fast-failing)."""
-        apps, ready = {}, True
+        deployed sink's circuit is OPEN (fast-failing).  Overload is
+        surfaced here too: ``status`` becomes "degraded" while any
+        @Async buffer sits above its high watermark or a dispatch-storm
+        watchdog incident (WD0xx) is on record."""
+        apps, ready, degraded = {}, True, False
         for name, rt in self.manager.runtimes.items():
             sinks = {}
             for s in rt.sinks:
@@ -183,11 +186,22 @@ class SiddhiService:
                                           "ready": state != "open"}
                 if state == "open":
                     ready = False
-            apps[name] = {"started": rt._started, "sinks": sinks,
-                          "errors_stored": (rt.error_store.count(rt.name)
-                                            if rt.error_store is not None
-                                            else 0)}
-        return {"status": "up", "ready": ready, "apps": apps}
+            doc = {"started": rt._started, "sinks": sinks,
+                   "errors_stored": (rt.error_store.count(rt.name)
+                                     if rt.error_store is not None
+                                     else 0)}
+            saturated = [sid for sid, j in rt.junctions.items()
+                         if j.saturated()]
+            if saturated:
+                doc["saturated_streams"] = saturated
+                degraded = True
+            wd = getattr(rt, "watchdog", None)
+            if wd is not None and wd.incidents:
+                doc["incidents"] = list(wd.incidents)
+                degraded = True
+            apps[name] = doc
+        return {"status": "degraded" if degraded else "up",
+                "ready": ready, "apps": apps}
 
     # ------------------------------------------------------------ metrics
 
@@ -200,7 +214,11 @@ class SiddhiService:
         resilience = [rt.resilience_metrics
                       for rt in self.manager.runtimes.values()
                       if getattr(rt, "resilience_metrics", None) is not None]
-        body = prometheus_text(managers, profiler(), resilience).encode()
+        ingest = [rt.ingest_metrics
+                  for rt in self.manager.runtimes.values()
+                  if getattr(rt, "ingest_metrics", None) is not None]
+        body = prometheus_text(managers, profiler(), resilience,
+                               ingest).encode()
         h.send_response(200)
         h.send_header("Content-Type",
                       "text/plain; version=0.0.4; charset=utf-8")

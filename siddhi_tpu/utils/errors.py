@@ -49,6 +49,23 @@ class SiddhiAppRuntimeException(Exception):
     """Runtime event-processing failure (routed to @OnError handling)."""
 
 
+class BufferOverflowError(SiddhiAppRuntimeException):
+    """An @Async junction buffer stayed full past the bounded admission
+    timeout (overload='BLOCK'), or an overload policy rejected events.
+    Routed through the stream's @OnError path like any runtime failure."""
+
+
+class PoisonEventError(SiddhiAppRuntimeException):
+    """An ingested event failed the quarantine validator (NaN/Inf
+    payload, non-coercible type, or a timestamp outside the admissible
+    window) and was routed to the error store instead of device state."""
+
+
+class DispatchStormError(SiddhiAppRuntimeException):
+    """The dispatch-storm watchdog tripped: a timer target re-fired with
+    zero ingest progress and was force-disarmed (WD0xx incident)."""
+
+
 class StoreQueryCreationError(SiddhiAppCreationError):
     pass
 

@@ -22,6 +22,11 @@ Pieces:
   * ``inject_fault`` — monkeypatch any bound method (e.g. a device-step
     wrapper) to raise per a script.
   * ``VirtualClock`` — manual monotonic clock for CircuitBreaker tests.
+  * ``burst_feed`` / ``poison_feed`` / ``backwards_feed`` — seeded event
+    generators for the overload/quarantine suite (tests/test_overload.py).
+  * ``GatedReceiver`` — a junction subscriber whose delivery can be
+    wedged (blocked on an Event) to exert real backpressure on @Async
+    workers, then released.
 """
 from __future__ import annotations
 
@@ -262,6 +267,99 @@ def inject_fault(obj, attr: str, script: FailureScript,
     def restore():
         setattr(obj, attr, original)
     return restore
+
+
+# ------------------------------------------------------------------ overload
+
+def burst_feed(n_events: int, seed: int = 0, start_ts: int = 1_000_000,
+               symbols=("A", "B", "C")):
+    """Seeded burst of (symbol, price, volume, ts) rows with
+    monotonically non-decreasing timestamps — offered faster than any
+    consumer drains, for admission-control tests.  Returns a list of
+    ``([symbol, price, volume], ts)`` tuples."""
+    rng = random.Random(seed)
+    ts = start_ts
+    out = []
+    for i in range(n_events):
+        ts += rng.randrange(0, 3)          # dense: 0-2 ms apart
+        out.append(([rng.choice(symbols), float(i), i], ts))
+    return out
+
+
+def poison_feed(n_events: int, seed: int = 0, start_ts: int = 1_000_000,
+                poison_every: int = 5):
+    """Seeded mixed feed: every ``poison_every``-th row is poisoned with
+    a deterministic rotation of NaN price, Inf price, a non-coercible
+    volume, or a timestamp far in the past.  Returns
+    ``(rows, clean_rows)`` where rows is the full feed and clean_rows
+    the healthy subset (for pre-filtered parity runs); each element is
+    ``([symbol, price, volume], ts)``."""
+    rng = random.Random(seed)
+    ts = start_ts
+    rows, clean = [], []
+    kinds = ("nan", "inf", "type", "ts_regress")
+    for i in range(n_events):
+        ts += rng.randrange(1, 4)
+        row = (["ABC", float(i), i], ts)
+        if i and i % poison_every == 0:
+            kind = kinds[(i // poison_every) % len(kinds)]
+            if kind == "nan":
+                row = (["ABC", float("nan"), i], ts)
+            elif kind == "inf":
+                row = (["ABC", float("inf"), i], ts)
+            elif kind == "type":
+                row = (["ABC", float(i), object()], ts)
+            else:
+                row = (["ABC", float(i), i], start_ts - 500_000)
+            rows.append(row)
+            continue
+        rows.append(row)
+        clean.append(row)
+    return rows, clean
+
+
+def backwards_feed(n_events: int, seed: int = 0,
+                   start_ts: int = 1_000_000, jump_back_ms: int = 60_000,
+                   every: int = 7):
+    """Seeded feed where every ``every``-th timestamp regresses by
+    ``jump_back_ms`` (beyond any sane slack) — the poisoned-clock
+    upstream.  Returns ``([symbol, price, volume], ts)`` tuples."""
+    rng = random.Random(seed)
+    ts = start_ts
+    out = []
+    for i in range(n_events):
+        ts += rng.randrange(1, 4)
+        bad = i and i % every == 0
+        out.append((["ABC", float(i), i],
+                    ts - jump_back_ms if bad else ts))
+    return out
+
+
+class GatedReceiver:
+    """Junction subscriber that blocks deliveries until ``open()`` —
+    subscribe it directly on an @Async stream to wedge the worker and
+    fill the bounded queue (downstream-of-a-query receivers are
+    pipelined and return immediately, so they exert no backpressure)."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.entered = threading.Event()   # a delivery reached the gate
+        self.received: List = []
+        self._lock = threading.Lock()
+
+    def receive_chunk(self, chunk):
+        self.entered.set()
+        self.gate.wait()
+        with self._lock:
+            self.received.extend(chunk.timestamps.tolist())
+
+    def open(self):
+        self.gate.set()
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return len(self.received)
 
 
 # ------------------------------------------------------------------ clock

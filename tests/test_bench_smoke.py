@@ -51,6 +51,12 @@ def test_bench_smoke_runs_clean():
     assert dsm["sequential"]["dispatches_per_block"] == 2
     assert dsm["stacked"]["dispatches_per_block"] == 1
     assert dsm["stacked"]["matches"] == dsm["sequential"]["matches"] > 0
+    # ingest armor (round 9): SHED_OLDEST under a wedged consumer, with
+    # exact accounting asserted inside the smoke and visible here
+    osm = out["overload_smoke"]
+    assert osm["admitted"] == 200
+    assert osm["shed"] > 0
+    assert osm["admitted"] == osm["delivered"] + osm["shed"]
     prof = out["kernel_profile"]
     assert prof["nfa.bank_step"]["scan_ticks"] > 0
     assert prof["nfa.bank_step"]["dispatch_count"] > 0

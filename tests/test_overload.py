@@ -1,0 +1,465 @@
+"""Ingest-armor contract tests (overload control, poison quarantine,
+dispatch-storm watchdog — siddhi_tpu/core/overload.py):
+
+  * SHED_OLDEST keeps the engine alive at 10x+ offered load with exact
+    shed accounting (admitted == delivered + shed, to the event) and a
+    bounded ingest p99 — no send ever wedges on a saturated buffer;
+  * BLOCK bounds the formerly infinite ``Queue.put()`` with a timeout +
+    typed BufferOverflowError routed through @OnError(action='STORE');
+  * poison quarantine: a mixed poison feed produces results
+    bit-identical to the pre-filtered feed; rejects land in the error
+    store (origin='ingest') and a replay RE-validates (still-poison
+    events return to the store: at-least-once, never silently dropped);
+  * ts32 timestamp-slack edges: within-slack regressions admitted
+    bit-identically, beyond-slack and would-wrap stamps quarantined;
+  * wedged @Async stop(): drain bounded by drain.timeout.ms, leftovers
+    counted as shed reason='drain_timeout';
+  * dispatch-storm watchdog: the round-5 session re-arm crawl
+    (re-introduced behind dwin_compiler.SESSION_REARM_PATHOLOGY) trips
+    in < 500 dispatches, disarms the timer, records a WD001 incident
+    (error store origin='watchdog'), and the app keeps running;
+  * SA06x analyzer diagnostics, /health degraded + /metrics series, and
+    the SIDDHI_TPU_INGEST_GUARD=0 kill switch.
+
+All feeds come from the seeded generators in tests/chaos.py; no
+assertion depends on a wall-clock sleep (rendezvous use junction.flush
+and gated receivers).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import chaos  # noqa: E402  (tests/ is on sys.path via conftest)
+from siddhi_tpu import SiddhiManager, StreamCallback  # noqa: E402
+from siddhi_tpu.analysis import analyze  # noqa: E402
+from siddhi_tpu.core.resilience import InMemoryErrorStore  # noqa: E402
+from siddhi_tpu.ops.ts32 import safe_max  # noqa: E402
+
+
+def _mk(app, error_store=None):
+    m = SiddhiManager()
+    if error_store is not None:
+        m.set_error_store(error_store)
+    return m, m.create_siddhi_app_runtime(app)
+
+
+def _capture(rt, stream="Out"):
+    got = []
+    rt.add_callback(stream, StreamCallback(
+        lambda evs: got.extend((e.timestamp, tuple(e.data)) for e in evs)))
+    return got
+
+
+S = "define stream In (symbol string, price float, volume long);\n"
+PASS_Q = "@info(name='q') from In select symbol, price, volume " \
+         "insert into Out;\n"
+
+
+# ============================================================= admission
+
+def test_shed_oldest_survives_overload_exact_accounting():
+    """10x+ offered load against a wedged consumer: the engine stays
+    alive, every send returns fast, and admitted == delivered + shed
+    exactly (no event unaccounted)."""
+    app = ("@Async(buffer.size='8', batch.size.max='1', "
+           "overload='SHED_OLDEST', overload.high='0.75', "
+           "overload.low='0.25') " + S + PASS_Q)
+    m, rt = _mk(app)
+    gate = chaos.GatedReceiver()
+    rt.junctions["In"].subscribe(gate)
+    rt.start()
+    h = rt.get_input_handler("In")
+    feed = chaos.burst_feed(400, seed=11)     # 50x the 8-chunk buffer
+    lat = []
+    for row, ts in feed:
+        t0 = time.perf_counter()
+        h.send(row, ts)
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    p99 = lat[int(len(lat) * 0.99) - 1]
+    assert p99 < 0.25, f"ingest p99 {p99 * 1e3:.1f} ms — a send wedged"
+    gate.open()
+    rt.junctions["In"].flush()                # barrier: queue fully drained
+    im = rt.ingest_metrics
+    admitted = im.ingest_admitted_total.value(stream="In")
+    shed = im.ingest_shed_total.value(stream="In", reason="shed_oldest")
+    assert admitted == len(feed)              # SHED_OLDEST admits every send
+    assert shed > 0                           # and genuinely shed under load
+    assert admitted == gate.count + shed      # exact accounting
+    assert im.ingest_overflow_total.value(stream="In") == 0
+    rt.shutdown()
+    m.shutdown()
+
+
+def test_block_policy_bounded_timeout_routes_to_error_store():
+    """BLOCK + full buffer: put() is bounded by block.timeout.ms and the
+    overflow surfaces as a typed BufferOverflowError through
+    @OnError(action='STORE') — the pre-armor code blocked forever."""
+    app = ("@OnError(action='STORE') "
+           "@Async(buffer.size='4', batch.size.max='1', overload='BLOCK', "
+           "block.timeout.ms='200') " + S + PASS_Q)
+    m, rt = _mk(app, error_store=InMemoryErrorStore())
+    gate = chaos.GatedReceiver()
+    rt.junctions["In"].subscribe(gate)
+    rt.start()
+    h = rt.get_input_handler("In")
+    for row, ts in chaos.burst_feed(5, seed=3):   # fills buffer + in-hand
+        h.send(row, ts)
+    t0 = time.perf_counter()
+    for row, ts in chaos.burst_feed(3, seed=4, start_ts=2_000_000):
+        h.send(row, ts)                            # each: 200 ms then typed
+    elapsed = time.perf_counter() - t0
+    assert 0.55 <= elapsed < 5.0, f"timeout not bounded: {elapsed:.2f}s"
+    entries = rt.error_store.list(app_name=rt.name)
+    assert [e.origin for e in entries] == ["stream"] * 3
+    assert all("BufferOverflowError" in e.error for e in entries)
+    im = rt.ingest_metrics
+    assert im.ingest_overflow_total.value(stream="In") == 3
+    gate.open()
+    rt.junctions["In"].flush()
+    rt.shutdown()
+    m.shutdown()
+
+
+def test_store_policy_spills_to_error_store():
+    """STORE: above the high watermark new chunks divert to the error
+    store (origin='overload') instead of shedding, and a replay
+    re-ingests them once the consumer recovers."""
+    app = ("@Async(buffer.size='4', batch.size.max='1', overload='STORE', "
+           "overload.high='0.75') " + S + PASS_Q)
+    m, rt = _mk(app, error_store=InMemoryErrorStore())
+    gate = chaos.GatedReceiver()
+    rt.junctions["In"].subscribe(gate)
+    rt.start()
+    h = rt.get_input_handler("In")
+    feed = chaos.burst_feed(40, seed=5)
+    for row, ts in feed:
+        h.send(row, ts)
+    entries = rt.error_store.list(app_name=rt.name)
+    assert entries and all(e.origin == "overload" for e in entries)
+    stored = sum(len(e.events) for e in entries)
+    im = rt.ingest_metrics
+    assert im.ingest_shed_total.value(stream="In", reason="stored") == stored
+    assert im.ingest_admitted_total.value(stream="In") + stored == len(feed)
+    gate.open()
+    rt.junctions["In"].flush()
+    before = gate.count
+    assert rt.replay_errors() == stored
+    # admission still applies during replay: a replayed burst that
+    # refills the buffer re-diverts to the store (no loss, no dup) —
+    # drain in bounded rounds until the store is empty
+    for _ in range(50):
+        rt.junctions["In"].flush()
+        if rt.error_store.count(rt.name) == 0:
+            break
+        rt.replay_errors()
+    assert rt.error_store.count(rt.name) == 0
+    assert gate.count == before + stored      # recovered, none lost
+    rt.shutdown()
+    m.shutdown()
+
+
+def test_wedged_async_stop_drain_is_bounded():
+    """A receiver wedged forever must not wedge shutdown: the drain is
+    bounded by @Async(drain.timeout.ms) and leftovers are counted as
+    shed reason='drain_timeout'."""
+    app = ("@Async(buffer.size='8', batch.size.max='1', "
+           "drain.timeout.ms='500') " + S + PASS_Q)
+    m, rt = _mk(app)
+    gate = chaos.GatedReceiver()                  # never opened pre-stop
+    rt.junctions["In"].subscribe(gate)
+    rt.start()
+    h = rt.get_input_handler("In")
+    for row, ts in chaos.burst_feed(6, seed=7):
+        h.send(row, ts)
+    im = rt.ingest_metrics
+    t0 = time.perf_counter()
+    rt.shutdown()
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 10.0, f"shutdown wedged for {elapsed:.1f}s"
+    assert im.ingest_shed_total.value(stream="In",
+                                      reason="drain_timeout") > 0
+    gate.open()                                   # release the dead worker
+    m.shutdown()
+
+
+# ============================================================ quarantine
+
+QUAR = "@quarantine(ts.slack.ms='1000') " + S
+
+
+def test_poison_feed_parity_and_replay_revalidates():
+    """A mixed poison feed (NaN/Inf prices, a non-coercible volume,
+    wildly regressed stamps) through a quarantined stream produces
+    output BIT-IDENTICAL to the pre-filtered feed through an unguarded
+    stream; every reject is stored (origin='ingest') and a replay
+    re-validates — still-poison events return to the store."""
+    rows, clean = chaos.poison_feed(60, seed=13, poison_every=5)
+    store = InMemoryErrorStore()
+    m, rt = _mk(QUAR + PASS_Q, error_store=store)
+    got = _capture(rt)
+    rt.start()
+    h = rt.get_input_handler("In")
+    for row, ts in rows:
+        h.send(row, ts)
+    rt.flush()
+
+    m2, rt2 = _mk(S + PASS_Q)                     # unguarded reference
+    want = _capture(rt2)
+    rt2.start()
+    h2 = rt2.get_input_handler("In")
+    for row, ts in clean:
+        h2.send(row, ts)
+    rt2.flush()
+    assert got == want, "quarantined run diverged from pre-filtered run"
+
+    n_poison = len(rows) - len(clean)
+    entries = store.list(app_name=rt.name)
+    assert sum(len(e.events) for e in entries) == n_poison
+    assert all(e.origin == "ingest" for e in entries)
+    reasons = {r for e in entries
+               for r in ("nan", "type", "ts_regress") if r in e.error}
+    assert reasons == {"nan", "type", "ts_regress"}
+    im = rt.ingest_metrics
+    quarantined = sum(im.ingest_quarantined_total.series().values())
+    assert quarantined == n_poison
+
+    # replay re-validates: poison is still poison, back in the store
+    rt.replay_errors()
+    rt.flush()
+    entries = store.list(app_name=rt.name)
+    assert sum(len(e.events) for e in entries) == n_poison
+    assert got == want, "replay must not leak poison into results"
+    rt.shutdown()
+    m.shutdown()
+    rt2.shutdown()
+    m2.shutdown()
+
+
+def test_backwards_timestamp_feed_quarantined():
+    """Every beyond-slack regression from the seeded backwards feed is
+    quarantined; the admitted remainder flows through untouched."""
+    feed = chaos.backwards_feed(50, seed=17, jump_back_ms=60_000, every=7)
+    store = InMemoryErrorStore()
+    m, rt = _mk(QUAR + PASS_Q, error_store=store)
+    got = _capture(rt)
+    rt.start()
+    h = rt.get_input_handler("In")
+    for row, ts in feed:
+        h.send(row, ts)
+    rt.flush()
+    n_bad = sum(1 for i in range(50) if i and i % 7 == 0)
+    im = rt.ingest_metrics
+    assert im.ingest_quarantined_total.value(
+        stream="In", reason="ts_regress") == n_bad
+    assert len(got) == len(feed) - n_bad
+    assert all("ts_regress" in e.error
+               for e in store.list(app_name=rt.name))
+    rt.shutdown()
+    m.shutdown()
+
+
+def test_ts32_slack_edges():
+    """ts32 admissibility edges: a regression of exactly the slack is
+    admitted bit-identically, one ms beyond is quarantined, an offset
+    past safe_max(slack) would wrap the ts32 window math and is
+    quarantined WITHOUT advancing the high-water mark."""
+    slack = 1000
+    base = 1_000_000
+    m, rt = _mk(QUAR + PASS_Q, error_store=InMemoryErrorStore())
+    got = _capture(rt)
+    rt.start()
+    h = rt.get_input_handler("In")
+    h.send(["A", 1.0, 1], base)                   # hwm = base
+    h.send(["B", 2.0, 2], base - slack)           # exactly slack: admitted
+    h.send(["C", 3.0, 3], base - slack - 1)       # beyond: quarantined
+    h.send(["D", 4.0, 4], base + safe_max(slack) + 1)   # would wrap
+    h.send(["E", 5.0, 5], base + 10)              # hwm didn't move: admitted
+    rt.flush()
+    assert [(ts, d[0]) for ts, d in got] == \
+        [(base, "A"), (base - slack, "B"), (base + 10, "E")]
+    im = rt.ingest_metrics
+    assert im.ingest_quarantined_total.value(
+        stream="In", reason="ts_regress") == 1
+    assert im.ingest_quarantined_total.value(
+        stream="In", reason="ts_wrap") == 1
+
+    # parity: the admitted subset through an unguarded engine is
+    # bit-identical (the validator must not perturb admitted events)
+    m2, rt2 = _mk(S + PASS_Q)
+    want = _capture(rt2)
+    rt2.start()
+    h2 = rt2.get_input_handler("In")
+    for row, ts in [(["A", 1.0, 1], base), (["B", 2.0, 2], base - slack),
+                    (["E", 5.0, 5], base + 10)]:
+        h2.send(row, ts)
+    rt2.flush()
+    assert got == want
+    rt.shutdown()
+    m.shutdown()
+    rt2.shutdown()
+    m2.shutdown()
+
+
+# ============================================================== watchdog
+
+def test_dispatch_storm_watchdog_trips_and_disarms():
+    """Regression for the session-timer dispatch storm: with the
+    round-5 re-arm pathology re-introduced (a 1 ms timer crawl with
+    zero ingest progress), the watchdog must trip in < 500 dispatches,
+    force-disarm the timer, record a WD001 incident (and an error-store
+    entry, origin='watchdog'), and the app must keep running."""
+    import numpy as np
+
+    import siddhi_tpu.plan.dwin_compiler as dwc
+    from siddhi_tpu import QueryCallback
+
+    cse = "define stream cse (symbol string, price float, volume long);\n"
+    app = ("@app:playback " + cse +
+           "@info(name='q') from cse#window.session(700, symbol) "
+           "select symbol, price, volume insert all events into out;")
+    fired = [0]
+    orig = dwc.DeviceWindowProcessor._on_timer
+
+    def counted(self, now):
+        fired[0] += 1
+        return orig(self, now)
+
+    dwc.SESSION_REARM_PATHOLOGY = True
+    dwc.DeviceWindowProcessor._on_timer = counted
+    try:
+        m, rt = _mk(app, error_store=InMemoryErrorStore())
+        rt.add_callback("q", QueryCallback(lambda *a: None))
+        rt.start()
+        h = rt.get_input_handler("cse")
+
+        def send(sym, ts):
+            h.send_batch(
+                {"symbol": np.asarray([sym], object),
+                 "price": np.asarray([1.0], np.float32),
+                 "volume": np.asarray([ts], np.int64)},
+                np.asarray([ts], np.int64))
+
+        send("A", 1000)
+        send("C", 50_000)      # un-guarded: a ~49k-fire 1 ms crawl
+        wd = rt.watchdog
+        assert wd.incidents, "watchdog did not trip on the storm"
+        inc = wd.incidents[0]
+        assert inc["code"] == "WD001"
+        assert inc["fires"] < 500
+        assert fired[0] < 500, f"storm ran {fired[0]} dispatches"
+        assert inc["target"].endswith(".counted")   # the timer target
+        entries = rt.error_store.list(app_name=rt.name)
+        assert any(e.origin == "watchdog" for e in entries)
+        assert rt.ingest_metrics.watchdog_trips_total.series()
+        send("D", 60_000)      # timer disarmed; the app still ingests
+        rt.flush()
+        rt.shutdown()
+        m.shutdown()
+    finally:
+        dwc.SESSION_REARM_PATHOLOGY = False
+        dwc.DeviceWindowProcessor._on_timer = orig
+
+
+# ============================================================== analyzer
+
+def test_analyzer_sa06x_diagnostics():
+    ok = ("@Async(buffer.size='64', overload='SHED_OLDEST', "
+          "overload.high='0.8', overload.low='0.5') " + S + PASS_Q)
+    assert not {"SA060", "SA061", "SA062", "SA063"} & \
+        set(analyze(ok).codes())
+    bad_policy = "@Async(overload='DROP_EVERYTHING') " + S + PASS_Q
+    assert "SA060" in analyze(bad_policy).codes()
+    bad_marks = ("@Async(overload='SHED_NEW', overload.high='0.2', "
+                 "overload.low='0.9') " + S + PASS_Q)
+    assert "SA061" in analyze(bad_marks).codes()
+    store_no_store = "@Async(overload='STORE') " + S + PASS_Q
+    assert "SA062" in analyze(store_no_store).codes()
+    bad_quar = "@quarantine(nan='maybe') " + S + PASS_Q
+    assert "SA063" in analyze(bad_quar).codes()
+    bad_slack = "@quarantine(ts.slack.ms='-5') " + S + PASS_Q
+    assert "SA063" in analyze(bad_slack).codes()
+
+
+# ============================================================== service
+
+def test_service_health_degraded_and_ingest_metrics():
+    """REST surface: a saturated @Async buffer flips /health to
+    'degraded' with the stream listed, /metrics exposes the
+    siddhi_ingest_* series, and recovery returns /health to 'up'."""
+    import json
+    import urllib.request
+
+    from siddhi_tpu.service import SiddhiService
+
+    def req(method, url, body=None):
+        data = json.dumps(body).encode() if isinstance(body, (dict, list)) \
+            else (body.encode() if isinstance(body, str) else None)
+        r = urllib.request.Request(url, data=data, method=method)
+        with urllib.request.urlopen(r) as resp:
+            return resp.status, resp.read().decode()
+
+    app = ("@app:name('armored') "
+           "@Async(buffer.size='4', batch.size.max='1', "
+           "overload='SHED_NEW', overload.high='0.25', "
+           "overload.low='0.1', drain.timeout.ms='500') "
+           "define stream S (symbol string, price float); "
+           "@info(name='q') from S select symbol, price insert into Out;")
+    svc = SiddhiService(port=0).start()
+    base = f"http://127.0.0.1:{svc.port}"
+    gate = chaos.GatedReceiver()
+    try:
+        req("POST", f"{base}/siddhi/artifact/deploy", app)
+        rt = svc.manager.runtimes["armored"]
+        rt.junctions["S"].subscribe(gate)
+        # wedge the worker on one delivery first, then burst: the queue
+        # then sits pinned at the high watermark (high_chunks=1)
+        req("POST", f"{base}/siddhi/apps/armored/streams/S",
+            [{"data": ["A", 0.0]}])
+        assert gate.entered.wait(10.0)
+        req("POST", f"{base}/siddhi/apps/armored/streams/S",
+            [{"data": ["A", float(i)]} for i in range(12)])
+        _, body = req("GET", f"{base}/health")
+        health = json.loads(body)
+        assert health["status"] == "degraded"
+        assert health["apps"]["armored"]["saturated_streams"] == ["S"]
+        _, text = req("GET", f"{base}/metrics")
+        assert "# TYPE siddhi_ingest_admitted_total counter" in text
+        assert 'siddhi_ingest_admitted_total{app="armored",stream="S"}' \
+            in text
+        assert 'siddhi_ingest_shed_total{app="armored",' in text
+        assert 'siddhi_ingest_saturation{app="armored",stream="S"}' in text
+        gate.open()
+        rt.junctions["S"].flush()
+        _, body = req("GET", f"{base}/health")
+        assert json.loads(body)["status"] == "up"
+    finally:
+        gate.open()            # never leave a wedged worker for stop()
+        svc.stop()
+
+
+# ============================================================ kill switch
+
+def test_kill_switch_disables_ingest_guard(monkeypatch):
+    """SIDDHI_TPU_INGEST_GUARD=0: no admission control, no validator, no
+    watchdog — the legacy unbounded path, bit-for-bit."""
+    monkeypatch.setenv("SIDDHI_TPU_INGEST_GUARD", "0")
+    app = ("@Async(buffer.size='8', overload='SHED_OLDEST') " + QUAR +
+           PASS_Q)
+    m, rt = _mk(app)
+    got = _capture(rt)
+    rt.start()
+    j = rt.junctions["In"]
+    assert j.overload is None
+    assert j.validator is None
+    assert rt.watchdog is None
+    h = rt.get_input_handler("In")
+    h.send(["A", float("nan"), 1], 1000)      # poison flows through
+    rt.flush()
+    assert len(got) == 1
+    im = rt.ingest_metrics
+    assert sum(im.ingest_admitted_total.series().values()) == 0
+    rt.shutdown()
+    m.shutdown()
