@@ -79,6 +79,10 @@ def nfa_state_bytes(a: AutomatonIR,
         (not a.is_sequence and a.states and a.states[0].kind == "count")
     if arm_once:
         b["armed_total"] = P * I32
+    if a.telemetry:
+        # [occ[S] ‖ gate_pass[S] ‖ gate_fail[S] ‖ within_drops] per
+        # partition (@app:statistics(telemetry='true'), ops/nfa.make_carry)
+        b["telem"] = P * (3 * len(a.states) + 1) * I32
     return b
 
 

@@ -94,6 +94,8 @@ class AutomatonIR:
     #                               super-dispatch (round 7)
     dispatches_per_block: int = 1  # device executions per ingest block
     #                                (n_chunks when sequential, 1 stacked)
+    telemetry: bool = False       # opt-in on-device state telemetry leaf
+    #                               (@app:statistics(telemetry='true'))
 
     @property
     def accept(self) -> int:
@@ -112,6 +114,7 @@ class AutomatonIR:
             "pruned_states": self.pruned_states,
             "simplified_conditions": self.simplified_conditions,
             "statically_dead": self.statically_dead,
+            "telemetry": self.telemetry,
         }
 
 
@@ -158,6 +161,7 @@ class PlanIR:
                 ("every", a.is_every), ("sequence", a.is_sequence),
                 ("eps_start", a.eps_start), ("dead_start", a.dead_start),
                 ("lead_absent", a.lead_absent), ("meshed", a.meshed),
+                ("telem", a.telemetry),
                 ("DEAD", a.statically_dead)) if on]
             out.append(
                 f"  automaton {a.query}: states={len(a.states)} "
@@ -295,7 +299,8 @@ def automaton_ir_from_nfa(nfa, query: str) -> AutomatonIR:
         prune_notes=tuple(report.get("notes", ())),
         egress_cap=int(getattr(nfa, "_egress_cap", 1024)),
         meshed=getattr(nfa, "mesh", None) is not None,
-        batch_b=max(int(getattr(nfa, "batch_b", 1)), 1))
+        batch_b=max(int(getattr(nfa, "batch_b", 1)), 1),
+        telemetry=bool(getattr(spec, "telemetry", False)))
 
 
 def _array_bytes(obj) -> int:

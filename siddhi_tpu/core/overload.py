@@ -269,6 +269,15 @@ def route_rejects(junction, events_by_reason: List[Tuple[str, list]]):
     im = getattr(rt, "ingest_metrics", None)
     store = getattr(rt, "error_store", None)
     sid = junction.definition.id
+    total = sum(len(events) for _r, events in events_by_reason)
+    from .flight import flight, quarantine_burst_threshold
+    if total >= quarantine_burst_threshold():
+        flight().emit(
+            "quarantine_burst", app=app_name,
+            detail={"stream": sid, "rejected": total,
+                    "reasons": {r: len(e) for r, e in events_by_reason
+                                if e}},
+            runtime=rt)
     for reason, events in events_by_reason:
         if not events:
             continue
@@ -407,6 +416,13 @@ class DispatchWatchdog:
                     []))
         except Exception:   # noqa: BLE001 — tripping must never raise
             log.exception("watchdog error-store write failed")
+        try:
+            from .flight import flight
+            flight().emit("watchdog_trip", app=self.app_name,
+                          detail=incident,
+                          runtime=getattr(self, "runtime", None))
+        except Exception:   # noqa: BLE001
+            log.exception("watchdog flight-bundle emit failed")
 
 
 # ------------------------------------------------------------------ metrics

@@ -256,6 +256,12 @@ class KernelProfiler:
         with self._lock:
             return sum(st.dispatch_count for st in self.kernels.values())
 
+    def total_scan_ticks(self) -> int:
+        """Sum of every kernel's scan_ticks — the flight recorder diffs
+        this around an ingest block for the per-block record."""
+        with self._lock:
+            return sum(st.scan_ticks for st in self.kernels.values())
+
     def record_app_block(self, app: str, dispatches: int):
         """One ingest block for `app` cost `dispatches` device launches."""
         if not self.enabled:

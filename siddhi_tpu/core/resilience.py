@@ -141,6 +141,7 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = 0.0
         self._lock = threading.Lock()
+        self._pending: list = []     # transitions awaiting callback
 
     @classmethod
     def from_options(cls, options: Dict[str, str],
@@ -153,10 +154,26 @@ class CircuitBreaker:
             **kw)
 
     def _transition(self, new: str):
+        """Record a state change; the callback fires AFTER the lock is
+        released (_fire_pending) — on_transition hooks may read breaker
+        state (the circuit_state gauge does, and the flight-recorder
+        incident bundle renders that gauge), which would self-deadlock
+        on this non-reentrant lock if called inline."""
         old, self._state = self._state, new
         if old != new and self.on_transition is not None:
+            self._pending.append((old, new))
+
+    def _fire_pending(self):
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return
+                old, new = self._pending.pop(0)
+            cb = self.on_transition
+            if cb is None:
+                continue
             try:
-                self.on_transition(old, new)
+                cb(old, new)
             except Exception:   # noqa: BLE001 — metrics must not break flow
                 pass
 
@@ -164,7 +181,9 @@ class CircuitBreaker:
     def state(self) -> str:
         with self._lock:
             self._maybe_half_open()
-            return self._state
+            st = self._state
+        self._fire_pending()
+        return st
 
     @property
     def state_code(self) -> int:
@@ -180,12 +199,15 @@ class CircuitBreaker:
         """May a publish attempt proceed right now?"""
         with self._lock:
             self._maybe_half_open()
-            return self._state != OPEN
+            ok = self._state != OPEN
+        self._fire_pending()
+        return ok
 
     def record_success(self):
         with self._lock:
             self._failures = 0
             self._transition(CLOSED)
+        self._fire_pending()
 
     def record_failure(self):
         with self._lock:
@@ -194,6 +216,7 @@ class CircuitBreaker:
                     self._failures >= self.failure_threshold:
                 self._opened_at = self.clock()
                 self._transition(OPEN)
+        self._fire_pending()
 
 
 # ------------------------------------------------------------------ metrics

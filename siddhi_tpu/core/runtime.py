@@ -166,6 +166,7 @@ class SiddhiAppRuntime:
             stats = find_annotation(self.app.annotations, "statistics")
         reporter, interval, enabled = "console", 60, False
         tracing_on = False
+        telemetry_on = False
         if stats is not None:
             reporter = stats.get("reporter", "console")
             interval = int(stats.get("interval", "60"))
@@ -177,9 +178,19 @@ class SiddhiAppRuntime:
             elif pos and str(pos[0]).lower() == "false":
                 enabled = False
             tracing_on = str(stats.get("tracing", "false")).lower() == "true"
+            telemetry_on = \
+                str(stats.get("telemetry", "false")).lower() == "true"
         self.app_ctx.statistics_manager = StatisticsManager(
             self.name, reporter, interval)
         self.app_ctx.stats_enabled = enabled
+        # @app:statistics(telemetry='true') — opt-in on-device NFA/window
+        # state telemetry; compilers read the flag off app_ctx, the device
+        # runtimes push host copies into the DeviceTelemetry holder
+        self.app_ctx.telemetry_enabled = telemetry_on
+        self.device_telemetry = None
+        if telemetry_on:
+            from .statistics import DeviceTelemetry
+            self.device_telemetry = DeviceTelemetry(self.name)
         if enabled:
             # kernel profiling rides @app:statistics: the per-kernel
             # compile/device-time gauges feed the same /metrics surface
@@ -612,6 +623,8 @@ class SiddhiAppRuntime:
         from .profiling import profiler
         snap = self.app_ctx.statistics_manager.snapshot()
         snap["kernels"] = profiler().snapshot()
+        if self.device_telemetry is not None:
+            snap["telemetry"] = self.device_telemetry.snapshot()
         return snap
 
     # ------------------------------------------------------------ tracing

@@ -32,6 +32,8 @@ class Scheduler:
         self._lock = threading.RLock()
         self._timer: Optional[threading.Timer] = None
         self._stopped = False
+        #: cumulative fired-target count (flight-recorder block records)
+        self.fires = 0
         if ts_gen.in_playback:
             ts_gen.add_time_change_listener(self._on_virtual_time)
 
@@ -68,6 +70,7 @@ class Scheduler:
         for _ts, _, target in due:
             if wd is not None and not wd.allow(target, now):
                 continue
+            self.fires += 1
             try:
                 target(now)
             except Exception:  # noqa: BLE001 — scheduler thread must survive
@@ -94,6 +97,7 @@ class Scheduler:
             for ts, _, target in due:
                 if wd is not None and not wd.allow(target, ts):
                     continue
+                self.fires += 1
                 target(ts)
 
     def shutdown(self):

@@ -281,9 +281,18 @@ class Sink:
             sid = self.stream_def.id
             m.circuit_state.set_fn(
                 lambda b=self.breaker: b.state_code, sink=sid)
-            self.breaker.on_transition = (
-                lambda old, new, m=m, sid=sid:
-                m.circuit_transitions_total.inc(sink=sid, to=new))
+
+            def _on_transition(old, new, m=m, sid=sid, rt=app_runtime):
+                m.circuit_transitions_total.inc(sink=sid, to=new)
+                if new == "open":
+                    # incident bus: a sink fast-failing is exactly the
+                    # moment the operator wants the recent flight ring
+                    from .flight import flight
+                    flight().emit("circuit_open",
+                                  app=getattr(rt, "name", ""),
+                                  detail={"sink": sid, "from": old},
+                                  runtime=rt)
+            self.breaker.on_transition = _on_transition
 
     @property
     def app_name(self) -> str:

@@ -35,7 +35,7 @@ import json
 import sys
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 # ------------------------------------------------------------------ histogram
 
@@ -499,22 +499,123 @@ _TYPES = [
      "counter", "Events carried through a kernel"),
     ("siddhi_kernel_dispatches_total",
      "counter", "Device executions launched by a kernel"),
+    ("siddhi_kernel_scan_ticks_total",
+     "counter", "lax.scan ticks executed inside a kernel"),
+    ("siddhi_kernel_live_bytes",
+     "gauge", "Live device-buffer bytes owned by a kernel"),
+    ("siddhi_kernel_batch_b", "gauge", "Events folded per scan tick (B)"),
     ("siddhi_app_dispatches_per_block",
      "gauge", "Device dispatches per ingest block (running average)"),
 ]
 
+#: Opt-in on-device state telemetry (@app:statistics(telemetry='true')).
+#: Accumulated in-kernel (ops/nfa.py, ops/dwin.py) and read out through
+#: the fused-egress slab — see DeviceTelemetry.
+TELEMETRY_TYPES = [
+    ("siddhi_nfa_state_occupancy",
+     "gauge", "Live NFA slot occupancy per automaton state"),
+    ("siddhi_nfa_gate_pass_total",
+     "counter", "Condition-gate passes per automaton state"),
+    ("siddhi_nfa_gate_fail_total",
+     "counter", "Condition-gate failures per automaton state"),
+    ("siddhi_nfa_within_drops_total",
+     "counter", "Partial matches expired by the within clause"),
+    ("siddhi_dwin_ring_fill", "gauge", "Device window ring occupancy"),
+    ("siddhi_dwin_evictions_total",
+     "counter", "Events evicted/expired from a device window"),
+    ("siddhi_dwin_overflow_total",
+     "counter", "Device window ring overflow trips"),
+]
+
+
+class DeviceTelemetry:
+    """Host-side holder for the opt-in on-device telemetry blocks.
+
+    NFA carries contribute a ``[P, 3S+1]`` int32 leaf per query
+    (per-state occupancy gauge, cumulative gate pass/fail counts, within
+    drops); device windows contribute ``[fill, evictions, overflow]``.
+    The device runtimes push the latest host copy here on retire; REST
+    ``/metrics``, ``rt.statistics`` and the flight ring read it out."""
+
+    def __init__(self, app_name: str):
+        self.app_name = app_name
+        self._lock = threading.Lock()
+        self._nfa: Dict[str, Dict[str, Any]] = {}
+        self._windows: Dict[str, Dict[str, int]] = {}
+
+    def update_nfa(self, query: str, telem, n_states: int,
+                   unit_kinds=None) -> None:
+        import numpy as np
+        t = np.asarray(telem)
+        if t.ndim == 2:             # [P, 3S+1] → totals across partitions
+            t = t.sum(axis=0)
+        S = int(n_states)
+        with self._lock:
+            self._nfa[query] = {
+                "occupancy": [int(v) for v in t[:S]],
+                "gate_pass": [int(v) for v in t[S:2 * S]],
+                "gate_fail": [int(v) for v in t[2 * S:3 * S]],
+                "within_drops": int(t[3 * S]),
+                "state_kinds": list(unit_kinds or []),
+            }
+
+    def update_window(self, name: str, telem3) -> None:
+        import numpy as np
+        t = np.asarray(telem3).reshape(-1)
+        with self._lock:
+            self._windows[name] = {"fill": int(t[0]),
+                                   "evictions": int(t[1]),
+                                   "overflow": int(t[2])}
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"nfa": {q: dict(v) for q, v in self._nfa.items()},
+                    "windows": {w: dict(v)
+                                for w, v in self._windows.items()}}
+
+    def prometheus_lines(self) -> List[str]:
+        lines: List[str] = []
+        with self._lock:
+            for query, rec in self._nfa.items():
+                for i, occ in enumerate(rec["occupancy"]):
+                    lb = _fmt_labels({"app": self.app_name, "query": query,
+                                      "state": str(i)})
+                    lines.append(f"siddhi_nfa_state_occupancy{lb} {occ}")
+                for i, v in enumerate(rec["gate_pass"]):
+                    lb = _fmt_labels({"app": self.app_name, "query": query,
+                                      "state": str(i)})
+                    lines.append(f"siddhi_nfa_gate_pass_total{lb} {v}")
+                for i, v in enumerate(rec["gate_fail"]):
+                    lb = _fmt_labels({"app": self.app_name, "query": query,
+                                      "state": str(i)})
+                    lines.append(f"siddhi_nfa_gate_fail_total{lb} {v}")
+                lb = _fmt_labels({"app": self.app_name, "query": query})
+                lines.append("siddhi_nfa_within_drops_total"
+                             f"{lb} {rec['within_drops']}")
+            for name, rec in self._windows.items():
+                lb = _fmt_labels({"app": self.app_name, "window": name})
+                lines.append(f"siddhi_dwin_ring_fill{lb} {rec['fill']}")
+                lines.append("siddhi_dwin_evictions_total"
+                             f"{lb} {rec['evictions']}")
+                lines.append("siddhi_dwin_overflow_total"
+                             f"{lb} {rec['overflow']}")
+        return lines
+
 
 def prometheus_text(managers: List[StatisticsManager],
                     kernel_profiler=None, resilience=None,
-                    ingest=None) -> str:
+                    ingest=None, telemetry=None) -> str:
     """Full Prometheus/OpenMetrics text exposition over any number of app
     StatisticsManagers plus the (process-global) kernel profiler, the
-    per-runtime ResilienceMetrics (core/resilience.py) and the
-    per-runtime IngestMetrics (core/overload.py)."""
+    per-runtime ResilienceMetrics (core/resilience.py), the per-runtime
+    IngestMetrics (core/overload.py) and the per-runtime DeviceTelemetry
+    holders.  Every series family gets its # HELP/# TYPE header exactly
+    once, before any samples."""
     from .overload import INGEST_TYPES
     from .resilience import RESILIENCE_TYPES
     lines: List[str] = []
-    for name, typ, help_ in _TYPES + RESILIENCE_TYPES + INGEST_TYPES:
+    for name, typ, help_ in (_TYPES + TELEMETRY_TYPES +
+                             RESILIENCE_TYPES + INGEST_TYPES):
         lines.append(f"# HELP {name} {help_}")
         lines.append(f"# TYPE {name} {typ}")
     for sm in managers:
@@ -525,4 +626,6 @@ def prometheus_text(managers: List[StatisticsManager],
         lines.extend(rm.prometheus_lines())
     for im in (ingest or []):
         lines.extend(im.prometheus_lines())
+    for dt in (telemetry or []):
+        lines.extend(dt.prometheus_lines())
     return "\n".join(lines) + "\n"

@@ -477,6 +477,17 @@ class StreamJunction:
                 self._handle_error(chunk, e, receiver=r)
 
     def _handle_error(self, chunk: EventChunk, e: Exception, receiver=None):
+        from .flight import flight
+        rt = getattr(self.app_ctx, "runtime", None)
+        app_name = rt.name if rt is not None else ""
+        flight().note_error(app_name, self.definition.id, e)
+        if isinstance(e, BufferOverflowError):
+            # incident bus: an admission overflow means load shedding is
+            # losing events — dump a bundle while the ring still shows
+            # the blocks leading up to it
+            flight().emit("buffer_overflow", app=app_name,
+                          detail={"stream": self.definition.id,
+                                  "error": str(e)}, runtime=rt)
         action = self.on_error_action
         if action == "WAIT" and receiver is not None:
             # bounded blocking until downstream recovers: retry THIS
@@ -509,6 +520,12 @@ class StreamJunction:
             return
         log.error("Error processing stream '%s': %s\n%s",
                   self.definition.id, e, traceback.format_exc())
+        if not isinstance(e, BufferOverflowError):
+            # uncaught junction exception (no @OnError route absorbed it)
+            flight().emit("junction_exception", app=app_name,
+                          detail={"stream": self.definition.id,
+                                  "error": f"{type(e).__name__}: {e}"},
+                          runtime=rt)
         for listener in self.app_ctx.exception_listeners:
             listener(e)
 

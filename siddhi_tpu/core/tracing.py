@@ -136,9 +136,15 @@ class Tracer:
 
     # ------------------------------------------------------------ export
 
-    def to_dict(self) -> Dict[str, Any]:
+    def to_dict(self, limit: Optional[int] = None) -> Dict[str, Any]:
+        """Chrome-trace document.  ``limit`` keeps only the newest N
+        events — incident bundles embed the trace, and a full buffer
+        (up to 500k events) would dwarf everything else in the dump."""
         with self._lock:
-            events = list(self._events)
+            if limit is not None and len(self._events) > limit:
+                events = list(self._events)[-limit:]
+            else:
+                events = list(self._events)
         return {"traceEvents": events, "displayTimeUnit": "ms",
                 "otherData": {"engine": "siddhi_tpu"}}
 

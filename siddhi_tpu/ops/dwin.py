@@ -63,6 +63,11 @@ class DwinSpec(NamedTuple):
     #                        lo) entries whose lex order IS int64 order
     skey_lane: int = -1  # session kind: i32 lane holding the dict-encoded
     #                      session key (keyless apps encode one code)
+    telemetry: bool = False  # @app:statistics(telemetry='true'): carry a
+    #                      [P, 3] int32 telemetry leaf (fill gauge,
+    #                      evictions total, overflow total) and append a
+    #                      summary row to the egress buffer (before the
+    #                      tail) — no extra D2H, emissions bit-identical
 
 
 def make_dwin_carry(spec: DwinSpec, n_lanes: int) -> Dict[str, np.ndarray]:
@@ -78,6 +83,9 @@ def make_dwin_carry(spec: DwinSpec, n_lanes: int) -> Dict[str, np.ndarray]:
                  exp_i=np.zeros((P, W, I), np.int32),
                  exp_ts=np.full((P, W), TS_NONE, np.int32),
                  exp_fill=np.zeros((P,), np.int32))
+    if spec.telemetry:
+        # [fill gauge, evictions total, overflow total] per lane
+        c["telem"] = np.zeros((P, 3), np.int32)
     return c
 
 
@@ -127,8 +135,10 @@ def _new_ring(pf, pi, pts, keep, rank, W, F, I):
 
 
 def _pack_egress(emit_mask, pool_idx, evict_t, cause, pts, pf, pi,
-                 tail_vals, cap):
-    """[P, M] emission set → [cap+1, 4+F+I] compacted rows + tail."""
+                 tail_vals, cap, telem_row=None):
+    """[P, M] emission set → [cap+1, 4+F+I] compacted rows + tail.
+    When `telem_row` (a [3] int32 summary) is given, one extra row is
+    appended BEFORE the tail, so ``buf[-1]`` stays the tail row."""
     P, M = emit_mask.shape
     F = pf.shape[-1]
     I = pi.shape[-1]
@@ -148,6 +158,10 @@ def _pack_egress(emit_mask, pool_idx, evict_t, cause, pts, pf, pi,
     tail = tail.at[0, 0].set(jnp.sum(flat.astype(jnp.int32)))
     for k, v in enumerate(tail_vals):
         tail = tail.at[0, 1 + k].set(v)
+    if telem_row is not None:
+        trow = jnp.zeros((1, 4 + F + I), jnp.int32)
+        trow = trow.at[0, :3].set(telem_row)
+        return jnp.concatenate([rows, trow, tail], axis=0)
     return jnp.concatenate([rows, tail], axis=0)
 
 
@@ -167,6 +181,22 @@ def build_dwin_step(spec: DwinSpec):
         j = jnp.arange(M)[None, :]
         is_carry = j < W
         new_carry = dict(carry)
+
+        def telem(nfill, emit_mask, ovf_mask):
+            """Accumulate the telemetry leaf; returns the [3] summary row
+            for _pack_egress (None when telemetry is off).  Pure addition
+            over masks the kernel already computed — emissions and ring
+            contents are untouched."""
+            tel = carry.get("telem")
+            if tel is None:
+                return None
+            ev = jnp.sum(emit_mask.astype(jnp.int32), axis=1)
+            nt = jnp.stack([nfill, tel[:, 1] + ev,
+                            tel[:, 2] + ovf_mask.astype(jnp.int32)],
+                           axis=1)
+            new_carry["telem"] = nt
+            return jnp.stack([jnp.max(nt[:, 0]), jnp.sum(nt[:, 1]),
+                              jnp.sum(nt[:, 2])])
 
         if kind == "sort":
             # Keep the bottom-N by (sort key, arrival rank); each
@@ -208,7 +238,8 @@ def build_dwin_step(spec: DwinSpec):
                              fill=nfill)
             buf = _pack_egress(evicted, j, evict_t, cause, pts, pf, pi,
                                (jnp.max(nfill), jnp.int32(0), TS_NONE,
-                                jnp.max(ovf.astype(jnp.int32))), cap)
+                                jnp.max(ovf.astype(jnp.int32))), cap,
+                               telem_row=telem(nfill, evicted, ovf))
             return new_carry, buf
 
         if kind == "session":
@@ -250,7 +281,8 @@ def build_dwin_step(spec: DwinSpec):
             live_min = jnp.min(jnp.where(w_live, last_new, TS_NONE))
             buf = _pack_egress(expired, j, evict_ts, cause, pts, pf, pi,
                                (jnp.max(nfill), jnp.int32(0), live_min,
-                                jnp.max(ovf.astype(jnp.int32))), cap)
+                                jnp.max(ovf.astype(jnp.int32))), cap,
+                               telem_row=telem(nfill, expired, ovf))
             return new_carry, buf
 
         if kind in ("length", "time", "externalTime", "timeLength",
@@ -328,7 +360,8 @@ def build_dwin_step(spec: DwinSpec):
                 jnp.arange(W)[None, :] < nfill[:, None], sts, TS_NONE))
             buf = _pack_egress(evicted, j, evict_t, cause, pts, pf, pi,
                                (jnp.max(nfill), jnp.int32(0), live_min,
-                                jnp.max(ovf.astype(jnp.int32))), cap)
+                                jnp.max(ovf.astype(jnp.int32))), cap,
+                               telem_row=telem(nfill, evicted, ovf))
             return new_carry, buf
 
         # ---------------- batch kinds ----------------
@@ -372,7 +405,8 @@ def build_dwin_step(spec: DwinSpec):
             buf = _pack_egress(emit, j, jnp.zeros((P, M), jnp.int32),
                                cause, pts, pf, pi,
                                (jnp.max(nfill), jnp.int32(0), TS_NONE,
-                                jnp.max(ovf.astype(jnp.int32))), cap)
+                                jnp.max(ovf.astype(jnp.int32))), cap,
+                               telem_row=telem(nfill, emit, ovf))
             return new_carry, buf
 
         cause = jnp.full((P, M), C_BATCH, jnp.int32)
@@ -412,7 +446,8 @@ def build_dwin_step(spec: DwinSpec):
                            all_f, all_i,
                            (jnp.max(nfill), jnp.max(post_exp_fill), TS_NONE,
                             jnp.max((ovf | eovf).astype(jnp.int32))),
-                           cap)
+                           cap, telem_row=telem(nfill, all_mask,
+                                                ovf | eovf))
         return new_carry, buf
 
     return step

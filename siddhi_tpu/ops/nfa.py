@@ -171,6 +171,13 @@ class NfaSpec(NamedTuple):
     #                                   compiler pins resolve_batch_b();
     #                                   0 → resolve from env at build time,
     #                                   1 → legacy one-event ticks)
+    telemetry: bool = False           # @app:statistics(telemetry='true'):
+    #                                   accumulate an int32 telemetry leaf
+    #                                   (per-state occupancy, gate
+    #                                   pass/fail, within-expiry drops) in
+    #                                   the carry — read out through the
+    #                                   fused egress slab; MUST leave match
+    #                                   outputs bit-identical
 
     @property
     def n_states(self) -> int:
@@ -232,6 +239,9 @@ def make_carry(spec: NfaSpec, n_partitions: int) -> Dict[str, jnp.ndarray]:
         carry["deadline"] = jnp.zeros((P, K), jnp.int32)
     if spec.arm_once:
         carry["armed_total"] = jnp.zeros((P,), jnp.int32)
+    if spec.telemetry:
+        # [occ[S] (gauge) ‖ gate_pass[S] ‖ gate_fail[S] ‖ within_drops]
+        carry["telem"] = jnp.zeros((P, 3 * len(spec.units) + 1), jnp.int32)
     return carry
 
 
@@ -581,6 +591,12 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
 
     s = _StepState(spec, carry, K)
 
+    # telemetry leaf rides the carry untouched by the match math: every
+    # contribution below is a NEW reduction over masks the transition
+    # logic already computes, so match outputs stay bit-identical
+    tel = carry.get("telem") if spec.telemetry else None
+    tel_exp = jnp.int32(0)
+
     # ---- within expiry (reference isExpired :104-113 — start-state
     # partials are exempt: a half-filled leading pair or accumulating
     # kleene start never expires, only later units enforce `within`)
@@ -590,6 +606,8 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
             # the empty-kleene start partial (leading min-0) sits at unit
             # 1 but IS a start-state partial — exempt
             expired = expired & ~((s.st == 1) & (s.cnt_prev == 0))
+        if tel is not None:
+            tel_exp = jnp.sum(expired.astype(jnp.int32))
         s.st = jnp.where(expired, -1, s.st)
 
     # ---- leading absent ensure-arm: the oracle re-initializes the start
@@ -1059,6 +1077,33 @@ def _one_partition_step(spec: NfaSpec, carry: Dict, event):
         out["deadline"] = s.deadline
     if s.armed_total is not None:
         out["armed_total"] = s.armed_total
+    if tel is not None:
+        # gate pass/fail per unit: reuse the conds/st_pre/stream values
+        # the transitions consumed — an "eligible" slot sat at unit j on
+        # the matching stream; "pass" means its condition program fired
+        tel_pass, tel_fail = [], []
+        for j, u in enumerate(units):
+            at = valid & (st_pre == j)
+            if u.cond_a >= 0:
+                elig = at & (stream == u.stream_a)
+                hit = elig & conds[u.cond_a]
+            else:
+                elig = jnp.zeros((K,), bool)
+                hit = elig
+            if u.cond_b >= 0:
+                elig_b = at & (stream == u.stream_b)
+                hit = hit | (elig_b & conds[u.cond_b])
+                elig = elig | elig_b
+            tel_pass.append(jnp.sum(hit.astype(jnp.int32)))
+            tel_fail.append(jnp.sum((elig & ~hit).astype(jnp.int32)))
+        occ = jnp.sum((s.st[None, :] == jnp.arange(S)[:, None])
+                      .astype(jnp.int32), axis=1)
+        out["telem"] = jnp.concatenate([
+            occ,                                    # live occupancy gauge
+            tel[S:2 * S] + jnp.stack(tel_pass),
+            tel[2 * S:3 * S] + jnp.stack(tel_fail),
+            (tel[3 * S] + tel_exp)[None],           # within-expiry drops
+        ])
     return out, (s.m_mask, match_caps, s.m_ts, s.m_enter, s.m_seq)
 
 

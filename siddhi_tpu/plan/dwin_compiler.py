@@ -214,6 +214,10 @@ class DeviceWindowProcessor(WindowProcessor):
         self.n_f, self.n_i = nf, ni
 
         self.capacity = max(W_START, 2 * self.length or 0)
+        # @app:statistics(telemetry='true'): ring fill / eviction /
+        # overflow counters ride the carry + egress buffer
+        self.telemetry = bool(getattr(app_ctx, "telemetry_enabled", False))
+        self.last_telemetry = None        # [P, 3] host int32 after retire
         self._base: Optional[int] = None
         self.carry = None                 # device dict (lazy at first use)
         self._steps: Dict[Tuple[int, int], callable] = {}
@@ -235,7 +239,8 @@ class DeviceWindowProcessor(WindowProcessor):
         return DwinSpec(self.kind, self.capacity, self.n_f, self.n_i,
                         self.window_ms, self.length,
                         sort_keys=self._sort_keys,
-                        skey_lane=self._skey_lane)
+                        skey_lane=self._skey_lane,
+                        telemetry=self.telemetry)
 
     def _ensure_carry(self):
         if self.carry is None:
@@ -525,6 +530,14 @@ class DeviceWindowProcessor(WindowProcessor):
         count = int(tail[0])
         self._fill_host = int(tail[1])
         self._exp_fill_host = int(tail[2])
+        if self.telemetry:
+            # summary row rides just before the tail (see _pack_egress):
+            # [fill gauge, evictions total, overflow total]
+            self.last_telemetry = buf[-2, :3].copy()
+            rt = getattr(self.app_ctx, "runtime", None)
+            holder = getattr(rt, "device_telemetry", None)
+            if holder is not None:
+                holder.update_window(self.definition.id, self.last_telemetry)
         rows = buf[:count]
         F = max(self.n_f, 1)
         rows_f = rows[:, 4:4 + F].view(np.float32)
@@ -557,6 +570,10 @@ class DeviceWindowProcessor(WindowProcessor):
     # ------------------------------------------------------------ emission
 
     def on_data(self, chunk: EventChunk):
+        from ..core.profiling import profiler
+        prof = profiler()
+        disp0 = prof.total_dispatches() if prof.enabled else 0
+        ticks0 = prof.total_scan_ticks() if prof.enabled else 0
         now = int(chunk.timestamps[-1])
         if self.kind in ("time", "delay", "timeLength", "session"):
             self.app_ctx.scheduler.notify_at(now + self.window_ms,
@@ -567,6 +584,22 @@ class DeviceWindowProcessor(WindowProcessor):
             work = self._dispatch_step(chunk, now, None)
             work["emit"] = ("slide", chunk, None, None)
         self._submit(work)
+        from ..core.flight import flight
+        fl = flight()
+        if fl.enabled:
+            rt = getattr(self.app_ctx, "runtime", None)
+            sid = self.definition.id
+            fl.record_block(
+                getattr(rt, "name", ""), stream=sid,
+                batch=len(chunk.timestamps),
+                dispatches=(prof.total_dispatches() - disp0
+                            if prof.enabled else 0),
+                scan_ticks=(prof.total_scan_ticks() - ticks0
+                            if prof.enabled else 0),
+                junction=(rt.junctions.get(sid) if rt is not None
+                          else None),
+                scheduler=self.app_ctx.scheduler,
+                telemetry=self.last_telemetry)
 
     # ------------------------------------------------------------ pipeline
 

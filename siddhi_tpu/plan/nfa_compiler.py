@@ -580,7 +580,8 @@ class CompiledPatternNFA:
                  parameterize: bool = False, query: Optional[Query] = None,
                  mesh: Any = "auto", prune: Optional[bool] = None,
                  batch_b: Optional[int] = None,
-                 donate: Optional[bool] = None):
+                 donate: Optional[bool] = None,
+                 telemetry: bool = False):
         """mesh: "auto" (default) shards the partition axis over all local
         devices when more than one exists (parallel/mesh.auto_mesh); a
         jax.sharding.Mesh pins an explicit mesh; None forces single-device.
@@ -602,7 +603,11 @@ class CompiledPatternNFA:
         default (None) therefore resolves per path: single-device engine
         steps stay undonated (they replay overflowing chunks from the
         pre-chunk carry), mesh steps donate unless mid-chain `every`
-        forces replayability (parallel/mesh.py round 5 semantics)."""
+        forces replayability (parallel/mesh.py round 5 semantics).
+
+        telemetry: @app:statistics(telemetry='true') — carry an int32
+        per-state telemetry leaf (occupancy, gate pass/fail, within
+        drops) read out through the fused egress slab."""
         app = (SiddhiCompiler.parse(app_string)
                if isinstance(app_string, str) else app_string)
         self.app = app
@@ -1010,9 +1015,11 @@ class CompiledPatternNFA:
             dead_start=self.seq_dead_start,
             n_last=tuple(n_last), idx_banks=tuple(idx_banks),
             lastk_banks=tuple(lastk_banks), m_src=tuple(m_src),
-            cond_free=tuple(cond_free), batch_b=self.batch_b)
+            cond_free=tuple(cond_free), batch_b=self.batch_b,
+            telemetry=bool(telemetry))
         self.has_absent = any(u.kind == "absent" for u in self.units)
         self.last_min_deadline: Optional[int] = None
+        self.last_telemetry = None   # [P, 3S+1] host int32 after retire
         from ..parallel.mesh import auto_mesh, round_up_partitions
         self.mesh = auto_mesh() if isinstance(mesh, str) and mesh == "auto" \
             else mesh
@@ -1729,21 +1736,27 @@ class CompiledPatternNFA:
         dl = self.carry.get("deadline") if self.has_absent else None
         buf = self._egress_jit(mask, caps, ts, enter, seq, dropped,
                                dl_st, dl, self._egress_cap)
+        # on-device telemetry rides the SAME slab/transfer as the match
+        # buffer — readout costs no extra D2H dispatch
+        telem = self.carry.get("telem") if self.spec.telemetry else None
         fuser = getattr(self, "egress_fuser", None)
         token = None
         if fuser is not None:
             # per-app fused egress (plan/pipeline.EgressFuser): the buffer
             # rides the app's per-ingest-block slab — ONE D2H per block
             # shared with every other device runtime, no per-buffer copy
-            token = fuser.register(self, [buf])
+            bufs = [buf] if telem is None else [buf, telem]
+            token = fuser.register(self, bufs)
         else:
             try:
                 buf.copy_to_host_async()
+                if telem is not None:
+                    telem.copy_to_host_async()
             except Exception:   # backends without async copy: retire blocks
                 pass
         return {"buf": buf, "fuse": token, "cap": self._egress_cap,
                 "outs": outs, "dropped": dropped, "dl_st": dl_st, "dl": dl,
-                "dl_base": self.base_ts, "tk": (T, K)}
+                "dl_base": self.base_ts, "tk": (T, K), "telem": telem}
 
     def egress_retire(self, handle):
         """Phase 2: block on the transfer, re-pack at a doubled cap if the
@@ -1754,9 +1767,14 @@ class CompiledPatternNFA:
         if token is not None:
             # the slab read (one per ingest block, all runtimes) is
             # accounted by the fuser under "egress.fuse"
-            buf = token.fetch()[0]
+            fetched = token.fetch()
+            buf = fetched[0]
+            if len(fetched) > 1:
+                self.last_telemetry = fetched[1]
         else:
             buf = np.asarray(handle["buf"])
+            if handle.get("telem") is not None:
+                self.last_telemetry = np.asarray(handle["telem"])
             from ..core.profiling import profiler
             profiler().record_d2h("nfa.egress_pack", buf.nbytes)
         count = int(buf[-1, 0])
@@ -2136,7 +2154,8 @@ class CompiledPatternBank:
     def __init__(self, apps: Sequence[str], n_partitions: int,
                  n_slots: int = 8, pattern_chunk: Optional[int] = None,
                  ring: int = 0, batch_b: Optional[int] = None,
-                 stack: Optional[bool] = None, replayable: bool = False):
+                 stack: Optional[bool] = None, replayable: bool = False,
+                 telemetry: bool = False):
         """stack: run all homogeneous pattern chunks as ONE jitted
         super-dispatch ([C, N, ...] stacked carry, vmap over the chunk
         axis — ops/nfa.build_super_bank_step) instead of C sequential
@@ -2159,7 +2178,8 @@ class CompiledPatternBank:
         # DistributedPatternBank, so the inner NFA stays single-device
         self.nfa = CompiledPatternNFA(apps[0], n_partitions=n_partitions,
                                       n_slots=n_slots, parameterize=True,
-                                      mesh=None, batch_b=batch_b)
+                                      mesh=None, batch_b=batch_b,
+                                      telemetry=telemetry)
         self.n_patterns = len(apps)
         self.n_partitions = n_partitions
         # top_k over the per-partition counts caps the ring at P

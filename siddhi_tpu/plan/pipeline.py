@@ -164,6 +164,7 @@ class _FuseGroup:
             if self._host is None and self._slab is not None:
                 self._host = np.asarray(self._slab)       # the ONE D2H
                 self.fuser.d2h_count += 1
+                self.fuser.last_slab_bytes = self._host.nbytes
                 from ..core.profiling import profiler
                 profiler().record_d2h("egress.fuse", self._host.nbytes)
             out: List[Any] = []
@@ -206,6 +207,10 @@ class EgressFuser:
         self._current = _FuseGroup(self)
         self.d2h_count = 0
         self.blocks = 0
+        #: size of the most recent fused slab read — surfaced in the
+        #: flight ring (planner._record_block) so a bundle shows the
+        #: egress volume of the blocks leading up to an incident
+        self.last_slab_bytes = 0
 
     def _rotate(self) -> None:
         grp = self._current

@@ -36,6 +36,35 @@ DEFAULT_SLOTS = 8
 GROW_START = 8          # initial keyed-lane capacity (doubles on demand)
 
 
+def _record_block(rt_obj, prof, disp0: int, ticks0: int, stream: str,
+                  batch: int, junction=None, telemetry=None) -> None:
+    """Per-ingest-block accounting shared by every device runtime: the
+    profiler's dispatches-per-block gauge (when profiling is on) plus a
+    flight-recorder ring record (core/flight.py, always-cheap)."""
+    from ..core.flight import flight
+    d = prof.total_dispatches() - disp0 if prof.enabled else 0
+    t = prof.total_scan_ticks() - ticks0 if prof.enabled else 0
+    if prof.enabled:
+        # the measured side of the consolidation claim: device launches
+        # this ingest block cost (the siddhi_app_dispatches_per_block
+        # gauge)
+        prof.record_app_block(rt_obj.app_name, d)
+    fl = flight()
+    if not fl.enabled:
+        return
+    app = getattr(rt_obj.qr, "app_runtime", None)
+    sched = getattr(app.app_ctx, "scheduler", None) if app is not None \
+        else None
+    if junction is None and app is not None:
+        junction = app.junctions.get(stream)
+    fuser = getattr(app, "_egress_fuser", None) if app is not None else None
+    extra = ({"egress_bytes": fuser.last_slab_bytes}
+             if fuser is not None and fuser.last_slab_bytes else None)
+    fl.record_block(rt_obj.app_name, stream=stream, batch=batch,
+                    dispatches=d, scan_ticks=t, junction=junction,
+                    scheduler=sched, telemetry=telemetry, extra=extra)
+
+
 def map_keys_to_lanes(key_lanes: Dict[Any, int], keys: List[Any],
                       capacity: int, grow_fn) -> np.ndarray:
     """Assign each key a stable lane index, growing the device slab (via
@@ -155,8 +184,10 @@ class DevicePatternRuntime:
         self.keyed = key_executors is not None
         self.key_executors = key_executors or {}
         capacity = GROW_START if self.keyed else 1
-        self.nfa = CompiledPatternNFA(app.app, n_partitions=capacity,
-                                      n_slots=n_slots, query=q)
+        self.nfa = CompiledPatternNFA(
+            app.app, n_partitions=capacity, n_slots=n_slots, query=q,
+            telemetry=bool(getattr(app.app_ctx, "telemetry_enabled",
+                                   False)))
         self.key_lanes: Dict[Any, int] = {}
         self.qr = qr
         self._dtype_for = dtype_for
@@ -211,6 +242,11 @@ class DevicePatternRuntime:
         # the app-wide slab — one D2H per ingest block across runtimes
         self.app_name = app.name
         self.nfa.egress_fuser = egress_fuser_for(app)
+        self._junctions = {sid: app.junction_of(sid)
+                           for sid in self.nfa.stream_codes}
+        # on-device telemetry sink (@app:statistics(telemetry='true')):
+        # per-state occupancy / gate rates mirrored on /metrics
+        self._telemetry_sink = getattr(app, "device_telemetry", None)
 
     # ------------------------------------------------------------ ingest
 
@@ -232,6 +268,7 @@ class DevicePatternRuntime:
             return
         prof = profiler()
         disp0 = prof.total_dispatches() if prof.enabled else 0
+        ticks0 = prof.total_scan_ticks() if prof.enabled else 0
         n = len(data)
         if self.keyed:
             ex = self.key_executors.get(stream_id)
@@ -299,12 +336,11 @@ class DevicePatternRuntime:
         # stream/StreamJunction.java:280-316)
         while len(self._inflight) > self.pipeline_depth:
             self._retire_one()
-        if prof.enabled:
-            # the measured side of the consolidation claim: device
-            # launches this ingest block cost (exported as the per-app
-            # siddhi_app_dispatches_per_block gauge)
-            prof.record_app_block(self.app_name,
-                                  prof.total_dispatches() - disp0)
+        tel = self.nfa.last_telemetry
+        _record_block(self, prof, disp0, ticks0, stream_id, n,
+                      junction=self._junctions.get(stream_id),
+                      telemetry=(tel.sum(axis=0) if tel is not None
+                                 else None))
 
     def _retire_one(self) -> None:
         """Block on the oldest in-flight chunk, handle slot-ring overflow
@@ -313,6 +349,12 @@ class DevicePatternRuntime:
         emit."""
         h = self._inflight.popleft()
         pids, ts, cols = self.nfa.retire_events(h)
+        if self._telemetry_sink is not None and \
+                self.nfa.last_telemetry is not None:
+            self._telemetry_sink.update_nfa(
+                self.qr.name, self.nfa.last_telemetry,
+                len(self.nfa.spec.units),
+                [u.kind for u in self.nfa.spec.units])
         dropped = self.nfa.last_dropped_total
         if dropped > self._dropped_seen and self.nfa.replayable:
             # slot overflow would LOSE matches (the oracle's pending lists
@@ -589,6 +631,7 @@ class DeviceWindowedAggRuntime(PipelinedDeviceIngest):
             return
         prof = profiler()
         disp0 = prof.total_dispatches() if prof.enabled else 0
+        ticks0 = prof.total_scan_ticks() if prof.enabled else 0
         keys = self.key_executor.keys(data)
         keep = np.asarray([k is not None for k in keys], bool)
         if not keep.all():
@@ -632,9 +675,7 @@ class DeviceWindowedAggRuntime(PipelinedDeviceIngest):
                     break
         self._submit({"outs": outs, "fuse": token, "data": data,
                       "lanes": lanes, "rows": rows})
-        if prof.enabled:
-            prof.record_app_block(self.app_name,
-                                  prof.total_dispatches() - disp0)
+        _record_block(self, prof, disp0, ticks0, stream_id, n)
 
     def _retire(self, work) -> None:
         from ..core.event import EventChunk
@@ -802,6 +843,7 @@ class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
             return
         prof = profiler()
         disp0 = prof.total_dispatches() if prof.enabled else 0
+        ticks0 = prof.total_scan_ticks() if prof.enabled else 0
         if self.keyed:
             keys = self.key_executor.keys(data)
             keep = np.asarray([k is not None for k in keys], bool)
@@ -819,9 +861,7 @@ class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
         if work is None:
             return
         self._submit(work)
-        if prof.enabled:
-            prof.record_app_block(self.app_name,
-                                  prof.total_dispatches() - disp0)
+        _record_block(self, prof, disp0, ticks0, stream_id, len(data))
 
     def _retire(self, work) -> None:
         from .gagg_compiler import GaggOverflow
@@ -1109,6 +1149,7 @@ class DeviceFilterRuntime(PipelinedDeviceIngest):
             return
         prof = profiler()
         disp0 = prof.total_dispatches() if prof.enabled else 0
+        ticks0 = prof.total_scan_ticks() if prof.enabled else 0
         n_pad = 1 << (n - 1).bit_length()
         cols = {}
         for a in self.numeric:
@@ -1141,9 +1182,7 @@ class DeviceFilterRuntime(PipelinedDeviceIngest):
                     break
         self._submit({"ok": ok, "outs": outs, "fuse": token,
                       "chunk": chunk, "n": n})
-        if prof.enabled:
-            prof.record_app_block(self.app_name,
-                                  prof.total_dispatches() - disp0)
+        _record_block(self, prof, disp0, ticks0, stream_id, n)
 
     def _retire(self, work) -> None:
         from ..core.event import TIMER, RESET, EventChunk

@@ -8,11 +8,15 @@ Extras beyond the reference surface (operationally useful for a TPU-backed
 deployment): list apps, push events into a stream, run store queries, and
 snapshot/restore — all JSON over stdlib http.server (zero dependencies).
 
-Observability surface (this PR): ``GET /metrics`` serves the
-Prometheus/OpenMetrics text exposition over every deployed app's
-StatisticsManager plus the process-global kernel profiler
+Observability surface: ``GET /metrics`` serves the Prometheus/
+OpenMetrics text exposition over every deployed app's StatisticsManager
+plus the process-global kernel profiler and the opt-in device telemetry
 (core/statistics.prometheus_text); ``GET /stats`` serves the same data
-as JSON.  Both are scrape-ready on the zero-dependency server.
+as JSON.  Flight-recorder endpoints: ``GET /incidents`` lists incident
+summaries, ``GET /incidents/{id}/bundle`` returns a full bundle,
+``POST /siddhi/apps/{app}/debug/bundle`` snapshots one on demand, and
+``GET /siddhi/apps/{app}/trace`` returns the Chrome trace-event JSON
+(rt.dump_trace parity).  All scrape-ready on the zero-dependency server.
 """
 from __future__ import annotations
 
@@ -118,6 +122,24 @@ class SiddhiService:
             rev = rt.persist()
             return h._send(200, {"revision": rev})
         if len(parts) == 5 and parts[:2] == ["siddhi", "apps"] and \
+                parts[3] == "debug" and parts[4] == "bundle":
+            rt = self.manager.get_siddhi_app_runtime(parts[2])
+            if rt is None:
+                return h._send(404, {"error": f"no app '{parts[2]}'"})
+            from ..core.flight import flight
+            fl = flight()
+            if not fl.enabled:
+                return h._send(409, {"error": "flight recorder disabled "
+                                              "(SIDDHI_TPU_FLIGHT=0)"})
+            body = h._body()
+            opts = json.loads(body) if body else {}
+            bundle = fl.emit("on_demand", app=rt.name,
+                             detail={"requested_by": "rest",
+                                     "note": opts.get("note", "")},
+                             runtime=rt)
+            return h._send(200, {"id": bundle["id"],
+                                 "kind": bundle["kind"]})
+        if len(parts) == 5 and parts[:2] == ["siddhi", "apps"] and \
                 parts[3] == "errors" and parts[4] in ("replay", "purge"):
             rt = self.manager.get_siddhi_app_runtime(parts[2])
             if rt is None:
@@ -163,6 +185,26 @@ class SiddhiService:
             return h._send(200, {"errors": [
                 e.summary() for e in rt.error_store.list(app_name=rt.name)],
                 "store": type(rt.error_store).__name__})
+        if len(parts) == 4 and parts[:2] == ["siddhi", "apps"] and \
+                parts[3] == "trace":
+            # Chrome trace-event JSON (Perfetto-loadable), parity with
+            # rt.dump_trace but without touching the filesystem
+            rt = self.manager.get_siddhi_app_runtime(parts[2])
+            if rt is None:
+                return h._send(404, {"error": f"no app '{parts[2]}'"})
+            from ..core.tracing import tracer
+            return h._send(200, tracer().to_dict())
+        if parts == ["incidents"]:
+            from ..core.flight import flight
+            return h._send(200, {"incidents": flight().incidents()})
+        if len(parts) == 3 and parts[0] == "incidents" and \
+                parts[2] == "bundle":
+            from ..core.flight import flight
+            bundle = flight().bundle(parts[1])
+            if bundle is None:
+                return h._send(404, {"error": f"no bundle '{parts[1]}' "
+                                              "(aged out or unknown)"})
+            return h._send(200, bundle)
         h._send(404, {"error": f"no route {h.path}"})
 
     # ------------------------------------------------------------ health
@@ -217,8 +259,11 @@ class SiddhiService:
         ingest = [rt.ingest_metrics
                   for rt in self.manager.runtimes.values()
                   if getattr(rt, "ingest_metrics", None) is not None]
+        telemetry = [rt.device_telemetry
+                     for rt in self.manager.runtimes.values()
+                     if getattr(rt, "device_telemetry", None) is not None]
         body = prometheus_text(managers, profiler(), resilience,
-                               ingest).encode()
+                               ingest, telemetry).encode()
         h.send_response(200)
         h.send_header("Content-Type",
                       "text/plain; version=0.0.4; charset=utf-8")

@@ -60,6 +60,16 @@ def test_bench_smoke_runs_clean():
     prof = out["kernel_profile"]
     assert prof["nfa.bank_step"]["scan_ticks"] > 0
     assert prof["nfa.bank_step"]["dispatch_count"] > 0
+    # flight recorder + device telemetry (round 10): ring populated by
+    # the smoke's own ingest, on-demand bundle round-tripped through
+    # REST, and the always-on recorder's per-block overhead bounded
+    # (asserted < 5% inside the smoke itself)
+    fsm = out["flight_smoke"]
+    assert fsm["ring_blocks"] > 0
+    assert fsm["bundle_id"].startswith("inc-")
+    assert fsm["bundle_ring_blocks"] > 0
+    assert fsm["telemetry_gate_pass"] > 0
+    assert 0.0 <= fsm["overhead_pct"] < 5.0
 
 
 def test_bench_skips_on_unreachable_backend():

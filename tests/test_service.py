@@ -138,3 +138,76 @@ def test_error_endpoints_409_without_store():
         assert out == {"errors": [], "store": None}
     finally:
         svc.stop()
+
+
+# ------------------------------------------------- exposition contract
+
+STATS_APP = """
+@app:name('expoapp')
+@app:statistics(reporter='console', interval='300', telemetry='true')
+define stream S (sym string, price float);
+@info(name='q')
+from every e1=S[price > 10.0] -> e2=S[price > e1.price]
+select e1.price as p1, e2.price as p2 insert into Out;
+"""
+
+
+def test_metrics_exposition_is_prometheus_clean():
+    """/metrics contract: the version=0.0.4 text content type, every
+    emitted sample series covered by exactly one # HELP/# TYPE pair
+    (PR 6-9 added series faster than the header table — kernel
+    scan_ticks/live_bytes/batch_b had drifted), headers before samples."""
+    import numpy as np
+    svc = SiddhiService(port=0).start()
+    base = f"http://127.0.0.1:{svc.port}"
+    try:
+        _req("POST", f"{base}/siddhi/artifact/deploy", STATS_APP)
+        rng = np.random.default_rng(0)
+        _req("POST", f"{base}/siddhi/apps/expoapp/streams/S",
+             [{"data": ["A", float(rng.uniform(5, 30))]}
+              for _ in range(25)])
+        svc.manager.get_siddhi_app_runtime("expoapp").flush()
+        with urllib.request.urlopen(f"{base}/metrics") as r:
+            ctype = r.headers.get("Content-Type", "")
+            text = r.read().decode()
+    finally:
+        svc.stop()
+
+    assert ctype.startswith("text/plain; version=0.0.4")
+
+    lines = text.splitlines()
+    helps, types = {}, {}
+    first_sample_of = {}
+    for i, ln in enumerate(lines):
+        if ln.startswith("# HELP "):
+            name = ln.split()[2]
+            assert name not in helps, f"duplicate HELP for {name}"
+            helps[name] = i
+        elif ln.startswith("# TYPE "):
+            name = ln.split()[2]
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = i
+        elif ln:
+            s = ln.split("{")[0].split(" ")[0]
+            first_sample_of.setdefault(s, i)
+    assert set(helps) == set(types)
+
+    def family(series):
+        for suf in ("_bucket", "_sum", "_count"):
+            if series.endswith(suf) and series[: -len(suf)] in helps:
+                return series[: -len(suf)]
+        return series
+
+    for s, i in first_sample_of.items():
+        fam = family(s)
+        assert fam in helps, f"series {s} has no # HELP/# TYPE header"
+        assert helps[fam] < i and types[fam] < i, \
+            f"header for {s} appears after its first sample"
+
+    # the drifted kernel series and the new telemetry series are covered
+    for name in ("siddhi_kernel_scan_ticks_total",
+                 "siddhi_kernel_live_bytes", "siddhi_kernel_batch_b",
+                 "siddhi_nfa_state_occupancy",
+                 "siddhi_nfa_gate_pass_total"):
+        assert name in helps, f"missing header for {name}"
+        assert name in first_sample_of, f"no samples for {name}"

@@ -1,0 +1,272 @@
+"""On-device NFA/window state telemetry
+(@app:statistics(telemetry='true'), observability PR).
+
+Contract: the telemetry leaf is an int32 side-channel accumulated from
+masks the transition logic ALREADY computes (ops/nfa.py, ops/dwin.py) —
+matches, payloads and dropped counters must be BIT-IDENTICAL with
+telemetry on vs off, for every batch_b, for stacked pattern banks and on
+the conftest-forced virtual 8-device CPU mesh.  The static cost model
+stays byte-exact with the telem leaf counted (analysis/cost_model.py),
+and the series surface on /metrics, rt.statistics and the flight ring.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from siddhi_tpu import QueryCallback, SiddhiManager, StreamCallback  # noqa: E402
+from siddhi_tpu.analysis.cost_model import nfa_state_bytes  # noqa: E402
+from siddhi_tpu.analysis.plan_ir import automaton_ir_from_nfa  # noqa: E402
+from siddhi_tpu.core.statistics import (DeviceTelemetry,  # noqa: E402
+                                        prometheus_text)
+from siddhi_tpu.ops.nfa import make_carry  # noqa: E402
+from siddhi_tpu.plan.nfa_compiler import (CompiledPatternBank,  # noqa: E402
+                                          CompiledPatternNFA)
+
+STREAM = "define stream S (price float, kind int);\n"
+
+SHAPES = {
+    "every_within":
+        "from every e1=S[kind == 0] -> "
+        "e2=S[kind == 1 and price > e1.price] within 3 sec "
+        "select e1.price as p1, e2.price as p2 insert into Out;",
+    "count":
+        "from every e1=S[kind == 0] -> e2=S[kind == 1]<1:3> -> "
+        "e3=S[kind == 0] "
+        "select e1.price as p1, e3.price as p3 insert into Out;",
+}
+
+
+def _feed(n=200, seed=0, parts=2):
+    rng = np.random.default_rng(seed)
+    pids = rng.integers(0, parts, n).astype(np.int64)
+    cols = {"price": rng.uniform(0, 100, n).astype(np.float32),
+            "kind": rng.integers(0, 3, n).astype(np.float32)}
+    ts = 1_000_000 + np.cumsum(rng.integers(0, 900, n)).astype(np.int64)
+    return pids, cols, ts
+
+
+def _run(nfa, feed):
+    pids, cols, ts = feed
+    out = list(nfa.process_events(pids, cols, ts))
+    return out, int(nfa.last_dropped_total)
+
+
+def _reset(nfa):
+    nfa.carry = nfa._place_carry(make_carry(nfa.spec, nfa.n_partitions))
+    nfa.base_ts = None
+
+
+# ------------------------------------------------------------ bit identity
+
+@pytest.mark.parametrize("shape", sorted(SHAPES))
+@pytest.mark.parametrize("B", [1, 4])
+def test_matches_bit_identical_with_telemetry(shape, B):
+    """Randomized feeds: telemetry-on produces the exact same matches,
+    payloads and dropped counters as telemetry-off, B in {1, 4}."""
+    app = STREAM + SHAPES[shape]
+    plain = CompiledPatternNFA(app, n_partitions=2, n_slots=4, mesh=None,
+                               batch_b=B)
+    telem = CompiledPatternNFA(app, n_partitions=2, n_slots=4, mesh=None,
+                               batch_b=B, telemetry=True)
+    assert not plain.spec.telemetry and telem.spec.telemetry
+    assert "telem" not in plain.carry and "telem" in telem.carry
+    total = 0
+    for seed in (0, 1):
+        feed = _feed(seed=seed)
+        want, wdrop = _run(plain, feed)
+        got, gdrop = _run(telem, feed)
+        assert got == want, f"{shape} B={B} seed={seed}: diverged"
+        assert gdrop == wdrop
+        total += len(want)
+        _reset(plain)
+        _reset(telem)
+    assert total > 0, f"{shape}: degenerate cell (0 matches)"
+
+
+def test_stacked_bank_bit_identical_with_telemetry():
+    """Stacked C>1 pattern-bank super-dispatch: the telem leaf rides the
+    generic [C, N, P, ...] broadcast without perturbing counts/rings."""
+    P = 8
+    stream = "define stream S (partition int, price float, kind int);\n"
+    apps = [stream +
+            f"from every e1=S[kind == 0 and price > {thr}] -> "
+            "e2=S[kind == 1 and price > e1.price] within 9 sec "
+            "select e1.price as p1, e2.price as p2 insert into Out;"
+            for thr in (10.0, 40.0, 60.0, 90.0)]
+
+    def bank(telemetry):
+        b = CompiledPatternBank(apps, n_partitions=P, n_slots=4,
+                                pattern_chunk=2, ring=4, stack=True,
+                                telemetry=telemetry)
+        b.base_ts = 1_000_000
+        return b
+
+    def feed(b, seed):
+        from siddhi_tpu.ops.nfa import pack_blocks
+        rng = np.random.default_rng(seed)
+        counts = np.zeros(b.n_patterns, np.int64)
+        rows = []
+        t0 = 1_000_000
+        for _ in range(3):
+            n = P * 10
+            pids = np.tile(np.arange(P, dtype=np.int64), 10)
+            j = np.repeat(np.arange(10, dtype=np.int64), P)
+            ts = t0 + j * 1_000 + pids * (1_000 // P)
+            cols = {"partition": pids.astype(np.float32),
+                    "price": rng.uniform(0, 100, n).astype(np.float32),
+                    "kind": rng.integers(0, 2, n).astype(np.float32)}
+            block = pack_blocks(pids, cols, ts, np.zeros(n, np.int32), P,
+                                base_ts=1_000_000)
+            t0 += 10 * 1_000
+            out = b.process_block(block)
+            counts += np.asarray(out[0], np.int64)
+            dec = b.decode_ring(*out[1:])
+            rows.append(sorted(zip(*(np.asarray(v).tolist()
+                                     for v in dec.values()))))
+        return counts, rows, b.total_dropped()
+
+    plain, telem = bank(False), bank(True)
+    assert telem.stacked and telem.n_chunks == 2
+    assert "telem" in telem.nfa.carry
+    wc, wr, wd = feed(plain, seed=3)
+    gc, gr, gd = feed(telem, seed=3)
+    assert (gc == wc).all() and gr == wr and gd == wd
+    assert wc.sum() > 0
+
+
+def test_mesh_engine_bit_identical_with_telemetry():
+    """The virtual 8-device mesh path: the telem leaf shards on its
+    leading partition dim like every other carry leaf (parallel/mesh
+    tree-maps lead_axis_sharding over make_carry)."""
+    app = STREAM + SHAPES["every_within"]
+    telem = CompiledPatternNFA(app, n_partitions=8, telemetry=True)
+    plain = CompiledPatternNFA(app, n_partitions=8)
+    assert telem.mesh is not None and telem.mesh.devices.size == 8
+    feed = _feed(n=280, parts=8, seed=5)
+    got, _ = _run(telem, feed)
+    want, _ = _run(plain, feed)
+    assert got == want and len(want) > 0
+    tel = telem.last_telemetry
+    assert tel is not None and tel.shape == (8, 3 * 2 + 1)
+
+
+# ------------------------------------------------------------ semantics
+
+def test_telemetry_counters_are_meaningful():
+    """occupancy counts live slots per state, gate passes at the accept
+    gate equal completed matches for a 2-state pattern, and within
+    expiry shows up in the drops counter."""
+    app = STREAM + SHAPES["every_within"]
+    nfa = CompiledPatternNFA(app, n_partitions=2, n_slots=4, mesh=None,
+                             telemetry=True)
+    feed = _feed(n=200, seed=0)
+    out, _ = _run(nfa, feed)
+    tel = np.asarray(nfa.last_telemetry).sum(axis=0)
+    S = len(nfa.spec.units)
+    occ, gate_pass = tel[:S], tel[S:2 * S]
+    within_drops = int(tel[3 * S])
+    assert gate_pass[1] == len(out) > 0     # e2 gate fires exactly per match
+    assert (occ >= 0).all() and occ.sum() <= 2 * 4
+    assert within_drops > 0                 # 3 s window over a 200-event feed
+
+
+# ------------------------------------------------------- cost model / IR
+
+def test_cost_model_byte_exact_with_telemetry():
+    app = STREAM + ("from every e1=S[kind == 0] -> "
+                    "e2=S[kind == 1 and price > e1.price] within 10 sec "
+                    "select e1.price as p1 insert into Out;")
+    nfa = CompiledPatternNFA(app, n_partitions=3, mesh=None, telemetry=True)
+    ir = automaton_ir_from_nfa(nfa, "q")
+    assert ir.telemetry
+    predicted = nfa_state_bytes(ir)
+    assert predicted["telem"] == 3 * (3 * len(ir.states) + 1) * 4
+    actual = sum(int(np.asarray(v).nbytes) for v in nfa.carry.values())
+    assert sum(predicted.values()) == actual
+    # defaults stay off — goldens and PC001 accounting unchanged
+    off = automaton_ir_from_nfa(
+        CompiledPatternNFA(app, n_partitions=3, mesh=None), "q")
+    assert not off.telemetry and "telem" not in nfa_state_bytes(off)
+
+
+def test_plan_ir_dump_carries_telem_flag():
+    from siddhi_tpu.analysis.plan_ir import PlanIR
+    app = STREAM + SHAPES["every_within"]
+    nfa = CompiledPatternNFA(app, n_partitions=2, mesh=None, telemetry=True)
+    plan = PlanIR(app_name="t",
+                  automata=[automaton_ir_from_nfa(nfa, "q")])
+    dump = plan.dump()
+    assert "telem" in dump.split("flags=[", 1)[1].split("]", 1)[0]
+    assert plan.as_dict()["automata"][0]["telemetry"] is True
+
+
+# ------------------------------------------------------- runtime surface
+
+def test_runtime_snapshot_metrics_and_windows():
+    """Full engine path: @app:statistics(telemetry='true') populates
+    rt.statistics['telemetry'], the siddhi_nfa_*/siddhi_dwin_* series
+    and the flight ring; window fill/eviction counters are exact."""
+    from siddhi_tpu.core.flight import flight
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:statistics(reporter='console', interval='300',
+                        telemetry='true')
+        define stream S (sym string, price float);
+        define stream cse (symbol string, price float, volume long);
+        @info(name='p')
+        from every e1=S[price > 10.0] -> e2=S[price > e1.price]
+        select e1.price as p1, e2.price as p2 insert into Out;
+        @info(name='w') from cse#window.length(5)
+        select symbol, price, volume insert all events into wout;
+    """)
+    assert rt.app_ctx.telemetry_enabled and rt.device_telemetry is not None
+    got = []
+    rt.add_callback("Out", StreamCallback(lambda evs: got.extend(evs)))
+    rt.add_callback("w", QueryCallback(lambda *a: None))
+    rt.start()
+    h = rt.get_input_handler("S")
+    rng = np.random.default_rng(0)
+    for _ in range(40):
+        h.send(["A", float(rng.uniform(5, 30))])
+    n = 30
+    rt.get_input_handler("cse").send_batch(
+        {"symbol": np.asarray(["A"] * n, object),
+         "price": rng.uniform(0, 10, n).astype(np.float32),
+         "volume": np.arange(n, dtype=np.int64)},
+        timestamps=1_000_000 + np.arange(n, dtype=np.int64) * 250)
+    rt.flush()
+    snap = rt.statistics["telemetry"]
+    text = prometheus_text([rt.app_ctx.statistics_manager],
+                           telemetry=[rt.device_telemetry])
+    ring = flight().ring()
+    rt.shutdown()
+
+    q = snap["nfa"]["p"]
+    assert sum(q["gate_pass"]) == len(got) > 0
+    assert len(q["occupancy"]) == 2
+    w = snap["windows"]["cse"]
+    assert w["fill"] == 5 and w["evictions"] == n - 5 and w["overflow"] == 0
+
+    assert 'siddhi_nfa_state_occupancy{' in text
+    assert "# TYPE siddhi_nfa_gate_pass_total counter" in text
+    assert 'siddhi_dwin_ring_fill{' in text and '",window="cse"' in text
+    # the flight ring saw per-block telemetry rows from the pattern path
+    assert any("telemetry" in r for r in ring if r.get("stream") == "S")
+
+
+def test_device_telemetry_holder_is_standalone():
+    dt = DeviceTelemetry("a")
+    dt.update_nfa("q", np.arange(7, dtype=np.int32).reshape(1, 7), 2,
+                  ["simple", "simple"])
+    dt.update_window("w", np.asarray([3, 9, 1], np.int32))
+    snap = dt.snapshot()
+    assert snap["nfa"]["q"]["within_drops"] == 6
+    assert snap["windows"]["w"] == {"fill": 3, "evictions": 9,
+                                    "overflow": 1}
+    lines = dt.prometheus_lines()
+    assert any(ln.startswith("siddhi_nfa_state_occupancy") for ln in lines)
+    assert any(ln.startswith("siddhi_dwin_overflow_total") for ln in lines)

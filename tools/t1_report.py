@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Tier-1 timing report: turn a pytest log into a per-file table.
+
+The tier-1 suite runs under a wall-clock budget, so knowing WHERE the
+seconds go is the difference between "the suite is slow" and "one file
+regressed 3x".  This parses the output of
+
+    pytest tests/ -q -m 'not slow' --durations=0 ... 2>&1 | tee t1.log
+
+(the ``--durations=0`` section lists every test phase as
+``<sec>s <call|setup|teardown> <file>::<test>``) and emits
+
+  * a per-file timing table on stdout (seconds by phase, test count),
+  * optionally a bench-style JSON artifact (``-o T1_rNN.json``) so
+    rounds can be diffed the same way BENCH_rNN.json rounds are.
+
+Also extracted: the pass/fail/skip/error tallies, total wall time, and
+the DOTS count (progress characters), which is the cross-round
+comparison number the tier-1 budget workflow uses.
+
+Usage:
+    python tools/t1_report.py /tmp/_t1.log [-o T1_r10.json] [--top 25]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from collections import defaultdict
+
+DUR_RE = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+"
+    r"([\w./\\-]+\.py)::(\S+)")
+#: "==== 857 passed, 3 skipped in 612.33s ====" (plain form under -q:
+#: "857 passed, 3 skipped in 612.33s (0:10:12)")
+SUMMARY_RE = re.compile(
+    r"^(?:=+ )?((?:\d+ [a-z]+,? ?)+) in (\d+(?:\.\d+)?)s")
+TALLY_RE = re.compile(r"(\d+) (passed|failed|skipped|errors?|xfailed|"
+                      r"xpassed|warnings?|deselected)")
+#: pytest -q progress lines: dots/letters, optionally ending "[ 37%]"
+DOTS_RE = re.compile(r"^[.FEsx]+( *\[ *\d+%\])?$")
+
+
+def parse_log(lines):
+    per_file = defaultdict(lambda: {"call_s": 0.0, "setup_s": 0.0,
+                                    "teardown_s": 0.0, "tests": set()})
+    tallies, wall_s, dots = {}, None, 0
+    for line in lines:
+        line = line.rstrip("\n")
+        m = DUR_RE.match(line)
+        if m:
+            sec, phase, path, test = m.groups()
+            rec = per_file[path]
+            rec[f"{phase}_s"] += float(sec)
+            rec["tests"].add(test.split("[")[0])
+            continue
+        m = DOTS_RE.match(line)
+        if m:
+            dots += line.split("[")[0].count(".")
+            continue
+        m = SUMMARY_RE.search(line)
+        if m:
+            # a concatenation of several pytest runs (the 870 s budget
+            # forces the suite into slices) sums naturally
+            wall_s = round((wall_s or 0.0) + float(m.group(2)), 2)
+            for n, what in TALLY_RE.findall(m.group(1)):
+                key = what.rstrip("s") if what != "passed" else what
+                tallies[key] = tallies.get(key, 0) + int(n)
+    files = {}
+    for path, rec in sorted(per_file.items()):
+        total = rec["call_s"] + rec["setup_s"] + rec["teardown_s"]
+        files[path] = {
+            "total_s": round(total, 2),
+            "call_s": round(rec["call_s"], 2),
+            "setup_s": round(rec["setup_s"], 2),
+            "teardown_s": round(rec["teardown_s"], 2),
+            "n_tests": len(rec["tests"]),
+        }
+    return {"files": files, "tallies": tallies, "wall_s": wall_s,
+            "dots_passed": dots,
+            "timed_s": round(sum(f["total_s"] for f in files.values()), 2)}
+
+
+def render_table(report, top=None):
+    files = sorted(report["files"].items(),
+                   key=lambda kv: -kv[1]["total_s"])
+    if top:
+        files = files[:top]
+    w = max([len(p) for p, _ in files] or [4])
+    out = [f"{'file':<{w}}  {'total':>8}  {'call':>8}  {'setup':>8}  "
+           f"{'teardn':>8}  {'tests':>5}"]
+    out.append("-" * len(out[0]))
+    for path, f in files:
+        out.append(f"{path:<{w}}  {f['total_s']:>7.2f}s  "
+                   f"{f['call_s']:>7.2f}s  {f['setup_s']:>7.2f}s  "
+                   f"{f['teardown_s']:>7.2f}s  {f['n_tests']:>5}")
+    out.append("-" * len(out[1]))
+    t = report["tallies"]
+    out.append(f"{'TOTAL':<{w}}  {report['timed_s']:>7.2f}s   "
+               f"wall={report['wall_s']}s  dots={report['dots_passed']}  "
+               + " ".join(f"{k}={v}" for k, v in sorted(t.items())))
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("log", help="pytest log (run with --durations=0)")
+    ap.add_argument("-o", "--out", help="write bench-style JSON artifact")
+    ap.add_argument("--top", type=int, default=None,
+                    help="only show the N slowest files in the table")
+    args = ap.parse_args(argv)
+    with open(args.log, errors="replace") as f:
+        report = parse_log(f)
+    if not report["files"]:
+        sys.stderr.write("no --durations entries found in the log — "
+                         "run pytest with --durations=0\n")
+    print(render_table(report, top=args.top))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
+        sys.stderr.write(f"wrote {args.out}\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
