@@ -642,6 +642,7 @@ def bench_engine():
     columnar receive_chunk API."""
     import gc
     from siddhi_tpu import SiddhiManager, StreamCallback
+    from siddhi_tpu.core.profiling import rim_stats
 
     N_KEYS, CHUNK, CHUNKS = 1024, 65_536, 8
     APP = f"""@app:playback
@@ -687,6 +688,7 @@ end;
         # product claim (VERDICT r4 weak #2)
         rates = []
         base = 1_000_000 + CHUNK * 2
+        rim0 = rim_stats().events_materialized
         for rep in range(repeats):
             t0 = time.perf_counter()
             for ci in range(CHUNKS):
@@ -694,20 +696,28 @@ end;
                 h.send_batch(cols, timestamps=ts)
             rt.flush()                              # all matches delivered
             rates.append(CHUNK * CHUNKS / (time.perf_counter() - t0))
+        rim_delta = rim_stats().events_materialized - rim0
         rt.shutdown()
         gc.collect()
         return (float(np.median(rates)), float(np.max(rates)),
-                matched[0])
+                matched[0], int(rim_delta))
 
-    rate_ev, best_ev, m_ev = run(columnar=False)
-    rate_col, best_col, m_col = run(columnar=True)
+    rate_ev, best_ev, m_ev, rim_ev = run(columnar=False)
+    rate_col, best_col, m_col, rim_col = run(columnar=True)
     assert m_ev == m_col, (m_ev, m_col)
+    # the columnar engine path is the round-11 zero-copy host rim: a
+    # single materialized Event here means some hop silently fell back
+    # to the per-event dict path
+    assert rim_col == 0, \
+        f"columnar engine path materialized {rim_col} Event objects"
     return {"engine_events_per_sec": rate_ev,
             "engine_events_per_sec_best": best_ev,
             "engine_columnar_events_per_sec": rate_col,
             "engine_columnar_events_per_sec_best": best_col,
             "engine_repeats": ENGINE_REPEATS,
             "engine_matches_delivered": m_ev,
+            "engine_rim_materialized": rim_ev,
+            "engine_columnar_rim_materialized": rim_col,
             "engine_keys": N_KEYS, "engine_chunk": CHUNK,
             "engine_chunks": CHUNKS}
 
@@ -1061,6 +1071,74 @@ def bench_smoke():
     assert got[0] > 0, "smoke engine phase delivered no matches"
     res["engine_matches_delivered"] = got[0]
 
+    # ---- host rim (round 11): a full columnar ingest -> NFA match ->
+    # inMemory-sink run must materialize ZERO per-event Event objects
+    # (rim_stats counts every EventChunk.to_events() row), while the
+    # legacy per-event callback run over the same feed must still get
+    # real Events with identical row counts — both assertions are real
+    from siddhi_tpu.core.profiling import rim_stats
+    from siddhi_tpu.core.source_sink import InMemoryBroker
+
+    RIM_APP = (
+        "@app:playback define stream S (sym string, price float, "
+        "kind int); "
+        "@sink(type='inMemory', topic='bench_rim', "
+        "@map(type='passThrough')) "
+        "define stream Out (p1 float, p2 float); "
+        "partition with (sym of S) begin @info(name='q') "
+        "from every e1=S[kind == 0] -> e2=S[kind == 1 and price > "
+        "e1.price] within 40 sec "
+        "select e1.price as p1, e2.price as p2 insert into Out; end;")
+
+    def _rim_run(legacy):
+        m4 = SiddhiManager()
+        rt4 = m4.create_siddhi_app_runtime(RIM_APP)
+        sink_rows, cb_rows = [0], [0]
+
+        class _Sub:
+            topic = "bench_rim"
+
+            def on_message(self, payload):
+                sink_rows[0] += len(payload)
+
+        sub = _Sub()
+        InMemoryBroker.subscribe(sub)
+        if legacy:
+            # iterating forces the lazy per-event shim to build real
+            # Event objects — len() alone stays on the fast path
+            rt4.add_callback("Out", StreamCallback(
+                lambda evs: cb_rows.__setitem__(
+                    0, cb_rows[0] + sum(1 for _ in evs))))
+        rt4.start()
+        n_r, keys_r = 2048, 16
+        rng_r = np.random.default_rng(3)
+        syms_r = np.asarray([f"k{i}" for i in range(keys_r)], object)
+        r0 = rim_stats().events_materialized
+        rt4.get_input_handler("S").send_batch(
+            {"sym": syms_r[np.arange(n_r) % keys_r],
+             "price": rng_r.uniform(0, 100, n_r).astype(np.float32),
+             "kind": rng_r.integers(0, 2, n_r).astype(np.int64)},
+            timestamps=1_000_000 + np.arange(n_r, dtype=np.int64) * 2)
+        rt4.flush()
+        delta = rim_stats().events_materialized - r0
+        rt4.shutdown()
+        InMemoryBroker.unsubscribe(sub)
+        return sink_rows[0], cb_rows[0], int(delta)
+
+    col_rows, _, col_mat = _rim_run(legacy=False)
+    leg_rows, leg_cb_rows, leg_mat = _rim_run(legacy=True)
+    assert col_rows > 0, "smoke rim phase delivered no sink rows"
+    assert col_mat == 0, \
+        f"smoke rim FAILED: columnar ingest->match->sink materialized " \
+        f"{col_mat} Events (the fast path must be zero-copy)"
+    assert leg_mat > 0, \
+        "smoke rim FAILED: legacy callback run materialized no Events"
+    assert leg_rows == col_rows and leg_cb_rows == col_rows, \
+        (col_rows, leg_rows, leg_cb_rows)
+    res["rim_smoke"] = {"sink_rows": col_rows,
+                        "columnar_materialized": col_mat,
+                        "legacy_materialized": leg_mat}
+
     # ---- NFA batch sweep, tiny shape: B in {1,2,4} must agree exactly
     res.update(bench_bsweep(n_patterns=SMOKE_PATTERNS, t_blk=SMOKE_T,
                             depth=2, trains=2, b_values=(1, 2, 4),
@@ -1382,6 +1460,15 @@ def main():
     if "--fail-on-dispatches" in sys.argv:
         fail_on_dispatches = int(
             sys.argv[sys.argv.index("--fail-on-dispatches") + 1])
+    # --fail-on-rim-materialize N: exit non-zero when the engine phase's
+    # columnar run materialized more than N per-event Event objects —
+    # the mechanical gate of the round-11 zero-copy host rim (a
+    # regression here means some hop of ingest -> match -> callback
+    # quietly fell back to the per-event dict path)
+    fail_on_rim = None
+    if "--fail-on-rim-materialize" in sys.argv:
+        fail_on_rim = int(
+            sys.argv[sys.argv.index("--fail-on-rim-materialize") + 1])
     if "--phase" in sys.argv:
         phase = sys.argv[sys.argv.index("--phase") + 1]
         if phase == "gate":
@@ -1455,6 +1542,13 @@ def main():
         "engine_path_columnar_events_per_sec": round(
             eng["engine_columnar_events_per_sec"], 1),
         "engine_path_matches_delivered": eng["engine_matches_delivered"],
+        # round-11 host rim: Event objects materialized during the timed
+        # engine repeats (columnar must be 0 — gated by
+        # --fail-on-rim-materialize)
+        "engine_path_rim_materialized": eng.get(
+            "engine_rim_materialized"),
+        "engine_path_columnar_rim_materialized": eng.get(
+            "engine_columnar_rim_materialized"),
         "engine_path_config": (f"{eng['engine_keys']} keys x "
                                f"{eng['engine_chunks']} chunks of "
                                f"{eng['engine_chunk']}, @Async pipelined, "
@@ -1544,6 +1638,17 @@ def main():
                 f"dispatches per block, exceeds --fail-on-dispatches "
                 f"{fail_on_dispatches} — dispatch consolidation "
                 f"regressed (see dispatch_sweep)\n")
+            sys.exit(1)
+    if fail_on_rim is not None:
+        rim_measured = eng.get("engine_columnar_rim_materialized")
+        if rim_measured is not None and rim_measured > fail_on_rim:
+            sys.stderr.write(
+                f"[bench] FAIL: columnar engine path materialized "
+                f"{rim_measured} Event objects, exceeds "
+                f"--fail-on-rim-materialize {fail_on_rim} — the "
+                f"zero-copy host rim regressed (a stage fell back to "
+                f"the per-event path; see "
+                f"engine_path_columnar_rim_materialized)\n")
             sys.exit(1)
 
 

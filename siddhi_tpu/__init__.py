@@ -35,13 +35,15 @@ from .core.tracing import Tracer, disable_tracing, enable_tracing, tracer
 from .core.snapshot import (FileSystemPersistenceStore,
                             InMemoryPersistenceStore, PersistenceStore)
 from .core.source_sink import InMemoryBroker
-from .core.stream import QueryCallback, StreamCallback
+from .core.stream import (ColumnarStreamCallback, QueryCallback,
+                          StreamCallback)
 from .query_api import (Annotation, AttrType, Expression, Query, Selector,
                         SiddhiApp, StreamDefinition)
 
 __all__ = [
     "SiddhiManager", "SiddhiAppRuntime", "SiddhiCompiler",
-    "Event", "EventChunk", "StreamCallback", "QueryCallback",
+    "Event", "EventChunk", "StreamCallback", "ColumnarStreamCallback",
+    "QueryCallback",
     "InMemoryBroker", "PersistenceStore", "InMemoryPersistenceStore",
     "FileSystemPersistenceStore",
     "SiddhiApp", "StreamDefinition", "Query", "Selector", "Expression",

@@ -14,12 +14,16 @@ directly (strings are dictionary-encoded before shipping to device).
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
+import time
+
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..query_api.definition import AbstractDefinition, AttrType
+from .profiling import rim_stats
+
+_RIM = rim_stats()
 
 # ComplexEvent.Type lanes
 CURRENT = 0
@@ -51,14 +55,30 @@ def zero_for(t: AttrType):
     return dtype_for(t)(0)
 
 
-@dataclass
 class Event:
-    """User-facing event (reference event/Event.java: timestamp + Object[])."""
-    timestamp: int
-    data: List[Any]
+    """User-facing event (reference event/Event.java: timestamp + Object[]).
+
+    A plain ``__slots__`` class rather than a dataclass: the legacy
+    per-event rim builds millions of these per second and the dataclass
+    constructor is ~1.6x slower.  Like the eq-without-frozen dataclass it
+    replaced, instances are unhashable."""
+
+    __slots__ = ("timestamp", "data")
+
+    def __init__(self, timestamp: int, data: List[Any]):
+        self.timestamp = timestamp
+        self.data = data
 
     def __iter__(self):
         return iter(self.data)
+
+    def __eq__(self, other):
+        return (other.__class__ is Event and
+                self.timestamp == other.timestamp and
+                self.data == other.data)
+
+    def __repr__(self):
+        return f"Event(timestamp={self.timestamp!r}, data={self.data!r})"
 
 
 class EventChunk:
@@ -147,15 +167,17 @@ class EventChunk:
 
     def to_events(self) -> List[Event]:
         # vectorized row materialization: ndarray.tolist() converts each
-        # column to python scalars in C (vs a _to_py call per cell) — the
-        # user-facing Event[] decode rides the callback hot path
+        # column to python scalars in C, and zip/map build the row lists
+        # and Event objects without per-row bytecode.  Every call feeds
+        # the always-on events-materialized counter — the columnar fast
+        # path is asserted to never reach here (bench --smoke rim phase)
         n = len(self)
         if n == 0:
             return []
+        _RIM.events_materialized += n
         ts_list = self.timestamps.tolist()
         col_lists = [self.columns[name].tolist() for name in self.names]
-        return [Event(ts, list(row))
-                for ts, row in zip(ts_list, zip(*col_lists))]
+        return list(map(Event, ts_list, map(list, zip(*col_lists))))
 
     # ------------------------------------------------------------ transforms
 
@@ -193,7 +215,13 @@ class EventChunk:
                           self.qualified, self.is_batch)
 
     def only(self, *event_types: int) -> "EventChunk":
-        m = np.isin(self.types, event_types)
+        m = (self.types == event_types[0] if len(event_types) == 1
+             else np.isin(self.types, event_types))
+        if m.all():
+            # all-match fast path: chunks are treated as immutable values
+            # by every processor, so the filter can return self — match
+            # slabs are all-CURRENT and this sits on the delivery rim
+            return self
         return self.mask(m)
 
     def copy(self) -> "EventChunk":
@@ -239,6 +267,42 @@ class EventChunk:
     def __repr__(self):
         return (f"EventChunk(n={len(self)}, names={self.names}, "
                 f"types={[TYPE_NAMES.get(int(t), t) for t in self.types[:8]]})")
+
+
+class LazyEvents:
+    """Deferred chunk→``Event[]`` materialization for cold paths.
+
+    The legacy ``StreamCallback``/``QueryCallback`` rim, the sink retry
+    queue and the error stores carry "the events" of a chunk; handing
+    them this wrapper instead of an eager ``to_events()`` keeps every
+    path that never touches an element zero-materialization — the Event
+    objects (and the counter increment) only exist on first element
+    access.  Sized/iterable/indexable like the list it stands in for."""
+
+    __slots__ = ("chunk", "_events")
+
+    def __init__(self, chunk: EventChunk):
+        self.chunk = chunk
+        self._events: Optional[List[Event]] = None
+
+    def materialize(self) -> List[Event]:
+        if self._events is None:
+            t0 = time.perf_counter_ns()
+            self._events = self.chunk.to_events()
+            _RIM.rim_ns += time.perf_counter_ns() - t0
+        return self._events
+
+    def __len__(self) -> int:
+        return len(self.chunk)
+
+    def __bool__(self) -> bool:
+        return len(self.chunk) > 0
+
+    def __iter__(self):
+        return iter(self.materialize())
+
+    def __getitem__(self, i):
+        return self.materialize()[i]
 
 
 def _sel_qualified(q, sel):

@@ -262,6 +262,12 @@ class KernelProfiler:
         with self._lock:
             return sum(st.scan_ticks for st in self.kernels.values())
 
+    def total_dispatch_ns(self) -> int:
+        """Sum of every kernel's host-side dispatch time — diffed per
+        ingest block for the flight ring's rim-vs-kernel ms split."""
+        with self._lock:
+            return sum(st.dispatch_ns for st in self.kernels.values())
+
     def record_app_block(self, app: str, dispatches: int):
         """One ingest block for `app` cost `dispatches` device launches."""
         if not self.enabled:
@@ -321,11 +327,57 @@ class KernelProfiler:
         return lines
 
 
+class RimStats:
+    """Always-on host-rim accounting (the measured side of the columnar
+    end-to-end claim).  Two process-global counters:
+
+      * ``events_materialized`` — per-event ``Event`` objects built from
+        columnar chunks (``EventChunk.to_events``).  Zero across a
+        columnar ingest→match→columnar-sink run IS the zero-copy
+        property; bench ``--smoke`` asserts it and
+        ``--fail-on-rim-materialize`` gates on it.
+      * ``rim_ns`` — host-rim wall time (ingress conversion/validation +
+        egress callback/sink delivery), so the flight ring can carry a
+        per-block rim-vs-kernel ms split.
+
+    Unlike ``KernelProfiler`` this is NOT gated on ``enabled`` — the
+    counters must hold even when @app:statistics is off (the smoke gate
+    runs unprofiled).  Increments are plain int adds under the GIL: the
+    materialization counter's contract is exact on single-threaded
+    paths and monotone everywhere, which is all the gates need."""
+
+    __slots__ = ("events_materialized", "rim_ns")
+
+    def __init__(self):
+        self.events_materialized = 0
+        self.rim_ns = 0
+
+    # hot paths add to the attributes directly; these are for readers
+    def snapshot(self) -> Dict[str, Any]:
+        return {"events_materialized": self.events_materialized,
+                "host_rim_seconds": self.rim_ns / 1e9}
+
+    def reset(self) -> None:
+        self.events_materialized = 0
+        self.rim_ns = 0
+
+    def prometheus_lines(self) -> List[str]:
+        return [
+            f"siddhi_events_materialized_total {self.events_materialized}",
+            f"siddhi_host_rim_seconds_total {self.rim_ns / 1e9:.9g}",
+        ]
+
+
 _GLOBAL = KernelProfiler()
+_RIM = RimStats()
 
 
 def profiler() -> KernelProfiler:
     return _GLOBAL
+
+
+def rim_stats() -> RimStats:
+    return _RIM
 
 
 def storm_snapshot() -> Dict[str, Any]:

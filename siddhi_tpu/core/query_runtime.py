@@ -257,8 +257,12 @@ class QueryRuntime:
         mode = engine_mode(app.app)
         if mode == "host":
             return None
+        # SiddhiQL's 'hoping' spelling maps onto the device hopping kernel
+        hname = h.name.lower()
+        if hname == "hoping":
+            hname = "hopping"
         kind = next((k for k in DEVICE_KINDS
-                     if k.lower() == h.name.lower()), None) \
+                     if k.lower() == hname), None) \
             if not h.namespace else None
         if kind is None:
             if mode == "device":

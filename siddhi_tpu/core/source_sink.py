@@ -18,11 +18,12 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+import numpy as np
 
 from ..query_api.annotation import Annotation, find_all, find_annotation
 from ..utils.errors import (ConnectionUnavailableError, MappingFailedError,
                             SiddhiAppCreationError)
-from .event import CURRENT, Event, EventChunk
+from .event import CURRENT, Event, EventChunk, LazyEvents, dtype_for
 from .resilience import (CircuitBreaker, RetryPolicy, SinkRetryWorker,
                          make_entry)
 
@@ -58,6 +59,21 @@ class InMemoryBroker:
 
 # ===================================================================== mappers
 
+def _vals_to_column(attr_type, vals) -> np.ndarray:
+    """Python value list → one attribute column, same dtype/None policy as
+    ``EventChunk.from_rows`` (object lane for string/object, None → 0)."""
+    dt = dtype_for(attr_type)
+    if dt is object:
+        arr = np.empty(len(vals), object)
+        for i, v in enumerate(vals):
+            arr[i] = v
+        return arr
+    try:
+        return np.asarray(vals, dtype=dt)
+    except (TypeError, ValueError):
+        return np.asarray([0 if v is None else v for v in vals], dtype=dt)
+
+
 class SourceMapper:
     """format → Event[] (reference stream/input/source/SourceMapper.java)."""
 
@@ -68,9 +84,19 @@ class SourceMapper:
     def map(self, obj) -> List[Event]:
         raise NotImplementedError
 
+    def map_batch(self, obj):
+        """Columnar counterpart of ``map``: payload → (timestamps,
+        name→column dict) for ``InputHandler.send_batch`` — no per-event
+        Event objects.  ``None`` means this mapper (or this payload shape)
+        has no columnar path and the caller falls back to ``map``."""
+        return None
+
 
 class PassThroughSourceMapper(SourceMapper):
     def map(self, obj) -> List[Event]:
+        if isinstance(obj, EventChunk):
+            # chunk published by a columnar sink looping back in-memory
+            return obj.only(CURRENT).to_events()
         if isinstance(obj, Event):
             return [obj]
         if isinstance(obj, (list, tuple)):
@@ -81,6 +107,21 @@ class PassThroughSourceMapper(SourceMapper):
                 return [Event(now, list(r)) for r in obj]   # batch of rows
             return [Event(now, list(obj))]
         raise MappingFailedError(f"passThrough cannot map {type(obj)}")
+
+    def map_batch(self, obj):
+        if not self.definition.attributes:
+            return None
+        if isinstance(obj, EventChunk):
+            # zero-copy re-ingest of a columnar sink's chunk payload
+            cur = obj.only(CURRENT)
+            return cur.timestamps, cur.columns
+        if isinstance(obj, (list, tuple)) and obj \
+                and isinstance(obj[0], (list, tuple)):
+            now = int(time.time() * 1000)
+            cols = {a.name: _vals_to_column(a.type, [r[j] for r in obj])
+                    for j, a in enumerate(self.definition.attributes)}
+            return np.full(len(obj), now, np.int64), cols
+        return None   # single event / row: per-event shim is fine
 
 
 class JsonSourceMapper(SourceMapper):
@@ -99,6 +140,26 @@ class JsonSourceMapper(SourceMapper):
                                           time.time() * 1000)), row))
         return out
 
+    def map_batch(self, obj):
+        """Vectorized decode: one json.loads for the whole payload, then
+        column-at-a-time extraction straight into numpy lanes."""
+        if not self.definition.attributes:
+            return None
+        data = json.loads(obj) if isinstance(obj, (str, bytes)) else obj
+        if isinstance(data, dict):
+            data = [data]
+        if not (isinstance(data, list) and data
+                and all(isinstance(it, dict) for it in data)):
+            return None
+        now = int(time.time() * 1000)
+        payloads = [it.get("event", it) for it in data]
+        ts = np.asarray([int(it.get("timestamp", now)) for it in data],
+                        np.int64)
+        cols = {a.name: _vals_to_column(a.type,
+                                        [p.get(a.name) for p in payloads])
+                for a in self.definition.attributes}
+        return ts, cols
+
 
 class SinkMapper:
     def __init__(self, definition, options: Dict[str, str]):
@@ -108,10 +169,19 @@ class SinkMapper:
     def map(self, events: List[Event]):
         raise NotImplementedError
 
+    def map_chunk(self, chunk: EventChunk):
+        """Chunk-level counterpart of ``map``: serialize a columnar batch
+        without materializing Event objects.  ``None`` means no chunk path
+        — the sink falls back to ``to_events()`` + ``map``."""
+        return None
+
 
 class PassThroughSinkMapper(SinkMapper):
     def map(self, events: List[Event]):
         return events
+
+    def map_chunk(self, chunk: EventChunk):
+        return chunk      # zero-copy: the chunk itself is the payload
 
 
 class JsonSinkMapper(SinkMapper):
@@ -120,6 +190,13 @@ class JsonSinkMapper(SinkMapper):
         return json.dumps([{"event": dict(zip(names, e.data)),
                             "timestamp": e.timestamp} for e in events])
 
+    def map_chunk(self, chunk: EventChunk):
+        names = [a.name for a in self.definition.attributes]
+        ts = chunk.timestamps.tolist()
+        cols = [chunk.columns[n].tolist() for n in names]
+        return json.dumps([{"event": dict(zip(names, row)), "timestamp": t}
+                           for t, row in zip(ts, zip(*cols))])
+
 
 class TextSinkMapper(SinkMapper):
     def map(self, events: List[Event]):
@@ -127,6 +204,13 @@ class TextSinkMapper(SinkMapper):
         return "\n".join(
             ", ".join(f"{n}:{v}" for n, v in zip(names, e.data))
             for e in events)
+
+    def map_chunk(self, chunk: EventChunk):
+        names = [a.name for a in self.definition.attributes]
+        cols = [chunk.columns[n].tolist() for n in names]
+        return "\n".join(
+            ", ".join(f"{n}:{v}" for n, v in zip(names, row))
+            for row in zip(*cols))
 
 
 SOURCE_MAPPERS = {"passthrough": PassThroughSourceMapper,
@@ -214,12 +298,26 @@ class Source:
             self.connected = False
 
     def deliver(self, obj):
+        handler = getattr(self, "handler", None)
+        if handler is None:
+            # columnar fast path: mapper decodes straight to columns and
+            # the batch enters the junction without Event materialization.
+            # An attached HA handler speaks Event[] — it keeps the shim.
+            try:
+                batch = self.mapper.map_batch(obj)
+            except MappingFailedError as e:
+                log.error("mapping failed on %s: %s", self.stream_def.id, e)
+                return
+            if batch is not None:
+                ts, cols = batch
+                if len(ts):
+                    self.input_handler.send_batch(cols, timestamps=ts)
+                return
         try:
             events = self.mapper.map(obj)
         except MappingFailedError as e:
             log.error("mapping failed on %s: %s", self.stream_def.id, e)
             return
-        handler = getattr(self, "handler", None)
         if handler is not None and events:
             events = handler.handle(events)
         if events:
@@ -355,27 +453,56 @@ class Sink:
     def publish(self, payload, event: Event):
         raise NotImplementedError
 
+    def publish_chunk(self, payload, chunk: EventChunk):
+        """Chunk-level publish counterpart.  The default adapts to the
+        per-event ``publish`` with a first-row representative Event —
+        options are static on this path, so the event argument is only a
+        template placeholder.  Batch-native transports override this."""
+        ts, row = chunk.row(0)
+        self.publish(payload, Event(ts, row))
+
     # junction-facing
     def receive_chunk(self, chunk: EventChunk):
-        events = chunk.only(CURRENT).to_events()
-        if not events:
+        cur = chunk.only(CURRENT)
+        if cur.is_empty:
+            # nothing publishable (all-EXPIRED/TIMER traffic): return
+            # before any Event materialization
             return
         if self._is_dynamic():
-            for e in events:
+            # per-event {{attr}} option templating forces the event path
+            for e in cur.to_events():
                 self._publish_with_retry(self.mapper.map([e]), e, [e])
-        else:
+            return
+        payload = self.mapper.map_chunk(cur)
+        if payload is None:     # mapper has no chunk path
+            events = cur.to_events()
             self._publish_with_retry(self.mapper.map(events), events[0],
                                      events)
+            return
+        self._publish_with_retry(payload, None, LazyEvents(cur), chunk=cur)
 
     def _is_dynamic(self) -> bool:
         return any(isinstance(v, str) and _TEMPLATE_RE.search(v)
                    for v in self.options.values())
 
-    def _publish_with_retry(self, payload, event, events=None):
+    def _publish_any(self, payload, target):
+        """Publish dispatch shared with the retry worker: ``target`` is
+        the representative Event (per-event path) or the EventChunk."""
+        if isinstance(target, EventChunk):
+            self.publish_chunk(payload, target)
+        else:
+            self.publish(payload, target)
+
+    def _publish_with_retry(self, payload, event, events=None, chunk=None):
         """First attempt inline; failures go to the off-thread retry
         worker so the junction never blocks on a sick endpoint."""
         handler = getattr(self, "handler", None)
         if handler is not None:
+            if event is None and chunk is not None:
+                # the HA SPI speaks per-event: hand it a first-row
+                # representative (cold: only when a handler is attached)
+                ts, row = chunk.row(0)
+                event = Event(ts, row)
             payload = handler.handle(payload, event)
             if payload is None:
                 return
@@ -385,8 +512,9 @@ class Sink:
             self._terminal_failure(events, ConnectionUnavailableError(
                 f"circuit open for sink on {self.stream_def.id}"))
             return
+        target = chunk if chunk is not None else event
         try:
-            self.publish(payload, event)
+            self._publish_any(payload, target)
             self.breaker.record_success()
         except ConnectionUnavailableError as e:
             self.connected = False
@@ -396,7 +524,7 @@ class Sink:
                 m.sink_publish_failed_total.inc(sink=self.stream_def.id)
             log.warning("sink publish failed on %s (queued for retry): %s",
                         self.stream_def.id, e)
-            if not self._retry_worker().submit(payload, event, events, e):
+            if not self._retry_worker().submit(payload, target, events, e):
                 self._terminal_failure(events, e)
 
     def _retry_worker(self) -> SinkRetryWorker:
@@ -411,7 +539,7 @@ class Sink:
 
                 self._retry_worker_inst = SinkRetryWorker(
                     name=sid,
-                    publish_fn=self.publish,
+                    publish_fn=self._publish_any,
                     policy=self.retry_policy,
                     breaker=self.breaker,
                     on_exhausted=lambda task: self._terminal_failure(
@@ -516,8 +644,22 @@ class DistributedSink(Sink):
             d.disconnect()
 
     def receive_chunk(self, chunk: EventChunk):
-        events = chunk.only(CURRENT).to_events()
-        for e in events:
+        cur = chunk.only(CURRENT)
+        if cur.is_empty:
+            return      # all-EXPIRED/TIMER: nothing to materialize
+        if isinstance(self.strategy, BroadcastStrategy) and self.destinations \
+                and not any(d._is_dynamic() for d in self.destinations):
+            # broadcast with static options fans the mapped chunk to every
+            # destination — destinations share the mapper config, so probe
+            # the chunk path once
+            payload = self.destinations[0].mapper.map_chunk(cur)
+            if payload is not None:
+                lazy = LazyEvents(cur)
+                for d in self.destinations:
+                    d._publish_with_retry(payload, None, lazy, chunk=cur)
+                return
+        # routed strategies pick destinations per event
+        for e in cur.to_events():
             for di in self.strategy.destinations_for(e):
                 self.destinations[di]._publish_with_retry(
                     self.destinations[di].mapper.map([e]), e)

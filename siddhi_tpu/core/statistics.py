@@ -508,6 +508,17 @@ _TYPES = [
      "gauge", "Device dispatches per ingest block (running average)"),
 ]
 
+#: Always-on host-rim accounting (core/profiling.RimStats): rendered on
+#: every /metrics scrape regardless of @app:statistics — the zero-copy
+#: columnar path is asserted against these counters.
+RIM_TYPES = [
+    ("siddhi_events_materialized_total",
+     "counter", "Per-event Event objects built from columnar chunks"),
+    ("siddhi_host_rim_seconds_total",
+     "counter", "Host-rim wall time (ingress conversion + egress "
+     "delivery)"),
+]
+
 #: Opt-in on-device state telemetry (@app:statistics(telemetry='true')).
 #: Accumulated in-kernel (ops/nfa.py, ops/dwin.py) and read out through
 #: the fused-egress slab — see DeviceTelemetry.
@@ -612,12 +623,14 @@ def prometheus_text(managers: List[StatisticsManager],
     holders.  Every series family gets its # HELP/# TYPE header exactly
     once, before any samples."""
     from .overload import INGEST_TYPES
+    from .profiling import rim_stats
     from .resilience import RESILIENCE_TYPES
     lines: List[str] = []
-    for name, typ, help_ in (_TYPES + TELEMETRY_TYPES +
+    for name, typ, help_ in (_TYPES + RIM_TYPES + TELEMETRY_TYPES +
                              RESILIENCE_TYPES + INGEST_TYPES):
         lines.append(f"# HELP {name} {help_}")
         lines.append(f"# TYPE {name} {typ}")
+    lines.extend(rim_stats().prometheus_lines())
     for sm in managers:
         lines.extend(sm.prometheus_lines())
     if kernel_profiler is not None:

@@ -25,31 +25,63 @@ from ..query_api.annotation import find_annotation
 from ..query_api.definition import StreamDefinition
 from ..utils.errors import BufferOverflowError, SiddhiAppRuntimeException
 from .context import SiddhiAppContext
-from .event import CURRENT, EXPIRED, Event, EventChunk
+from .event import CURRENT, EXPIRED, Event, EventChunk, LazyEvents
+from .profiling import rim_stats
 from .tracing import tracer as _tracer
 
 log = logging.getLogger(__name__)
 
 FAULT_PREFIX = "!"
 
+_RIM = rim_stats()
+
 
 class StreamCallback:
     """User callback attached to a stream (reference
-    stream/output/StreamCallback.java).  Subclass and override `receive`."""
+    stream/output/StreamCallback.java).  Subclass and override `receive`.
 
-    def __init__(self, fn: Optional[Callable[[List[Event]], None]] = None):
+    This is the legacy per-event compatibility shim: ``receive`` gets a
+    list-like ``LazyEvents`` view of the delivered chunk that builds the
+    ``Event`` objects on first element access — a callback that only
+    counts or ignores its events stays on the zero-materialization fast
+    path.  Subscribe a ``ColumnarStreamCallback`` instead to receive the
+    columns themselves with no per-event decode at all."""
+
+    def __init__(self, fn: Optional[Callable[[Sequence[Event]], None]] = None):
         self._fn = fn
         self.stream_definition: Optional[StreamDefinition] = None
 
-    def receive(self, events: List[Event]):
+    def receive(self, events: Sequence[Event]):
         if self._fn is not None:
             self._fn(events)
 
     # junction-facing
     def receive_chunk(self, chunk: EventChunk):
-        ev = chunk.only(CURRENT, EXPIRED).to_events()
+        ev = LazyEvents(chunk.only(CURRENT, EXPIRED))
         if ev:
             self.receive(ev)
+
+
+class ColumnarStreamCallback:
+    """Columnar stream callback: receives the delivered ``EventChunk``
+    itself (CURRENT/EXPIRED lanes), no per-event materialization — the
+    egress counterpart of ``InputHandler.send_batch``.  Subclass and
+    override ``receive``, or pass ``fn(chunk)``.  Registers through the
+    same ``add_callback`` as the legacy ``StreamCallback``."""
+
+    def __init__(self, fn: Optional[Callable[[EventChunk], None]] = None):
+        self._fn = fn
+        self.stream_definition: Optional[StreamDefinition] = None
+
+    def receive(self, chunk: EventChunk):
+        if self._fn is not None:
+            self._fn(chunk)
+
+    # junction-facing
+    def receive_chunk(self, chunk: EventChunk):
+        c = chunk.only(CURRENT, EXPIRED)
+        if not c.is_empty:
+            self.receive(c)
 
 
 class QueryCallback:
@@ -68,8 +100,8 @@ class QueryCallback:
     def receive_chunk(self, chunk: EventChunk):
         if chunk.is_empty:
             return
-        cur = chunk.only(CURRENT).to_events()
-        exp = chunk.only(EXPIRED).to_events()
+        cur = LazyEvents(chunk.only(CURRENT))
+        exp = LazyEvents(chunk.only(EXPIRED))
         if not cur and not exp:
             return
         ts = int(chunk.timestamps[-1])
@@ -567,7 +599,13 @@ class StreamJunction:
 class InputHandler:
     """User-facing ingestion for one stream (reference
     stream/input/InputHandler.java:51-85: send(Object[]), send(Event),
-    send(Event[]) — here additionally columnar `send_batch`)."""
+    send(Event[]) — here additionally columnar `send_batch`).
+
+    ``send_batch`` is the native path: columns flow junction-ward with no
+    row detour.  ``send`` is a thin row-normalizing shim that coerces its
+    rows into the same chunk shape and joins the shared chunk core
+    (``_send_chunk``) — validation, clock observation, delivery and
+    playback advance are one code path for both."""
 
     def __init__(self, junction: StreamJunction, app_ctx: SiddhiAppContext):
         self.junction = junction
@@ -576,9 +614,10 @@ class InputHandler:
 
     def send(self, data, timestamp: Optional[int] = None):
         """send(Object[]) / send(Event) / send([Event,...]) /
-        send([Object[],...])."""
-        barrier = self.app_ctx.thread_barrier
-        barrier.pass_through()
+        send([Object[],...]) — per-event compatibility shim over the
+        columnar core."""
+        self.app_ctx.thread_barrier.pass_through()
+        t0 = time.perf_counter_ns()
         rows: List[Sequence[Any]]
         stamps: List[int]
         if isinstance(data, Event):
@@ -602,8 +641,6 @@ class InputHandler:
                     f"{len(r)}: {list(r)!r}")
         v = self.junction.validator
         if v is None:
-            for ts in stamps:
-                self.app_ctx.timestamp_generator.observe_event_time(ts)
             chunk = EventChunk.from_rows(self.definition, rows, stamps)
         else:
             # quarantine path: coerce (with per-row salvage), split off
@@ -622,20 +659,13 @@ class InputHandler:
                            for reason, c in chunk_rejects)
             if rejects:
                 route_rejects(self.junction, rejects)
-            if chunk.is_empty:
-                return
-            stamps = chunk.timestamps.tolist()
-            for ts in stamps:
-                self.app_ctx.timestamp_generator.observe_event_time(ts)
-        with _tracer().span("ingest.chunk", stream=self.definition.id,
-                            n=len(chunk)):
-            self.junction.send(chunk)
-        if self.app_ctx.timestamp_generator.in_playback:
-            self.app_ctx.scheduler.advance_to(max(stamps))
+        self._send_chunk(chunk, t0)
 
     def send_batch(self, columns, timestamps=None):
-        """Columnar fast path: dict name→array (+ optional int64 timestamps)."""
+        """Columnar native path: dict name→array (+ optional int64
+        timestamps)."""
         self.app_ctx.thread_barrier.pass_through()
+        t0 = time.perf_counter_ns()
         names = self.definition.attribute_names
         n = len(next(iter(columns.values())))
         if timestamps is None:
@@ -650,14 +680,20 @@ class InputHandler:
                 route_rejects(self.junction,
                               [(reason, c.to_events())
                                for reason, c in chunk_rejects])
-            if chunk.is_empty:
-                return
-            ts_arr = chunk.timestamps
-            n = len(chunk)
-        if len(ts_arr) > 0:
-            self.app_ctx.timestamp_generator.observe_event_time(
-                int(ts_arr.max()))
+        self._send_chunk(chunk, t0)
+
+    def _send_chunk(self, chunk: EventChunk, t0: int) -> None:
+        """Shared chunk core: observe the clock, deliver, advance
+        playback.  ``t0`` is the caller's entry stamp — everything up to
+        delivery is host-rim time (RimStats)."""
+        n = len(chunk)
+        if n == 0:
+            _RIM.rim_ns += time.perf_counter_ns() - t0
+            return
+        mx = int(chunk.timestamps.max())
+        self.app_ctx.timestamp_generator.observe_event_time(mx)
+        _RIM.rim_ns += time.perf_counter_ns() - t0
         with _tracer().span("ingest.chunk", stream=self.definition.id, n=n):
             self.junction.send(chunk)
-        if self.app_ctx.timestamp_generator.in_playback and len(ts_arr) > 0:
-            self.app_ctx.scheduler.advance_to(int(ts_arr.max()))
+        if self.app_ctx.timestamp_generator.in_playback:
+            self.app_ctx.scheduler.advance_to(mx)

@@ -31,6 +31,12 @@ fill+T, FIFO order; t = chunk event index):
 - timeBatch(t) / externalTimeBatch(ts, t): flush boundaries are control
   state (host-scheduled); the kernel flushes the carried buffer at
   host-directed event positions (TimeBatchWindowProcessor.java).
+- hopping(t, hop): ONE flush per step (the host dispatches a separate
+  step per hop boundary — an entry can be CURRENT in many overlapping
+  windows, which a single per-entry emit mask cannot express).  At a
+  flush the window is the live entries with ts in (now - window, now];
+  the exp plane carries the previous hop's window, whose entries with
+  ts <= now - window emit EXPIRED (HopingWindowProcessor semantics).
 
 Egress row schema (int32): [pool_idx, evict_t, cause, ts_off,
 f-bank bitcast ×F, i-bank ×I]; tail row: [count, fill', exp_fill',
@@ -68,6 +74,9 @@ class DwinSpec(NamedTuple):
     #                      evictions total, overflow total) and append a
     #                      summary row to the egress buffer (before the
     #                      tail) — no extra D2H, emissions bit-identical
+    hop_ms: int = 0      # hopping kind: emission period (window_ms is the
+    #                      span); appended last to keep positional
+    #                      construction stable
 
 
 def make_dwin_carry(spec: DwinSpec, n_lanes: int) -> Dict[str, np.ndarray]:
@@ -78,7 +87,7 @@ def make_dwin_carry(spec: DwinSpec, n_lanes: int) -> Dict[str, np.ndarray]:
          "ring_ts": np.full((P, W), TS_NONE, np.int32),
          "fill": np.zeros((P,), np.int32)}
     if spec.kind in ("lengthBatch", "timeBatch", "externalTimeBatch",
-                     "batch"):
+                     "batch", "hopping"):
         c.update(exp_f=np.zeros((P, W, F), np.float32),
                  exp_i=np.zeros((P, W, I), np.int32),
                  exp_ts=np.full((P, W), TS_NONE, np.int32),
@@ -362,6 +371,53 @@ def build_dwin_step(spec: DwinSpec):
                                (jnp.max(nfill), jnp.int32(0), live_min,
                                 jnp.max(ovf.astype(jnp.int32))), cap,
                                telem_row=telem(nfill, evicted, ovf))
+            return new_carry, buf
+
+        if kind == "hopping":
+            # ONE hop boundary per step: the host dispatches a separate
+            # kernel step per boundary (a row can be CURRENT in many
+            # overlapping windows, so a single per-entry emit id cannot
+            # express multi-flush membership).  `directive[:, 0] > 0`
+            # marks a flush step at instant `now`; append-only steps
+            # just pool the chunk.  At a flush the window is the live
+            # entries with ts in (now - window, now]; the exp plane
+            # holds the PREVIOUS hop's window, whose entries with
+            # ts <= now - window emit EXPIRED (restamped at the
+            # boundary by the host composer — HopingWindowProcessor).
+            flushing = directive[:, 0] > 0
+            cutoff = now[:, None] - spec.window_ms
+            keep = live & (~flushing[:, None] | (pts > cutoff))
+            sf, si, sts, nfill, ovf = _new_ring(pf, pi, pts, keep, rank,
+                                                W, F, I)
+            cur_emit = keep & flushing[:, None]
+            cause = jnp.full((P, M), C_BATCH, jnp.int32)
+            eslot = jnp.arange(W)[None, :]
+            exp_emit = (eslot < carry["exp_fill"][:, None]) & \
+                flushing[:, None] & (carry["exp_ts"] <= cutoff)
+            exp_cause = jnp.full((P, W), C_EXPBATCH, jnp.int32)
+            post_exp_fill = jnp.where(flushing, nfill, carry["exp_fill"])
+            new_carry.update(
+                ring_f=sf, ring_i=si, ring_ts=sts, fill=nfill,
+                exp_f=jnp.where(flushing[:, None, None], sf,
+                                carry["exp_f"]),
+                exp_i=jnp.where(flushing[:, None, None], si,
+                                carry["exp_i"]),
+                exp_ts=jnp.where(flushing[:, None], sts,
+                                 carry["exp_ts"]),
+                exp_fill=post_exp_fill)
+            all_mask = jnp.concatenate([cur_emit, exp_emit], axis=1)
+            all_idx = jnp.concatenate([j, M + eslot], axis=1)
+            all_t = jnp.zeros((P, M + W), jnp.int32)
+            all_cause = jnp.concatenate([cause, exp_cause], axis=1)
+            all_ts = jnp.concatenate([pts, carry["exp_ts"]], axis=1)
+            all_f = jnp.concatenate([pf, carry["exp_f"]], axis=1)
+            all_i = jnp.concatenate([pi, carry["exp_i"]], axis=1)
+            buf = _pack_egress(all_mask, all_idx, all_t, all_cause,
+                               all_ts, all_f, all_i,
+                               (jnp.max(nfill), jnp.max(post_exp_fill),
+                                TS_NONE,
+                                jnp.max(ovf.astype(jnp.int32))), cap,
+                               telem_row=telem(nfill, all_mask, ovf))
             return new_carry, buf
 
         # ---------------- batch kinds ----------------

@@ -39,7 +39,7 @@ from ..utils.errors import (SiddhiAppCreationError,
 
 DEVICE_KINDS = ("length", "lengthBatch", "time", "timeBatch",
                 "externalTime", "externalTimeBatch", "timeLength",
-                "delay", "batch", "sort", "session")
+                "delay", "batch", "sort", "session", "hopping")
 _BATCH_KINDS = ("lengthBatch", "timeBatch", "externalTimeBatch", "batch")
 W_START = 16
 LONG_BASE = np.int64(1) << 31
@@ -87,10 +87,12 @@ class DeviceWindowProcessor(WindowProcessor):
         # ---- window parameters (mirror core/window.create_window_processor)
         self.window_ms = 0
         self.length = 0
+        self.hop_ms = 0
         self.ts_expr = None
         need = {"length": 1, "lengthBatch": 1, "time": 1, "timeBatch": 1,
                 "delay": 1, "externalTime": 2, "externalTimeBatch": 2,
-                "timeLength": 2, "batch": 0, "sort": 2, "session": 1}[kind]
+                "timeLength": 2, "batch": 0, "sort": 2, "session": 1,
+                "hopping": 2}[kind]
         if len(params) < need:
             _reject(f"#window.{kind} needs {need} parameter(s)")
         if kind == "length" or kind == "lengthBatch":
@@ -113,6 +115,11 @@ class DeviceWindowProcessor(WindowProcessor):
         elif kind == "timeLength":
             self.window_ms = _const_ms(params[0])
             self.length = _const_ms(params[1])
+        elif kind == "hopping":
+            self.window_ms = _const_ms(params[0])
+            self.hop_ms = _const_ms(params[1])
+            if self.window_ms <= 0 or self.hop_ms <= 0:
+                _reject("hopping needs positive window and hop")
         elif kind == "sort":
             # sort(n, attr [, 'asc'|'desc', attr2, ...]) — round 5
             self.length = _const_ms(params[0])
@@ -227,6 +234,13 @@ class DeviceWindowProcessor(WindowProcessor):
         self._fill_host = 0               # pre-step fill (interleave c0)
         self._exp_fill_host = 0
         self._fill_disp = 0               # dispatch-side fill (lengthBatch)
+        # hopping control mirrors (dispatch-side): the live event
+        # timestamps and the previous hop's window timestamps — pure host
+        # arithmetic over chunk timestamps the dispatcher already holds,
+        # so provable no-op boundaries (everything empty) skip the kernel
+        # step instead of storming one dispatch per silent hop
+        self._hop_ts = np.empty(0, np.int64)
+        self._hop_prev = np.empty(0, np.int64)
         # ingest pipelining (round 5, plan/pipeline.py): the query
         # runtime's chain flush + timer/state paths drain _inflight
         from collections import deque
@@ -240,7 +254,8 @@ class DeviceWindowProcessor(WindowProcessor):
                         self.window_ms, self.length,
                         sort_keys=self._sort_keys,
                         skey_lane=self._skey_lane,
-                        telemetry=self.telemetry)
+                        telemetry=self.telemetry,
+                        hop_ms=self.hop_ms)
 
     def _ensure_carry(self):
         if self.carry is None:
@@ -578,12 +593,16 @@ class DeviceWindowProcessor(WindowProcessor):
         if self.kind in ("time", "delay", "timeLength", "session"):
             self.app_ctx.scheduler.notify_at(now + self.window_ms,
                                              self._on_timer)
-        if self.kind in _BATCH_KINDS:
+        if self.kind == "hopping":
+            for work in self._hop_dispatch(chunk):
+                self._submit(work)
+        elif self.kind in _BATCH_KINDS:
             work = self._batch_dispatch(chunk, now)
+            self._submit(work)
         else:
             work = self._dispatch_step(chunk, now, None)
             work["emit"] = ("slide", chunk, None, None)
-        self._submit(work)
+            self._submit(work)
         from ..core.flight import flight
         fl = flight()
         if fl.enabled:
@@ -660,6 +679,8 @@ class DeviceWindowProcessor(WindowProcessor):
         if mode == "slide":
             self._emit_slide(chunk, work, evt, cause, ts_off, rf, ri,
                              fill_pre)
+        elif mode == "hop":
+            self._emit_hop(work["base"] or 0, parts, flush_ts)
         else:
             if self.kind == "lengthBatch":
                 # flush ts = each batch's last member arrival ts
@@ -797,6 +818,82 @@ class DeviceWindowProcessor(WindowProcessor):
         work["emit"] = ("batch", chunk, n_done, flush_ts)
         return work
 
+    def _hop_dispatch(self, chunk: EventChunk) -> List[dict]:
+        """Split a chunk at hop boundaries (host control arithmetic,
+        mirrors HopingWindowProcessor.on_data) and dispatch one kernel
+        step per due boundary — a row can be CURRENT in many overlapping
+        windows, so a single per-entry flush id cannot express hopping —
+        plus an append-only step for the trailing remainder."""
+        works: List[dict] = []
+        if self.next_emit is None:
+            self.next_emit = int(chunk.timestamps[0]) + self.hop_ms
+            self.app_ctx.scheduler.notify_at(self.next_emit,
+                                             self._on_timer)
+        while not chunk.is_empty and \
+                int(chunk.timestamps[-1]) >= self.next_emit:
+            pre = chunk.timestamps <= self.next_emit
+            seg = None
+            if pre.any():
+                seg = chunk.mask(pre)
+                chunk = chunk.mask(~pre)
+            work = self._hop_step_work(seg)
+            if work is not None:
+                works.append(work)
+            self.next_emit += self.hop_ms
+        if not chunk.is_empty:
+            self._hop_ts = np.concatenate(
+                [self._hop_ts, np.asarray(chunk.timestamps, np.int64)])
+            work = self._dispatch_step(chunk, int(chunk.timestamps[-1]),
+                                       None)
+            work["emit"] = ("hop", None, None, None)
+            works.append(work)
+        return works
+
+    def _hop_step_work(self, seg: Optional[EventChunk]) -> Optional[dict]:
+        """One boundary flush at self.next_emit (seg = the rows that
+        belong to this hop's window; may be None).  Returns None when the
+        step is a provable no-op — nothing appended since the last
+        dispatched flush, and both the live window and the previous hop's
+        window are empty on device — so a large timestamp gap advances
+        next_emit without a kernel dispatch per silent hop."""
+        b = self.next_emit
+        if seg is not None and len(seg):
+            self._hop_ts = np.concatenate(
+                [self._hop_ts, np.asarray(seg.timestamps, np.int64)])
+        if seg is None and not len(self._hop_ts) and \
+                not len(self._hop_prev):
+            return None
+        cur = self._hop_ts[self._hop_ts > b - self.window_ms]
+        self._hop_ts = cur
+        self._hop_prev = cur
+        T = len(seg) if seg is not None and len(seg) else 1
+        work = self._dispatch_step(seg, b, np.ones((1, T), np.int32))
+        work["emit"] = ("hop", None, None, b)
+        return work
+
+    def _emit_hop(self, base: int, parts, ts_f: Optional[int]) -> None:
+        """Compose one hop's emission — EXPIRED (the previous window's
+        rows that slid out, restamped at the boundary), RESET, CURRENT
+        (original timestamps) — exactly HopingWindowProcessor._hop."""
+        if ts_f is None:                  # append-only step: no emission
+            return
+        (_idx, _evt, cause, ts_off, rf, ri, _mn) = parts
+        outs = []
+        exp_sel = cause == C_EXPBATCH
+        if exp_sel.any():
+            outs.append(self._rows_to_chunk(
+                rf[exp_sel], ri[exp_sel],
+                np.full(int(exp_sel.sum()), ts_f, np.int64), EXPIRED))
+        cur_sel = cause == C_BATCH
+        if cur_sel.any():
+            cur = self._rows_to_chunk(
+                rf[cur_sel], ri[cur_sel],
+                ts_off[cur_sel].astype(np.int64) + base, CURRENT)
+            outs.append(_reset_row(cur, ts_f))
+            outs.append(cur)
+        if outs:
+            self.send_next(EventChunk.concat(outs))
+
     def _emit_flushes(self, n_done, flush_ts, evt, cause, ts_off, rf, ri,
                       exp_fill_pre):
         base = self._base or 0
@@ -841,7 +938,7 @@ class DeviceWindowProcessor(WindowProcessor):
     def _on_timer(self, now: int):
         def run():
             self.on_timer_event(now)
-            if self.kind == "timeBatch":
+            if self.kind in ("timeBatch", "hopping"):
                 if self.next_emit is not None:
                     self.app_ctx.scheduler.notify_at(self.next_emit,
                                                      self._on_timer)
@@ -909,6 +1006,16 @@ class DeviceWindowProcessor(WindowProcessor):
                 self.send_next(self._session_expired_chunk(evt, rf, ri,
                                                            base))
             return
+        if self.kind == "hopping":
+            if self.next_emit is None:
+                return
+            while ts >= self.next_emit:
+                work = self._hop_step_work(None)
+                if work is not None:
+                    self._emit_hop(work["base"] or 0,
+                                   self._read_work(work), self.next_emit)
+                self.next_emit += self.hop_ms
+            return
         if self.kind == "timeBatch":
             if self.next_emit is None:
                 return
@@ -965,6 +1072,8 @@ class DeviceWindowProcessor(WindowProcessor):
                 "fill": self._fill_host, "exp_fill": self._exp_fill_host,
                 "next_emit": self.next_emit,
                 "window_end": self.window_end,
+                "hop_ts": self._hop_ts.tolist(),
+                "hop_prev": self._hop_prev.tolist(),
                 "strs": {a: list(dec) for a, (_e, dec)
                          in self.str_attrs.items()},
                 "skey": (list(self._skey_enc.items())
@@ -985,6 +1094,8 @@ class DeviceWindowProcessor(WindowProcessor):
         self._exp_fill_host = state["exp_fill"]
         self.next_emit = state["next_emit"]
         self.window_end = state["window_end"]
+        self._hop_ts = np.asarray(state.get("hop_ts", []), np.int64)
+        self._hop_prev = np.asarray(state.get("hop_prev", []), np.int64)
         for a, dec in state["strs"].items():
             self.str_attrs[a] = ({v: i + 1 for i, v in enumerate(dec)},
                                  list(dec))

@@ -42,6 +42,7 @@ def _record_block(rt_obj, prof, disp0: int, ticks0: int, stream: str,
     profiler's dispatches-per-block gauge (when profiling is on) plus a
     flight-recorder ring record (core/flight.py, always-cheap)."""
     from ..core.flight import flight
+    from ..core.profiling import rim_stats
     d = prof.total_dispatches() - disp0 if prof.enabled else 0
     t = prof.total_scan_ticks() - ticks0 if prof.enabled else 0
     if prof.enabled:
@@ -60,6 +61,18 @@ def _record_block(rt_obj, prof, disp0: int, ticks0: int, stream: str,
     fuser = getattr(app, "_egress_fuser", None) if app is not None else None
     extra = ({"egress_bytes": fuser.last_slab_bytes}
              if fuser is not None and fuser.last_slab_bytes else None)
+    # rim-vs-kernel ms split: delta of the always-on host-rim clock (and,
+    # when profiling is on, the kernel dispatch clock) since this
+    # runtime's previous block — per-block attribution for the ring
+    rim_now = rim_stats().rim_ns
+    kern_now = prof.total_dispatch_ns() if prof.enabled else 0
+    rim_prev = getattr(rt_obj, "_flight_rim_ns0", None)
+    if rim_prev is not None:
+        split = {"rim_ms": (rim_now - rim_prev) / 1e6,
+                 "kernel_ms": (kern_now - rt_obj._flight_kern_ns0) / 1e6}
+        extra = dict(extra or {}, **split)
+    rt_obj._flight_rim_ns0 = rim_now
+    rt_obj._flight_kern_ns0 = kern_now
     fl.record_block(rt_obj.app_name, stream=stream, batch=batch,
                     dispatches=d, scan_ticks=t, junction=junction,
                     scheduler=sched, telemetry=telemetry, extra=extra)

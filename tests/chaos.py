@@ -34,6 +34,7 @@ import random
 import threading
 from typing import Dict, List, Optional
 
+from siddhi_tpu.core.event import EventChunk
 from siddhi_tpu.core.snapshot import PersistenceStore
 from siddhi_tpu.core.source_sink import Sink, Source
 from siddhi_tpu.utils.errors import ConnectionUnavailableError
@@ -128,7 +129,11 @@ class ChaosSink(Sink):
     def publish(self, payload, event):
         script_for(self.chaos_id).check("publish")
         sink_log = delivered(self.chaos_id)
-        if isinstance(payload, list):
+        if isinstance(payload, EventChunk):
+            # columnar passthrough payload: record per-event for the
+            # suite's no-loss assertions
+            sink_log.extend(payload.to_events())
+        elif isinstance(payload, list):
             sink_log.extend(payload)
         else:
             sink_log.append(payload)

@@ -57,6 +57,14 @@ def test_bench_smoke_runs_clean():
     assert osm["admitted"] == 200
     assert osm["shed"] > 0
     assert osm["admitted"] == osm["delivered"] + osm["shed"]
+    # host rim (round 11): the columnar ingest -> match -> inMemory-sink
+    # run materialized ZERO per-event Event objects, while the legacy
+    # per-event callback run over the same feed did materialize — both
+    # asserted inside the smoke and visible here
+    rsm = out["rim_smoke"]
+    assert rsm["sink_rows"] > 0
+    assert rsm["columnar_materialized"] == 0
+    assert rsm["legacy_materialized"] > 0
     prof = out["kernel_profile"]
     assert prof["nfa.bank_step"]["scan_ticks"] > 0
     assert prof["nfa.bank_step"]["dispatch_count"] > 0

@@ -167,6 +167,58 @@ def test_lossy_frequent_window():
         [("A", 1), ("A", 2), ("B", 3), ("A", 4)])
 
 
+def test_hopping_overlap_window_gt_hop():
+    # window 2s, hop 1s: each event is CURRENT in two successive hops,
+    # expiring once when it slides out (HopingWindowTestCase shape)
+    run_query(CSE + Q + """
+        from cse#window.hoping(2 sec, 1 sec) select symbol, volume
+        insert all events into out;""",
+        [("cse", ["A", 1.0, 1], 1000), ("cse", ["B", 2.0, 2], 1600),
+         ("cse", ["C", 3.0, 3], 2300), ("cse", ["D", 4.0, 4], 3100)],
+        [("A", 1), ("B", 2), ("B", 2), ("C", 3), ("C", 3), ("D", 4),
+         ("D", 4)],
+        expected_removed=[("A", 1), ("B", 2), ("C", 3), ("D", 4)],
+        playback=True, advance_to=6000)
+
+
+def test_hopping_tumbling_window_eq_hop():
+    # window == hop degenerates to tumbling; an event exactly at
+    # boundary - window is excluded (strict > cut)
+    run_query(CSE + Q + """
+        from cse#window.hopping(1 sec, 1 sec) select symbol, volume
+        insert all events into out;""",
+        [("cse", ["A", 1.0, 1], 1000), ("cse", ["B", 2.0, 2], 1400),
+         ("cse", ["C", 3.0, 3], 2100)],
+        [("B", 2), ("C", 3)],
+        expected_removed=[("B", 2), ("C", 3)],
+        playback=True, advance_to=4000)
+
+
+def test_hopping_gap_window_lt_hop():
+    # window 1s, hop 2s: only events inside the trailing 1s of each hop
+    # are sampled; the rest never emit
+    run_query(CSE + Q + """
+        from cse#window.hoping(1 sec, 2 sec) select symbol, volume
+        insert all events into out;""",
+        [("cse", ["A", 1.0, 1], 1000), ("cse", ["B", 2.0, 2], 2500),
+         ("cse", ["C", 3.0, 3], 4900)],
+        [("B", 2), ("C", 3)],
+        expected_removed=[("B", 2)],
+        playback=True, advance_to=6000)
+
+
+def test_hopping_sum_per_hop():
+    # each hop's RESET row clears the accumulator, then the window's
+    # rows re-accumulate (running sum per CURRENT row, no is_batch)
+    run_query(CSE + Q + """
+        from cse#window.hoping(2 sec, 1 sec) select sum(volume) as total
+        insert into out;""",
+        [("cse", ["A", 1.0, 10], 1000), ("cse", ["B", 2.0, 20], 1600),
+         ("cse", ["C", 3.0, 30], 2300)],
+        [(10,), (30,), (20,), (50,), (30,)],
+        playback=True, advance_to=5000)
+
+
 def test_delay_window_holds_events():
     run_query(CSE + Q + """
         from cse#window.delay(1 sec) select symbol, volume
