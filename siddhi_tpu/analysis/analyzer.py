@@ -157,6 +157,7 @@ def analyze(app: Union[str, SiddhiApp],
     deadcode_pass(table, insert_targets, sink)
     _fault_tolerance_pass(app, sink)
     _ingest_protection_pass(app, sink)
+    _slo_pass(app, sink)
     order = {Severity.ERROR: 0, Severity.WARNING: 1, Severity.INFO: 2}
     res.diagnostics = sorted(
         sink.diagnostics,
@@ -281,6 +282,60 @@ def _ingest_protection_pass(app: SiddhiApp, sink: DiagnosticSink) -> None:
                           f"non-negative integer, nan/wrap booleans); "
                           f"the runtime will fall back to the option's "
                           f"default", pos=pos_of(d))
+
+
+# ============================================== service-level objectives
+
+_SLO_KEYS = {"latency.p99.ms", "lag.ms", "window.blocks", "breach.blocks"}
+
+
+def _slo_pass(app: SiddhiApp, sink: DiagnosticSink) -> None:
+    """SA070-SA072: ``@app:slo`` hazards (core/ledger.py).  The runtime
+    parses the annotation tolerantly — malformed values fall back to
+    defaults with a log line — so these diagnostics are where the author
+    learns a target was ignored."""
+    slo = find_annotation(app.annotations, "app:slo")
+    if slo is None:
+        slo = find_annotation(app.annotations, "slo")
+    if slo is None:
+        return
+
+    def num(key):
+        raw = slo.get(key, None)
+        if raw is None:
+            return None, False
+        try:
+            return float(raw), False
+        except (TypeError, ValueError):
+            return None, True
+
+    unknown = sorted(e.key for e in slo.elements
+                     if e.key and e.key not in _SLO_KEYS)
+    for k in unknown:
+        sink.emit("SA071",
+                  f"@app:slo option '{k}' is not read by the SLO engine "
+                  f"(known options: latency.p99.ms, lag.ms, "
+                  f"window.blocks, breach.blocks)")
+    lat, bad_lat = num("latency.p99.ms")
+    lag, bad_lag = num("lag.ms")
+    wb, bad_wb = num("window.blocks")
+    bb, bad_bb = num("breach.blocks")
+    bad = bad_lat or bad_lag or bad_wb or bad_bb
+    if not bad:
+        bad = ((lat is not None and lat <= 0)
+               or (lag is not None and lag <= 0)
+               or (wb is not None and (wb <= 0 or wb != int(wb)))
+               or (bb is not None and (bb <= 0 or bb != int(bb))))
+    if bad:
+        sink.emit("SA070",
+                  "@app:slo options are invalid (latency.p99.ms / lag.ms "
+                  "must be positive numbers, window.blocks / "
+                  "breach.blocks positive integers); the runtime will "
+                  "ignore the bad value and use the default")
+    if lat is None and lag is None and not (bad_lat or bad_lag):
+        sink.emit("SA072",
+                  "@app:slo declares no latency.p99.ms and no lag.ms "
+                  "target; the SLO engine has nothing to evaluate")
 
 
 # ============================================================ aggregations

@@ -192,6 +192,25 @@ CATALOG: Dict[str, CatalogEntry] = {e.code: e for e in [
        "runtime would silently fall back to the option's default.",
        "Fix the option, e.g. `@quarantine(ts.slack.ms='5000', "
        "nan='true', wrap='true')`."),
+    # ---- service-level objectives --------------------------------------
+    _C("SA070", _E, "invalid-slo-config",
+       "`@app:slo` option values are malformed: latency.p99.ms and "
+       "lag.ms must be positive numbers, window.blocks and "
+       "breach.blocks positive integers — the runtime would silently "
+       "ignore the bad value and fall back to the option's default.",
+       "Fix the offending option, e.g. `@app:slo(latency.p99.ms='200', "
+       "lag.ms='5000', window.blocks='128', breach.blocks='3')`."),
+    _C("SA071", _W, "unknown-slo-option",
+       "`@app:slo` carries an option the SLO engine does not read; it "
+       "is ignored at runtime (likely a typo for latency.p99.ms / "
+       "lag.ms / window.blocks / breach.blocks).",
+       "Remove the option or correct its name."),
+    _C("SA072", _W, "slo-without-targets",
+       "`@app:slo` declares no latency.p99.ms and no lag.ms target — "
+       "the SLO engine has nothing to evaluate, so no burn-rate gauge, "
+       "health degradation or SLO001 bundle will ever fire.",
+       "Add at least one target, e.g. "
+       "`@app:slo(latency.p99.ms='200')`."),
     # ---- TPU performance hazards ---------------------------------------
     _C("SP001", _W, "retrace-slot-growth",
        "A device-eligible `every` pattern without `within` will grow its "
@@ -367,6 +386,7 @@ _FAMILIES = (
     ("SA04", "Dead code"),
     ("SA05", "Fault tolerance"),
     ("SA06", "Ingest protection"),
+    ("SA07", "Service-level objectives"),
     ("SP0", "TPU performance hazards"),
     ("PV00", "Plan verifier — automaton"),
     ("PV01", "Plan verifier — jaxpr kernel sanitizer"),

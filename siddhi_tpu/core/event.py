@@ -89,7 +89,7 @@ class EventChunk:
     StateEvent (join/pattern output rows, event/state/StateEvent.java)."""
 
     __slots__ = ("timestamps", "types", "columns", "names", "qualified",
-                 "is_batch")
+                 "is_batch", "ledger_ns")
 
     def __init__(self, names: Sequence[str], timestamps: np.ndarray,
                  types: np.ndarray, columns: Dict[str, np.ndarray],
@@ -104,6 +104,11 @@ class EventChunk:
         # transforms below all carry it so intervening processors (filters,
         # stream functions) don't strip batch semantics
         self.is_batch = is_batch
+        # latency-ledger boundary stamp (monotonic ns): set at ingress
+        # admit / junction enqueue, consumed at the next stage boundary
+        # (queue-wait and dispatch-gap attribution, core/ledger.py); NOT
+        # carried by transforms — a derived chunk is a new timeline
+        self.ledger_ns = None
 
     # ------------------------------------------------------------ constructors
 
@@ -303,6 +308,14 @@ class LazyEvents:
 
     def __getitem__(self, i):
         return self.materialize()[i]
+
+    def __repr__(self):
+        # must NOT materialize: repr of a pending view is a debugging /
+        # logging path and the zero-copy property (events_materialized
+        # == 0) has to survive it
+        state = ("pending" if self._events is None
+                 else f"materialized={len(self._events)}")
+        return f"LazyEvents(n={len(self.chunk)}, {state})"
 
 
 def _sel_qualified(q, sel):

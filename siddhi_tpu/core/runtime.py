@@ -230,6 +230,20 @@ class SiddhiAppRuntime:
             else:
                 raise SiddhiAppCreationError(
                     f"Unknown error store type '{etype}'")
+        # @app:slo(latency.p99.ms='...', lag.ms='...') — per-app latency/
+        # lag objectives for the always-on ledger (core/ledger.py):
+        # burn-rate gauges on /metrics, /health degradation and an SLO001
+        # flight bundle on sustained breach.  Parsed tolerantly like the
+        # @Async overload options; the analyzer's SA07x diagnostics flag
+        # malformed values
+        self.slo_config = None
+        slo = find_annotation(self.app.annotations, "app:slo")
+        if slo is None:
+            slo = find_annotation(self.app.annotations, "slo")
+        if slo is not None:
+            from .ledger import SloConfig, ledger
+            self.slo_config = SloConfig.from_annotation(slo)
+            ledger().register_slo(self.name, self.slo_config)
 
     def _build(self):
         from .source_sink import attach_sources_and_sinks
@@ -491,6 +505,8 @@ class SiddhiAppRuntime:
         self.app_ctx.timestamp_generator.shutdown()
         if self.app_ctx.statistics_manager:
             self.app_ctx.statistics_manager.stop_reporting()
+        from .ledger import ledger
+        ledger().drop_app(self.name)
         self._started = False
 
     def debug(self):
@@ -620,9 +636,16 @@ class SiddhiAppRuntime:
 
     @property
     def statistics(self) -> dict:
-        from .profiling import profiler
+        from .ledger import ledger
+        from .profiling import profiler, rim_stats
         snap = self.app_ctx.statistics_manager.snapshot()
         snap["kernels"] = profiler().snapshot()
+        # the always-on host-rim counters and the latency ledger ride
+        # every snapshot surface (/metrics, flight records, here) —
+        # rt.statistics must agree with them (tests/test_service.py
+        # asserts the parity)
+        snap["rim"] = rim_stats().snapshot()
+        snap["ledger"] = ledger().snapshot(app=self.name)
         if self.device_telemetry is not None:
             snap["telemetry"] = self.device_telemetry.snapshot()
         return snap

@@ -24,6 +24,7 @@ from ..query_api.annotation import Annotation, find_all, find_annotation
 from ..utils.errors import (ConnectionUnavailableError, MappingFailedError,
                             SiddhiAppCreationError)
 from .event import CURRENT, Event, EventChunk, LazyEvents, dtype_for
+from .ledger import ledger as _ledger
 from .resilience import (CircuitBreaker, RetryPolicy, SinkRetryWorker,
                          make_entry)
 
@@ -468,6 +469,10 @@ class Sink:
             # nothing publishable (all-EXPIRED/TIMER traffic): return
             # before any Event materialization
             return
+        with _ledger().span("publish"):
+            self._receive_cur(cur)
+
+    def _receive_cur(self, cur: EventChunk):
         if self._is_dynamic():
             # per-event {{attr}} option templating forces the event path
             for e in cur.to_events():
@@ -647,6 +652,10 @@ class DistributedSink(Sink):
         cur = chunk.only(CURRENT)
         if cur.is_empty:
             return      # all-EXPIRED/TIMER: nothing to materialize
+        with _ledger().span("publish"):
+            self._publish_cur(cur)
+
+    def _publish_cur(self, cur: EventChunk):
         if isinstance(self.strategy, BroadcastStrategy) and self.destinations \
                 and not any(d._is_dynamic() for d in self.destinations):
             # broadcast with static options fans the mapped chunk to every

@@ -519,6 +519,28 @@ RIM_TYPES = [
      "delivery)"),
 ]
 
+#: Always-on per-stage latency ledger + lag watermarks + SLO engine
+#: (core/ledger.py): rendered on every /metrics scrape regardless of
+#: @app:statistics; SIDDHI_TPU_LEDGER=0 freezes the counters.
+LEDGER_TYPES = [
+    ("siddhi_ledger_stage_seconds_total",
+     "counter", "Exclusive wall time attributed to a pipeline stage"),
+    ("siddhi_ledger_stage_spans_total",
+     "counter", "Ledger span exits per pipeline stage"),
+    ("siddhi_ledger_stage_latency_ms",
+     "gauge", "Per-app per-block stage latency quantiles (ms)"),
+    ("siddhi_event_time_lag_ms",
+     "gauge", "Max admitted event timestamp vs wall/playback clock"),
+    ("siddhi_processing_lag_ms",
+     "gauge", "Wall time since a stream last admitted a chunk"),
+    ("siddhi_slo_burn_rate",
+     "gauge", "Observed / target ratio per @app:slo objective"),
+    ("siddhi_slo_breach_active",
+     "gauge", "1 while an app's SLO breach is active"),
+    ("siddhi_slo_breach_total",
+     "counter", "SLO breach transitions (SLO001 incidents)"),
+]
+
 #: Opt-in on-device state telemetry (@app:statistics(telemetry='true')).
 #: Accumulated in-kernel (ops/nfa.py, ops/dwin.py) and read out through
 #: the fused-egress slab — see DeviceTelemetry.
@@ -622,15 +644,18 @@ def prometheus_text(managers: List[StatisticsManager],
     IngestMetrics (core/overload.py) and the per-runtime DeviceTelemetry
     holders.  Every series family gets its # HELP/# TYPE header exactly
     once, before any samples."""
+    from .ledger import ledger
     from .overload import INGEST_TYPES
     from .profiling import rim_stats
     from .resilience import RESILIENCE_TYPES
     lines: List[str] = []
-    for name, typ, help_ in (_TYPES + RIM_TYPES + TELEMETRY_TYPES +
-                             RESILIENCE_TYPES + INGEST_TYPES):
+    for name, typ, help_ in (_TYPES + RIM_TYPES + LEDGER_TYPES +
+                             TELEMETRY_TYPES + RESILIENCE_TYPES +
+                             INGEST_TYPES):
         lines.append(f"# HELP {name} {help_}")
         lines.append(f"# TYPE {name} {typ}")
     lines.extend(rim_stats().prometheus_lines())
+    lines.extend(ledger().prometheus_lines())
     for sm in managers:
         lines.extend(sm.prometheus_lines())
     if kernel_profiler is not None:

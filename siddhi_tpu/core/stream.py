@@ -26,6 +26,7 @@ from ..query_api.definition import StreamDefinition
 from ..utils.errors import BufferOverflowError, SiddhiAppRuntimeException
 from .context import SiddhiAppContext
 from .event import CURRENT, EXPIRED, Event, EventChunk, LazyEvents
+from .ledger import ledger as _ledger, ledger_enabled
 from .profiling import rim_stats
 from .tracing import tracer as _tracer
 
@@ -34,6 +35,7 @@ log = logging.getLogger(__name__)
 FAULT_PREFIX = "!"
 
 _RIM = rim_stats()
+_LED = _ledger()
 
 
 class StreamCallback:
@@ -59,7 +61,8 @@ class StreamCallback:
     def receive_chunk(self, chunk: EventChunk):
         ev = LazyEvents(chunk.only(CURRENT, EXPIRED))
         if ev:
-            self.receive(ev)
+            with _LED.span("publish"):
+                self.receive(ev)
 
 
 class ColumnarStreamCallback:
@@ -81,7 +84,8 @@ class ColumnarStreamCallback:
     def receive_chunk(self, chunk: EventChunk):
         c = chunk.only(CURRENT, EXPIRED)
         if not c.is_empty:
-            self.receive(c)
+            with _LED.span("publish"):
+                self.receive(c)
 
 
 class QueryCallback:
@@ -105,7 +109,8 @@ class QueryCallback:
         if not cur and not exp:
             return
         ts = int(chunk.timestamps[-1])
-        self.receive(ts, cur or None, exp or None)
+        with _LED.span("publish"):
+            self.receive(ts, cur or None, exp or None)
 
 
 class _FlushBarrier:
@@ -313,6 +318,15 @@ class StreamJunction:
                 batch.append(nxt)
                 n += len(nxt)
             merged = EventChunk.concat(batch) if len(batch) > 1 else batch[0]
+            if ledger_enabled():
+                # queue stage: enqueue stamp -> this dequeue, per popped
+                # chunk; the merged chunk restarts its timeline here so
+                # _deliver's dispatch gap starts at the dequeue boundary
+                now_ns = time.perf_counter_ns()
+                for c in batch:
+                    if c.ledger_ns is not None:
+                        _LED.record("queue", now_ns - c.ledger_ns)
+                merged.ledger_ns = now_ns
             try:
                 self._deliver(merged)
                 delivered = True
@@ -391,6 +405,11 @@ class StreamJunction:
             # any event movement counts as ingest progress: a dispatch
             # storm is, by definition, dispatching with none
             wd.note_progress(len(chunk))
+        if chunk.ledger_ns is None and ledger_enabled():
+            # internal producers (query output fan-in, fault routes)
+            # start their timeline here: queue-wait / dispatch-gap
+            # attribution needs a boundary stamp on every chunk
+            chunk.ledger_ns = time.perf_counter_ns()
         if self.is_async and self._queue is not None:
             if self.overload is not None:
                 self._admit(chunk)
@@ -494,6 +513,13 @@ class StreamJunction:
 
     def _deliver(self, chunk: EventChunk):
         tr = _tracer()
+        led = _LED if ledger_enabled() else None
+        if led is not None and chunk.ledger_ns is not None:
+            # dispatch gap: boundary stamp (dequeue / junction entry) ->
+            # delivery start; consumed so a re-routed chunk (fault
+            # junction) does not double count
+            led.record("dispatch", time.perf_counter_ns() - chunk.ledger_ns)
+            chunk.ledger_ns = None
         for r in list(self.receivers):
             try:
                 if tr.enabled:
@@ -502,11 +528,22 @@ class StreamJunction:
                             else "deliver",
                             stream=self.definition.id, n=len(chunk),
                             receiver=type(r).__name__):
-                        r.receive_chunk(chunk)
+                        self._recv_one(r, chunk, led)
                 else:
-                    r.receive_chunk(chunk)
+                    self._recv_one(r, chunk, led)
             except Exception as e:  # noqa: BLE001 — @OnError boundary
                 self._handle_error(chunk, e, receiver=r)
+
+    @staticmethod
+    def _recv_one(r, chunk: EventChunk, led):
+        if led is None:
+            r.receive_chunk(chunk)
+            return
+        # dispatch stage (exclusive): junction fan-out + host-side query
+        # processing; the device/decode/publish work nested inside the
+        # receiver carries its own spans and is subtracted automatically
+        with led.span("dispatch"):
+            r.receive_chunk(chunk)
 
     def _handle_error(self, chunk: EventChunk, e: Exception, receiver=None):
         from .flight import flight
@@ -692,7 +729,18 @@ class InputHandler:
             return
         mx = int(chunk.timestamps.max())
         self.app_ctx.timestamp_generator.observe_event_time(mx)
-        _RIM.rim_ns += time.perf_counter_ns() - t0
+        now = time.perf_counter_ns()
+        _RIM.rim_ns += now - t0
+        if ledger_enabled():
+            # ingress stage (validate/encode up to delivery) + the
+            # event-time lag watermark: max admitted timestamp vs the
+            # playback clock when replaying history, else the wall clock
+            clock_ms = (self.app_ctx.current_time()
+                        if self.app_ctx.timestamp_generator.in_playback
+                        else time.time() * 1000.0)
+            _LED.note_ingress(self.app_ctx.name, self.definition.id,
+                              mx, clock_ms, now - t0)
+            chunk.ledger_ns = now
         with _tracer().span("ingest.chunk", stream=self.definition.id, n=n):
             self.junction.send(chunk)
         if self.app_ctx.timestamp_generator.in_playback:

@@ -30,6 +30,7 @@ import threading
 from collections import deque
 from typing import Any, Dict, Iterable, List, Optional
 
+from ..core.ledger import ledger as _ledger
 from ..query_api.annotation import find_annotation
 
 DEFAULT_DEPTH = 4
@@ -77,7 +78,8 @@ class PipelinedDeviceIngest:
             self._watchdog.note_progress()
         self._inflight.append(work)
         while len(self._inflight) > self.pipeline_depth:
-            self._retire(self._inflight.popleft())
+            with _ledger().span("decode"):
+                self._retire(self._inflight.popleft())
 
     def flush(self) -> None:
         """Retire every in-flight chunk: called on idle/drain by the
@@ -85,7 +87,8 @@ class PipelinedDeviceIngest:
         (re-entrant) — state reads can race the junction worker."""
         with self.qr.lock:
             while self._inflight:
-                self._retire(self._inflight.popleft())
+                with _ledger().span("decode"):
+                    self._retire(self._inflight.popleft())
 
     def _retire(self, work: Dict[str, Any]) -> None:
         raise NotImplementedError
@@ -162,7 +165,8 @@ class _FuseGroup:
                 self.fuser._rotate()
             self.seal()
             if self._host is None and self._slab is not None:
-                self._host = np.asarray(self._slab)       # the ONE D2H
+                with _ledger().span("egress_d2h"):
+                    self._host = np.asarray(self._slab)   # the ONE D2H
                 self.fuser.d2h_count += 1
                 self.fuser.last_slab_bytes = self._host.nbytes
                 from ..core.profiling import profiler

@@ -28,6 +28,7 @@ from ..query_api.expression import Variable
 from ..query_api.query import OutputEventsFor
 from ..utils.errors import (SiddhiAppCreationError,
                             SiddhiAppRuntimeException)
+from ..core.ledger import ledger as _ledger
 from .nfa_compiler import CompiledPatternNFA
 from .pipeline import PipelinedDeviceIngest
 
@@ -39,9 +40,11 @@ GROW_START = 8          # initial keyed-lane capacity (doubles on demand)
 def _record_block(rt_obj, prof, disp0: int, ticks0: int, stream: str,
                   batch: int, junction=None, telemetry=None) -> None:
     """Per-ingest-block accounting shared by every device runtime: the
-    profiler's dispatches-per-block gauge (when profiling is on) plus a
-    flight-recorder ring record (core/flight.py, always-cheap)."""
+    profiler's dispatches-per-block gauge (when profiling is on), the
+    latency ledger's per-app stage fold + SLO evaluation (core/ledger.py,
+    always-cheap), plus a flight-recorder ring record (core/flight.py)."""
     from ..core.flight import flight
+    from ..core.ledger import ledger
     from ..core.profiling import rim_stats
     d = prof.total_dispatches() - disp0 if prof.enabled else 0
     t = prof.total_scan_ticks() - ticks0 if prof.enabled else 0
@@ -50,10 +53,19 @@ def _record_block(rt_obj, prof, disp0: int, ticks0: int, stream: str,
         # this ingest block cost (the siddhi_app_dispatches_per_block
         # gauge)
         prof.record_app_block(rt_obj.app_name, d)
+    app = getattr(rt_obj.qr, "app_runtime", None)
     fl = flight()
+    # per-block stage waterfall: bank the stage deltas since this
+    # runtime's previous block for the per-app histograms, evaluate the
+    # app's SLO (an SLO001 bundle fires here on sustained breach), and
+    # keep the row for the flight record below (only built when the
+    # flight ring will actually store it)
+    led = ledger()
+    ledger_row = led.note_block(rt_obj.app_name, rt_obj, runtime=app,
+                                want_row=fl.enabled) \
+        if led.enabled else None
     if not fl.enabled:
         return
-    app = getattr(rt_obj.qr, "app_runtime", None)
     sched = getattr(app.app_ctx, "scheduler", None) if app is not None \
         else None
     if junction is None and app is not None:
@@ -61,6 +73,8 @@ def _record_block(rt_obj, prof, disp0: int, ticks0: int, stream: str,
     fuser = getattr(app, "_egress_fuser", None) if app is not None else None
     extra = ({"egress_bytes": fuser.last_slab_bytes}
              if fuser is not None and fuser.last_slab_bytes else None)
+    if ledger_row:
+        extra = dict(extra or {}, ledger=ledger_row)
     # rim-vs-kernel ms split: delta of the always-on host-rim clock (and,
     # when profiling is on, the kernel dispatch clock) since this
     # runtime's previous block — per-block attribution for the ring
@@ -338,8 +352,10 @@ class DevicePatternRuntime:
                            else np.zeros(n, np.float32))
         ts_arr = np.asarray(data.timestamps, np.int64)
         codes = np.full(n, stream_code, np.int32)
-        h = self.nfa.dispatch_events(pids, cols, ts_arr,
-                                     stream_codes=codes, pad_t_pow2=True)
+        with _ledger().span("device"):
+            h = self.nfa.dispatch_events(pids, cols, ts_arr,
+                                         stream_codes=codes,
+                                         pad_t_pow2=True)
         self._inflight.append(h)
         # retire down to the pipeline depth: with depth 0 this is the old
         # synchronous behavior (matches delivered before ingest returns);
@@ -361,7 +377,8 @@ class DevicePatternRuntime:
         replay it and every later in-flight chunk), decode columnar,
         emit."""
         h = self._inflight.popleft()
-        pids, ts, cols = self.nfa.retire_events(h)
+        with _ledger().span("device"):
+            pids, ts, cols = self.nfa.retire_events(h)
         if self._telemetry_sink is not None and \
                 self.nfa.last_telemetry is not None:
             self._telemetry_sink.update_nfa(
@@ -381,8 +398,9 @@ class DevicePatternRuntime:
             for e in pending:
                 while True:
                     pre_carry, pre_base = self.nfa.carry, self.nfa.base_ts
-                    r = self.nfa.replay_block(e)
-                    pids, ts, cols = self.nfa.retire_events(r)
+                    with _ledger().span("device"):
+                        r = self.nfa.replay_block(e)
+                        pids, ts, cols = self.nfa.retire_events(r)
                     if self.nfa.last_dropped_total <= self._dropped_seen:
                         break
                     self.nfa.carry = pre_carry
@@ -414,6 +432,9 @@ class DevicePatternRuntime:
         if not len(ts):
             return
         names = [o[0] for o in self.nfa.select_outputs]
+        # no ledger span here: every call site sits under the pipeline's
+        # "decode" span already (pipeline.py _submit/flush), and the
+        # downstream head.process work carries its own nested spans
         with trace_span("match.scatter", n=int(len(ts))):
             self.head.process(EventChunk.from_columns(names, ts, cols))
 
@@ -674,7 +695,8 @@ class DeviceWindowedAggRuntime(PipelinedDeviceIngest):
             ts64 = np.zeros(block["__ts"].shape, np.int64)
             ts64[lanes, rows] = src
             block["__ts64"] = ts64
-        outs = self.cwa.process_block(block)
+        with _ledger().span("device"):
+            outs = self.cwa.process_block(block)
         token = None
         if self._fuser is not None:
             # outputs ride the app's per-ingest-block slab: one shared
@@ -698,7 +720,8 @@ class DeviceWindowedAggRuntime(PipelinedDeviceIngest):
         if work.get("fuse") is not None:
             outs = work["fuse"].fetch()
         else:
-            outs = [np.asarray(o) for o in outs]
+            with _ledger().span("egress_d2h"):
+                outs = [np.asarray(o) for o in outs]
         sums = outs[0]
         counts = outs[1]
         mins = outs[2] if len(outs) > 2 else None
@@ -870,7 +893,8 @@ class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
                                       self._grow_lanes)
         else:
             lanes = np.zeros(len(data), np.int64)
-        work = self.cga.dispatch(lanes, data)
+        with _ledger().span("device"):
+            work = self.cga.dispatch(lanes, data)
         if work is None:
             return
         self._submit(work)
@@ -1182,7 +1206,9 @@ class DeviceFilterRuntime(PipelinedDeviceIngest):
         ts[:n] = (ts_arr - ts_arr[0]).astype(np.int32)
         valid = np.zeros(n_pad, bool)
         valid[:n] = True
-        ok, outs = self._program(cols, jnp.asarray(ts), jnp.asarray(valid))
+        with _ledger().span("device"):
+            ok, outs = self._program(cols, jnp.asarray(ts),
+                                     jnp.asarray(valid))
         token = None
         if self._fuser is not None:
             # mask + device columns ride the app's per-ingest-block slab
@@ -1207,8 +1233,9 @@ class DeviceFilterRuntime(PipelinedDeviceIngest):
             ok = fetched[0][:n]
             outs = fetched[1:]
         else:
-            ok = np.asarray(work["ok"])[:n]
-            outs = [np.asarray(o) for o in outs]
+            with _ledger().span("egress_d2h"):
+                ok = np.asarray(work["ok"])[:n]
+                outs = [np.asarray(o) for o in outs]
             if prof.enabled:
                 prof.record_d2h("filter.program", ok.nbytes + sum(
                     getattr(o, "nbytes", 0) for o in outs))

@@ -175,6 +175,8 @@ class SiddhiService:
             return self._send_metrics(h)
         if parts == ["stats"]:
             return h._send(200, self._stats_json())
+        if parts == ["slo"]:
+            return h._send(200, self._slo_json())
         if len(parts) == 4 and parts[:2] == ["siddhi", "apps"] and \
                 parts[3] == "errors":
             rt = self.manager.get_siddhi_app_runtime(parts[2])
@@ -216,6 +218,8 @@ class SiddhiService:
         surfaced here too: ``status`` becomes "degraded" while any
         @Async buffer sits above its high watermark or a dispatch-storm
         watchdog incident (WD0xx) is on record."""
+        from ..core.ledger import ledger
+        led = ledger()
         apps, ready, degraded = {}, True, False
         for name, rt in self.manager.runtimes.items():
             sinks = {}
@@ -240,6 +244,12 @@ class SiddhiService:
             wd = getattr(rt, "watchdog", None)
             if wd is not None and wd.incidents:
                 doc["incidents"] = list(wd.incidents)
+                degraded = True
+            if led.slo_breached(name):
+                # sustained @app:slo breach (core/ledger.py): the SLO001
+                # bundle is already on the incident bus; health turns
+                # degraded until the burn rate recovers
+                doc["slo_breached"] = True
                 degraded = True
             apps[name] = doc
         return {"status": "degraded" if degraded else "up",
@@ -272,7 +282,8 @@ class SiddhiService:
         h.wfile.write(body)
 
     def _stats_json(self) -> dict:
-        from ..core.profiling import profiler
+        from ..core.ledger import ledger
+        from ..core.profiling import profiler, rim_stats
         apps = {}
         for name, rt in self.manager.runtimes.items():
             if rt.app_ctx.statistics_manager is None:
@@ -288,5 +299,26 @@ class SiddhiService:
                 plan = getattr(rt.analysis, "plan", None)
                 if plan is not None:
                     doc["plan"] = plan.as_dict()
+            doc["ledger"] = ledger().snapshot(app=name)
             apps[name] = doc
-        return {"apps": apps, "kernels": profiler().snapshot()}
+        # process-global surfaces, mirrored from rt.statistics so the
+        # three snapshot surfaces (/metrics, rt.statistics, here) agree
+        return {"apps": apps, "kernels": profiler().snapshot(),
+                "rim": rim_stats().snapshot()}
+
+    def _slo_json(self) -> dict:
+        """Per-app SLO posture + stream lag watermarks (the SLO engine's
+        dedicated read surface; /metrics carries the same numbers as
+        gauges)."""
+        from ..core.ledger import ledger
+        led = ledger()
+        snap = led.snapshot()
+        apps = {}
+        for name, rt in self.manager.runtimes.items():
+            entry = dict(snap["apps"].get(name, {}))
+            cfg = getattr(rt, "slo_config", None)
+            if cfg is not None and "slo" not in entry:
+                entry["slo"] = {"config": cfg.as_dict()}
+            apps[name] = entry
+        return {"enabled": snap["enabled"], "apps": apps,
+                "stage_seconds": snap["stage_seconds"]}

@@ -78,6 +78,37 @@ def test_bench_smoke_runs_clean():
     assert fsm["bundle_ring_blocks"] > 0
     assert fsm["telemetry_gate_pass"] > 0
     assert 0.0 <= fsm["overhead_pct"] < 5.0
+    # latency ledger (round 12): waterfall stage-sum reconciles against
+    # the independent e2e wall clock, a forced @app:slo breach round-trips
+    # an SLO001 bundle with waterfall evidence, and the always-on ledger's
+    # per-block overhead stays bounded (asserted < 5% inside the smoke)
+    lsm = out["ledger_smoke"]
+    assert 0.3 <= lsm["waterfall_coverage_p50"] <= 2.5
+    assert lsm["waterfall_attributed_p50_ms"] > 0
+    assert lsm["slo_bundle_id"].startswith("inc-")
+    assert lsm["slo_bundle_code"] == "SLO001"
+    assert lsm["slo_waterfall_stages"] > 0
+    assert 0.0 <= lsm["overhead_pct"] < 5.0
+
+
+def test_fail_on_p99_gate():
+    """--fail-on-p99 on the waterfall phase: an impossible threshold
+    must exit 1 with the FAIL line; a generous one must pass rc 0."""
+    args = ["--phase", "waterfall", "--wf-blocks", "6",
+            "--wf-chunk", "512"]
+    env = {"JAX_PLATFORMS": "cpu"}
+    res = _run(args + ["--fail-on-p99", "0.000001"], env_extra=env)
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "[bench] FAIL" in res.stderr
+    assert "--fail-on-p99" in res.stderr
+    # the phase still printed its JSON before the gate tripped
+    wf = json.loads(res.stdout.strip().splitlines()[-1])
+    assert wf["e2e_p99_ms"] > 0
+
+    res = _run(args + ["--fail-on-p99", "1e9"], env_extra=env)
+    assert res.returncode == 0, res.stdout + res.stderr
+    wf = json.loads(res.stdout.strip().splitlines()[-1])
+    assert wf["waterfall"] and wf["coverage_p50"] > 0
 
 
 def test_bench_skips_on_unreachable_backend():

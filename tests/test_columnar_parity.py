@@ -177,3 +177,48 @@ def test_legacy_shim_materializes_lazily_and_once():
     assert rim_stats().events_materialized == r0 + n, \
         "re-iterating a LazyEvents view re-materialized its Events"
     assert all(isinstance(e.timestamp, int) for e in events)
+
+
+def _lazy_view(n=8):
+    """A pending LazyEvents over a small chunk built straight from
+    columns (no engine run needed for sequence-protocol edges)."""
+    from siddhi_tpu.core.event import EventChunk, LazyEvents
+    cols = {"symbol": np.asarray(["S%d" % i for i in range(n)], object),
+            "price": np.arange(n, dtype=np.float64)}
+    chunk = EventChunk.from_columns(["symbol", "price"],
+                                    np.arange(n, dtype=np.int64), cols)
+    return LazyEvents(chunk)
+
+
+def test_lazy_events_sequence_protocol_edges():
+    n = 8
+    lazy = _lazy_view(n)
+    r0 = rim_stats().events_materialized
+    # len/bool/repr are delivery-path operations: none may materialize
+    assert len(lazy) == n and bool(lazy)
+    assert repr(lazy) == f"LazyEvents(n={n}, pending)"
+    assert rim_stats().events_materialized == r0, \
+        "len/bool/repr on a pending view built Events"
+    # element access materializes exactly once; the counter moves by n
+    assert lazy[0].data[0] == "S0"
+    assert rim_stats().events_materialized == r0 + n
+    # negative indices and slices behave like the list they stand for
+    assert lazy[-1].data[0] == "S%d" % (n - 1)
+    assert [e.data[0] for e in lazy[2:5]] == ["S2", "S3", "S4"]
+    assert [e.data[0] for e in lazy[::-1]][0] == "S%d" % (n - 1)
+    with np.testing.assert_raises(IndexError):
+        lazy[n]
+    # iteration after materialization reuses the same Event objects
+    assert list(lazy)[0] is lazy[0]
+    assert rim_stats().events_materialized == r0 + n, \
+        "slices / re-iteration after materialize re-built Events"
+    assert repr(lazy) == f"LazyEvents(n={n}, materialized={n})"
+
+
+def test_lazy_events_empty_view():
+    lazy = _lazy_view(0)
+    r0 = rim_stats().events_materialized
+    assert len(lazy) == 0 and not lazy
+    assert list(lazy) == []
+    assert lazy[0:3] == []
+    assert rim_stats().events_materialized == r0

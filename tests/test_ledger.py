@@ -1,0 +1,396 @@
+"""Latency ledger, lag watermarks and the SLO engine (core/ledger.py).
+
+Covers: nest-aware exclusive-time spans, the SIDDHI_TPU_LEDGER kill
+switch, per-block folds into per-app histograms, event-time lag
+watermarks, @app:slo parsing + burn-rate evaluation, the SLO001
+incident bundle with waterfall evidence, the REST/statistics surfaces,
+and the SA07x analyzer diagnostics.
+"""
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from siddhi_tpu import SiddhiManager, StreamCallback  # noqa: E402
+from siddhi_tpu.core.flight import flight  # noqa: E402
+from siddhi_tpu.core.ledger import (LEDGER_ENV, STAGES,  # noqa: E402
+                                    LatencyLedger, SloConfig, ledger,
+                                    ledger_enabled)
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    """Ledger and flight recorder are process-global; isolate each test
+    and point the bundle dir at tmp."""
+    monkeypatch.setenv("SIDDHI_TPU_FLIGHT_DIR", str(tmp_path / "bundles"))
+    ledger().reset()
+    flight().reset()
+    yield
+    ledger().reset()
+    flight().reset()
+
+
+# ------------------------------------------------------------------ spans
+
+def test_span_records_exclusive_time():
+    led = LatencyLedger()
+    with led.span("dispatch"):
+        time.sleep(0.002)
+        with led.span("device"):
+            time.sleep(0.005)
+        time.sleep(0.002)
+    ns = led.stage_ns()
+    # device gets its own elapsed; dispatch gets only the surrounding
+    # host time — NOT dispatch+device double counted
+    assert ns["device"] >= 4_000_000
+    assert 2_000_000 <= ns["dispatch"] < ns["device"]
+    total = ns["dispatch"] + ns["device"]
+    assert total >= 8_000_000
+
+
+def test_span_nesting_three_deep():
+    led = LatencyLedger()
+    with led.span("dispatch"):
+        with led.span("decode"):
+            with led.span("publish"):
+                time.sleep(0.003)
+    ns = led.stage_ns()
+    assert ns["publish"] >= 2_500_000
+    # outer spans only carry their own overhead, not the child's time
+    assert ns["decode"] < ns["publish"]
+    assert ns["dispatch"] < ns["publish"]
+
+
+def test_kill_switch_disables_spans_and_blocks(monkeypatch):
+    monkeypatch.setenv(LEDGER_ENV, "0")
+    assert not ledger_enabled()
+    led = LatencyLedger()
+    with led.span("device"):
+        time.sleep(0.001)
+    assert led.stage_ns()["device"] == 0
+
+    class Owner:
+        pass
+
+    assert led.note_block("a", Owner()) is None
+    monkeypatch.setenv(LEDGER_ENV, "1")
+    assert ledger_enabled()
+
+
+def test_record_clamps_negative():
+    led = LatencyLedger()
+    led.record("queue", -50)
+    assert led.stage_ns()["queue"] == 0
+
+
+# ------------------------------------------------------------- note_block
+
+class _Owner:
+    pass
+
+
+def test_note_block_folds_deltas_into_histograms():
+    led = LatencyLedger()
+    o = _Owner()
+    assert led.note_block("app1", o) is None     # first call: baseline
+    led.record("device", 3_000_000)
+    led.record("ingress", 1_000_000)
+    row = led.note_block("app1", o)
+    assert row == {"device": 3.0, "ingress": 1.0}
+    snap = led.snapshot(app="app1")
+    stages = snap["apps"]["app1"]["stages_ms"]
+    assert stages["device"]["count"] == 1
+    assert stages["total"]["count"] == 1
+    assert abs(stages["device"]["mean"] - 3.0) < 0.5
+    assert snap["apps"]["app1"]["last_block_ms"]["device"] == 3.0
+
+
+def test_note_block_row_skipped_when_not_wanted():
+    led = LatencyLedger()
+    o = _Owner()
+    led.note_block("app1", o)
+    led.record("device", 2_000_000)
+    assert led.note_block("app1", o, want_row=False) is None
+    # ... but the histogram fold still happened
+    stages = led.snapshot(app="app1")["apps"]["app1"]["stages_ms"]
+    assert stages["device"]["count"] == 1
+
+
+def test_deferred_fold_drains_on_every_read_surface():
+    led = LatencyLedger()
+    o = _Owner()
+    led.note_block("a", o)
+    for _ in range(5):
+        led.record("device", 1_000_000)
+        led.note_block("a", o)
+    # buffered, then folded lazily by prometheus_lines
+    lines = led.prometheus_lines()
+    assert any(l.startswith("siddhi_ledger_stage_latency_ms") and
+               'app="a"' in l for l in lines)
+    assert led.snapshot(app="a")["apps"]["a"]["stages_ms"][
+        "device"]["count"] == 5
+
+
+# ------------------------------------------------------- lag watermarks
+
+def test_note_ingress_lag_watermark():
+    led = LatencyLedger()
+    led.note_ingress("app1", "S", event_ts_ms=1_000,
+                     now_ms=1_750.0, dur_ns=10_000)
+    snap = led.snapshot(app="app1")
+    lag = snap["apps"]["app1"]["lag"]["S"]
+    assert lag["lag_ms"] == 750.0
+    assert lag["processing_lag_ms"] >= 0
+    assert led.stage_ns()["ingress"] == 10_000
+    lines = led.prometheus_lines()
+    assert any(l.startswith("siddhi_event_time_lag_ms") and "750" in l
+               for l in lines)
+    assert any(l.startswith("siddhi_processing_lag_ms") for l in lines)
+
+
+# ------------------------------------------------------------ SLO config
+
+def test_slo_config_from_annotation():
+    from siddhi_tpu.query_api.annotation import Annotation
+    ann = (Annotation("app:slo")
+           .element("latency.p99.ms", "250")
+           .element("lag.ms", "1500")
+           .element("window.blocks", "32")
+           .element("breach.blocks", "5"))
+    cfg = SloConfig.from_annotation(ann)
+    assert cfg.latency_p99_ms == 250.0
+    assert cfg.lag_ms == 1500.0
+    assert cfg.window_blocks == 32
+    assert cfg.breach_blocks == 5
+
+
+def test_slo_config_tolerates_malformed_values():
+    from siddhi_tpu.query_api.annotation import Annotation
+    ann = (Annotation("app:slo")
+           .element("latency.p99.ms", "fast")
+           .element("window.blocks", "-3"))
+    cfg = SloConfig.from_annotation(ann)
+    assert cfg.latency_p99_ms is None          # malformed -> default
+    assert cfg.window_blocks == 128
+    assert cfg.breach_blocks == 3
+
+
+def test_slo_breach_needs_consecutive_blocks():
+    led = LatencyLedger()
+    led.register_slo("a", SloConfig(latency_p99_ms=0.001,
+                                    window_blocks=8, breach_blocks=3))
+    o = _Owner()
+    led.note_block("a", o)
+    transitions = []
+    for _ in range(8):
+        led.record("device", 5_000_000)        # 5 ms >> 0.001 ms target
+        st = led._slo["a"]
+        before = st.breached
+        led.note_block("a", o)
+        if st.breached and not before:
+            transitions.append(st.consecutive)
+    assert led.slo_breached("a")
+    assert len(transitions) == 1               # one transition, once
+    st = led._slo["a"]
+    assert st.breach_total == 1
+    assert st.burn_latency > 1.0
+
+
+def test_slo_recovery_clears_breach():
+    led = LatencyLedger()
+    led.register_slo("a", SloConfig(latency_p99_ms=1e9,
+                                    window_blocks=8, breach_blocks=1))
+    st = led._slo["a"]
+    st.breached = True
+    st.consecutive = 3
+    assert st.observe(0.5, None) is False      # under target
+    assert not st.breached
+    assert st.consecutive == 0
+
+
+def test_slo_breach_emits_slo001_bundle_with_waterfall():
+    led = ledger()
+    led.register_slo("appX", SloConfig(latency_p99_ms=0.000001,
+                                       window_blocks=8, breach_blocks=2))
+    o = _Owner()
+    led.note_block("appX", o)
+    for _ in range(8):
+        led.record("device", 2_000_000)
+        led.record("decode", 500_000)
+        led.note_block("appX", o)
+    assert led.slo_breached("appX")
+    incs = [i for i in flight().incidents() if i["kind"] == "slo_breach"]
+    assert len(incs) == 1
+    bundle = flight().bundle(incs[0]["id"])
+    det = bundle["detail"]
+    assert det["code"] == "SLO001"
+    assert det["slo"]["latency.p99.ms"] == 0.000001
+    assert det["observed"]["breached"] is True
+    # the breach ships its own waterfall evidence
+    assert det["waterfall"]["device"] == 2.0
+    assert det["waterfall"]["decode"] == 0.5
+    assert det["stage_summary_ms"]["device"]["count"] >= 1
+
+
+# -------------------------------------------------- runtime integration
+
+def test_app_slo_annotation_registers_and_drops():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "@app:name('sloapp') "
+        "@app:slo(latency.p99.ms='250', lag.ms='1500') "
+        "define stream S (v float); "
+        "@info(name='q') from S[v > 0.0] select v insert into Out;")
+    assert rt.slo_config is not None
+    assert rt.slo_config.latency_p99_ms == 250.0
+    assert "sloapp" in ledger()._slo
+    rt.start()
+    rt.shutdown()
+    assert "sloapp" not in ledger()._slo        # drop_app on shutdown
+
+
+def test_engine_block_produces_full_waterfall():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "@app:name('wfapp') "
+        "define stream S (sym string, price float); "
+        "@info(name='q') from S[price > 0.0] "
+        "select sym, price insert into Out;")
+    got = []
+    rt.add_callback("Out", StreamCallback(lambda evs: got.append(len(evs))))
+    rt.start()
+    h = rt.get_input_handler("S")
+    cols = {"sym": np.asarray(["A"] * 16, object),
+            "price": np.arange(1.0, 17.0)}
+    for i in range(4):
+        h.send_batch(cols, 1_000 + i * 16 + np.arange(16, dtype=np.int64))
+    rt.flush()
+    snap = rt.statistics
+    lg = snap["ledger"]
+    assert lg["enabled"]
+    stages = lg["apps"]["wfapp"]["stages_ms"]
+    # ingress + dispatch + device all saw blocks (first block is the
+    # delta baseline, so count >= 2)
+    for stage in ("ingress", "dispatch", "device", "total"):
+        assert stages[stage]["count"] >= 2, (stage, stages)
+    assert lg["apps"]["wfapp"]["lag"]["S"]["lag_ms"] is not None
+    last = lg["apps"]["wfapp"]["last_block_ms"]
+    assert last.get("device", 0) > 0
+    # the flight ring rows carry the per-block waterfall
+    rows = [r for r in flight().ring() if r["app"] == "wfapp"
+            and "ledger" in r]
+    assert rows and rows[-1]["ledger"].get("device", 0) > 0
+    rt.shutdown()
+
+
+def test_ledger_kill_switch_end_to_end(monkeypatch):
+    monkeypatch.setenv(LEDGER_ENV, "0")
+    ledger().reset()
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "@app:name('offapp') "
+        "define stream S (v float); "
+        "@info(name='q') from S[v > 0.0] select v insert into Out;")
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(3):
+        h.send([float(i + 1)])
+    rt.flush()
+    snap = rt.statistics["ledger"]
+    assert snap["enabled"] is False
+    assert all(v == 0 for v in snap["stage_seconds"].values())
+    assert "offapp" not in snap["apps"] or not snap["apps"]["offapp"].get(
+        "stages_ms")
+    rt.shutdown()
+
+
+# ----------------------------------------------------------- REST + /slo
+
+def _rest(method, url, payload=None):
+    data = None
+    if payload is not None:
+        data = (payload if isinstance(payload, str)
+                else json.dumps(payload)).encode()
+    req = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read().decode())
+
+
+def test_rest_slo_surface_and_health_degradation():
+    from siddhi_tpu.service.rest import SiddhiService
+    svc = SiddhiService(port=0).start()
+    base = f"http://127.0.0.1:{svc.port}"
+    try:
+        _rest("POST", f"{base}/siddhi/artifact/deploy",
+              "@app:name('slorest') "
+              "@app:slo(latency.p99.ms='0.000001', window.blocks='8', "
+              "breach.blocks='2') "
+              "define stream S (v float); "
+              "@info(name='q') from S[v > 0.0] select v insert into Out;")
+        for i in range(12):
+            _rest("POST", f"{base}/siddhi/apps/slorest/streams/S",
+                  [{"data": [float(j + 1)]} for j in range(4)])
+        svc.manager.get_siddhi_app_runtime("slorest").flush()
+        slo = _rest("GET", f"{base}/slo")
+        assert slo["enabled"]
+        app_slo = slo["apps"]["slorest"]["slo"]
+        assert app_slo["config"]["latency.p99.ms"] == 0.000001
+        assert app_slo["breached"] is True
+        assert app_slo["burn_rate"]["latency_p99"] > 1.0
+        health = _rest("GET", f"{base}/health")
+        assert health["apps"]["slorest"]["slo_breached"] is True
+        assert health["status"] == "degraded"
+        # burn-rate gauges ride /metrics
+        req = urllib.request.Request(f"{base}/metrics")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            text = r.read().decode()
+        assert "siddhi_slo_burn_rate" in text
+        assert 'siddhi_slo_breach_active{app="slorest"} 1' in text
+        assert "siddhi_ledger_stage_seconds_total" in text
+    finally:
+        svc.stop()
+
+
+# ------------------------------------------------------- SA07x analyzer
+
+def test_analyzer_sa070_invalid_slo():
+    from siddhi_tpu.analysis import analyze
+    res = analyze(
+        "@app:name('a') @app:slo(latency.p99.ms='fast') "
+        "define stream S (v float); "
+        "@info(name='q') from S[v > 0.0] select v insert into Out;")
+    assert any(d.code == "SA070" for d in res.diagnostics)
+
+
+def test_analyzer_sa071_unknown_option():
+    from siddhi_tpu.analysis import analyze
+    res = analyze(
+        "@app:name('a') @app:slo(latency.p99.ms='250', latencyy='1') "
+        "define stream S (v float); "
+        "@info(name='q') from S[v > 0.0] select v insert into Out;")
+    codes = [d.code for d in res.diagnostics]
+    assert "SA071" in codes and "SA070" not in codes
+
+
+def test_analyzer_sa072_no_targets():
+    from siddhi_tpu.analysis import analyze
+    res = analyze(
+        "@app:name('a') @app:slo(window.blocks='16') "
+        "define stream S (v float); "
+        "@info(name='q') from S[v > 0.0] select v insert into Out;")
+    assert any(d.code == "SA072" for d in res.diagnostics)
+
+
+def test_analyzer_clean_slo_no_diagnostics():
+    from siddhi_tpu.analysis import analyze
+    res = analyze(
+        "@app:name('a') @app:slo(latency.p99.ms='250', lag.ms='1000') "
+        "define stream S (v float); "
+        "@info(name='q') from S[v > 0.0] select v insert into Out;")
+    assert not [d for d in res.diagnostics if d.code.startswith("SA07")]

@@ -211,3 +211,47 @@ def test_metrics_exposition_is_prometheus_clean():
                  "siddhi_nfa_gate_pass_total"):
         assert name in helps, f"missing header for {name}"
         assert name in first_sample_of, f"no samples for {name}"
+
+
+# ---------------------------------------------- rim + ledger parity
+
+def test_rim_and_ledger_parity_across_surfaces():
+    """The host-rim counters and the latency ledger must agree across
+    the three read surfaces: ``rt.statistics``, ``GET /stats`` and
+    ``GET /metrics``."""
+    from siddhi_tpu.core.profiling import rim_stats
+    svc = SiddhiService(port=0).start()
+    base = f"http://127.0.0.1:{svc.port}"
+    try:
+        _req("POST", f"{base}/siddhi/artifact/deploy", STATS_APP)
+        _req("POST", f"{base}/siddhi/apps/expoapp/streams/S",
+             [{"data": ["A", 10.0 + i]} for i in range(20)])
+        rt = svc.manager.get_siddhi_app_runtime("expoapp")
+        rt.flush()
+
+        snap = rt.statistics
+        stats = _req("GET", f"{base}/stats")
+        _, text = _raw(f"{base}/metrics")
+
+        # rim: rt.statistics["rim"] == /stats["rim"] == the live counters
+        live = rim_stats().snapshot()
+        assert snap["rim"]["events_materialized"] == \
+            stats["rim"]["events_materialized"] == \
+            live["events_materialized"]
+        assert f"siddhi_events_materialized_total " \
+               f"{live['events_materialized']}" in text
+        assert "siddhi_host_rim_seconds_total" in text
+
+        # ledger: same per-app stage histograms on both JSON surfaces
+        lg_rt = snap["ledger"]["apps"]["expoapp"]["stages_ms"]
+        lg_http = stats["apps"]["expoapp"]["ledger"]["apps"]["expoapp"][
+            "stages_ms"]
+        assert lg_rt.keys() == lg_http.keys()
+        for stage in lg_rt:
+            assert lg_rt[stage]["count"] == lg_http[stage]["count"], stage
+        assert lg_rt["device"]["count"] >= 1
+        assert "siddhi_ledger_stage_latency_ms" in text
+        assert 'siddhi_ledger_stage_seconds_total{stage="device"}' in text
+        assert "siddhi_event_time_lag_ms" in text
+    finally:
+        svc.stop()

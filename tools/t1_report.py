@@ -20,6 +20,12 @@ comparison number the tier-1 budget workflow uses.
 
 Usage:
     python tools/t1_report.py /tmp/_t1.log [-o T1_r10.json] [--top 25]
+    python tools/t1_report.py --compare T1_r11.json T1_r12.json
+
+``--compare OLD.json NEW.json`` diffs two such artifacts: per-file
+regressions beyond 2x are flagged (exit 1), new and vanished files are
+listed, and the tally deltas are printed — the round-over-round
+regression gate for the tier-1 timing budget.
 """
 from __future__ import annotations
 
@@ -103,13 +109,73 @@ def render_table(report, top=None):
     return "\n".join(out)
 
 
+#: a file is only a flagged regression when it grew beyond both the
+#: ratio and this absolute floor — 2x of 0.1 s is scheduler noise
+_COMPARE_MIN_S = 1.0
+
+
+def compare(old, new, ratio=2.0):
+    """Diff two parse_log artifacts.  Returns (lines, regressed) where
+    ``regressed`` is True when any per-file total grew > ``ratio``x
+    (above the noise floor) or a tally got worse."""
+    lines, regressed = [], False
+    of, nf = old.get("files", {}), new.get("files", {})
+    for path in sorted(set(of) | set(nf)):
+        o, n = of.get(path), nf.get(path)
+        if o is None:
+            lines.append(f"NEW      {path}  {n['total_s']:.2f}s "
+                         f"({n['n_tests']} tests)")
+            continue
+        if n is None:
+            lines.append(f"VANISHED {path}  was {o['total_s']:.2f}s "
+                         f"({o['n_tests']} tests)")
+            continue
+        os_, ns_ = o["total_s"], n["total_s"]
+        if ns_ > max(os_ * ratio, _COMPARE_MIN_S):
+            lines.append(f"SLOWER   {path}  {os_:.2f}s -> {ns_:.2f}s "
+                         f"({ns_ / os_ if os_ else float('inf'):.1f}x)")
+            regressed = True
+        elif os_ > max(ns_ * ratio, _COMPARE_MIN_S):
+            lines.append(f"faster   {path}  {os_:.2f}s -> {ns_:.2f}s")
+    ot, nt = old.get("tallies", {}), new.get("tallies", {})
+    for key in sorted(set(ot) | set(nt)):
+        a, b = ot.get(key, 0), nt.get(key, 0)
+        if a != b:
+            lines.append(f"tally    {key}: {a} -> {b}")
+            if key in ("failed", "error") and b > a:
+                regressed = True
+            if key == "passed" and b < a:
+                regressed = True
+    lines.append(f"timed    {old.get('timed_s')}s -> "
+                 f"{new.get('timed_s')}s   wall {old.get('wall_s')}s -> "
+                 f"{new.get('wall_s')}s")
+    return lines, regressed
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("log", help="pytest log (run with --durations=0)")
+    ap.add_argument("log", nargs="?",
+                    help="pytest log (run with --durations=0)")
     ap.add_argument("-o", "--out", help="write bench-style JSON artifact")
     ap.add_argument("--top", type=int, default=None,
                     help="only show the N slowest files in the table")
+    ap.add_argument("--compare", nargs=2, metavar=("OLD", "NEW"),
+                    help="diff two JSON artifacts (exit 1 on a > 2x "
+                         "per-file regression or worse tallies)")
     args = ap.parse_args(argv)
+    if args.compare:
+        with open(args.compare[0]) as f:
+            old = json.load(f)
+        with open(args.compare[1]) as f:
+            new = json.load(f)
+        lines, regressed = compare(old, new)
+        print("\n".join(lines))
+        if regressed:
+            sys.stderr.write("[t1_report] FAIL: regression vs "
+                             f"{args.compare[0]}\n")
+        return 1 if regressed else 0
+    if not args.log:
+        ap.error("a pytest log is required (or use --compare)")
     with open(args.log, errors="replace") as f:
         report = parse_log(f)
     if not report["files"]:
