@@ -17,8 +17,16 @@ Plan-level surface (PR 3) — a verifier over the *compiled* plan:
     rt.analysis.plan                              # PlanReport (PV/PC codes,
                                                   # pruned-state counts, cost)
 
+Engine self-analysis (PR 13) — the CE/LW concurrency + hot-path audit
+over siddhi_tpu's own source:
+
+    from siddhi_tpu.analysis import analyze_engine
+
+    report = analyze_engine()           # CE0xx/CE1xx, allowlist-aware
+    report.raise_if(strict=True)        # the tests/test_engine_lint gate
+
 CLI: ``python -m siddhi_tpu.analyze app.siddhi [--json] [--strict]
-[--plan]``.  Everything importable here stays jax-free; only the jaxpr
+[--plan]``; ``python -m siddhi_tpu.analyze --engine`` for the audit.  Everything importable here stays jax-free; only the jaxpr
 sanitizer (plan_verify.sanitize_runtime) imports jax, lazily.
 Diagnostic catalog: docs/analysis.md (generated from
 diagnostics.catalog_markdown()).
@@ -27,6 +35,7 @@ from .analyzer import AnalysisResult, analyze
 from .cost_model import CostReport, plan_cost
 from .diagnostics import (CATALOG, CatalogEntry, Diagnostic, Severity,
                           catalog_markdown)
+from .engine import EngineReport, analyze_engine, static_lock_edges
 from .plan_ir import AutomatonIR, PlanIR, ProgramIR, extract_plan
 from .plan_verify import (PlanReport, attach_plan_analysis, sanitize_step,
                           verify_automaton, verify_plan)
@@ -36,4 +45,5 @@ __all__ = ["analyze", "AnalysisResult", "Diagnostic", "Severity",
            "PlanIR", "AutomatonIR", "ProgramIR", "extract_plan",
            "CostReport", "plan_cost",
            "PlanReport", "verify_plan", "verify_automaton",
-           "sanitize_step", "attach_plan_analysis"]
+           "sanitize_step", "attach_plan_analysis",
+           "EngineReport", "analyze_engine", "static_lock_edges"]

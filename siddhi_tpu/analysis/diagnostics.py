@@ -332,6 +332,103 @@ CATALOG: Dict[str, CatalogEntry] = {e.code: e for e in [
        "will be compute-bound well below the ingest path's capability.",
        "Reduce condition complexity or slot width, or split the pattern "
        "across queries/chips."),
+    # ---- engine concurrency audit (analyze --engine) --------------------
+    _C("CE001", _E, "lock-order-cycle",
+       "The static lock-order graph of the engine source contains a "
+       "cycle: two (or more) locks are acquired in opposite orders on "
+       "different code paths.  Two threads interleaving those paths can "
+       "deadlock the host rim.",
+       "Break the cycle: pick one canonical order, or narrow one region "
+       "so it no longer acquires the second lock."),
+    _C("CE002", _W, "callback-under-lock",
+       "A user-supplied callback / extension hook (on_* attribute, "
+       "listener or subscriber iteration) is invoked while an engine "
+       "lock is held.  The callback can re-enter the engine and try to "
+       "take the same lock — the PR 10 circuit-breaker self-deadlock "
+       "class.",
+       "Collect pending callbacks under the lock, invoke them after "
+       "release (see CircuitBreaker._fire_pending)."),
+    _C("CE003", _W, "sleep-in-engine",
+       "time.sleep in engine code.  Sleeps are uninterruptible: a "
+       "shutdown request waits out the full remaining sleep (or the "
+       "whole backoff ladder), and under a lock they stall every other "
+       "thread.",
+       "Wait on a threading.Event with a timeout instead "
+       "(stop_event.wait(delay) returns early when shutdown sets it)."),
+    _C("CE004", _W, "join-without-timeout",
+       "A timeout-less Thread.join() inside a locked region or worker "
+       "body.  If the joined thread is wedged (or is the current thread "
+       "via a callback cycle), the join blocks forever and takes the "
+       "lock holder with it.",
+       "join(timeout=...) and handle the still-alive case (log, leak-"
+       "report, force-continue)."),
+    _C("CE005", _W, "queue-op-without-timeout",
+       "A blocking Queue.put()/get() without a timeout inside a locked "
+       "region or worker body.  A full (or empty) queue parks the "
+       "thread forever while it may be holding a lock others need — the "
+       "PR 9 forever-blocking put class.",
+       "Use timeouts (put(x, timeout=...)) with an overflow/empty "
+       "policy, or make the queue bounded-with-shedding."),
+    _C("CE006", _W, "io-under-lock",
+       "File or socket I/O (open/write/socket/urlopen/json.dump to a "
+       "file) while holding an engine lock.  I/O latency is unbounded; "
+       "every thread contending that lock inherits it.",
+       "Stage the data under the lock, do the I/O after release (see "
+       "FlightRecorder.emit: bundle built and dumped outside the "
+       "RLock)."),
+    _C("CE007", _W, "wait-without-timeout",
+       "A timeout-less Event/Condition .wait() in a worker body.  If "
+       "the notifying side dies first (or shutdown races the notify), "
+       "the worker parks forever and the thread leaks past join.",
+       "wait(timeout=...) in a loop that re-checks the predicate and "
+       "the stop flag."),
+    _C("CE008", _I, "unnamed-engine-thread",
+       "A threading.Thread/Timer is constructed without a siddhi- "
+       "prefixed name from core/threads.py.  Leaked or wedged threads "
+       "show up in dumps and the tier-1 leak sentinel as anonymous "
+       "Thread-N, unattributable to a component.",
+       "Name it via core.threads.engine_thread_name and register the "
+       "prefix in ENGINE_THREAD_PREFIXES."),
+    # ---- engine hot-path lint (@hot_path functions) ---------------------
+    _C("CE101", _W, "env-read-on-hot-path",
+       "An os.environ read (direct, or via a helper that is not one of "
+       "the verified fast-idiom readers) inside a @hot_path function.  "
+       "os.environ.get costs ~0.9 us per call (key encode + value "
+       "decode) — ~9x a plain dict read, measured in PR 12 — and these "
+       "functions run per block or per event.",
+       "Hoist the read to import/construction time, or use the "
+       "os.environ._data fast idiom (core/ledger.py ledger_enabled) "
+       "when the knob must stay flippable mid-process."),
+    _C("CE102", _W, "eager-to-events-on-hot-path",
+       "A .to_events() call inside a @hot_path function.  Materializing "
+       "per-event objects from a columnar chunk allocates one Event per "
+       "row — the PR 11 GC find; hot paths must stay columnar and only "
+       "materialize on explicitly lazy/legacy branches.",
+       "Operate on the chunk's columns, or route through LazyEvents so "
+       "materialization happens only if a consumer asks."),
+    _C("CE103", _W, "dict-per-event-on-hot-path",
+       "A dict/list comprehension or per-row dict build inside a loop "
+       "over events/rows in a @hot_path function.  One allocation per "
+       "event resurrects the per-event interpreter overhead the "
+       "columnar rim exists to avoid.",
+       "Build one columnar structure per block (arrays, or a single "
+       "dict of columns) instead of a dict per row."),
+    # ---- runtime lock-witness (SIDDHI_TPU_LOCKWITNESS=1) ----------------
+    _C("LW001", _E, "lock-order-inversion",
+       "The runtime lock-witness observed two locks acquired in "
+       "opposite orders (A->B on one thread, B->A on another, or "
+       "against the static graph).  The interleaving that deadlocks "
+       "exists; only scheduling luck has kept it latent.",
+       "Fix the acquisition order (see the incident bundle's "
+       "first/second edges and thread names); the static CE001 pass "
+       "shows every source region involved."),
+    _C("LW002", _W, "long-lock-hold",
+       "A witnessed engine lock was held longer than "
+       "SIDDHI_TPU_LOCKWITNESS_HOLD_MS (default 100 ms).  Long holds "
+       "turn the lock into a convoy: every contending thread inherits "
+       "the full hold latency.",
+       "Move slow work (I/O, device sync, callbacks) outside the lock; "
+       "the bundle names the lock and the holding thread."),
 ]}
 
 
@@ -391,6 +488,9 @@ _FAMILIES = (
     ("PV00", "Plan verifier — automaton"),
     ("PV01", "Plan verifier — jaxpr kernel sanitizer"),
     ("PC0", "Static cost model"),
+    ("CE0", "Engine concurrency audit"),
+    ("CE1", "Engine hot-path lint"),
+    ("LW0", "Runtime lock-witness"),
 )
 
 

@@ -9,6 +9,9 @@ Usage:
     python -m siddhi_tpu.analyze --catalog             # list every code
     python -m siddhi_tpu.analyze --catalog-md          # docs/analysis.md
                                                        # catalog section
+    python -m siddhi_tpu.analyze --engine              # engine
+                                                       # self-analysis
+                                                       # (CE/LW audit)
 
 Exit codes: 0 clean (infos allowed), 1 errors (or warnings under
 --strict), 2 usage error.
@@ -65,9 +68,17 @@ def main(argv=None) -> int:
                     help="emit diagnostics as a JSON array")
     ap.add_argument("--strict", action="store_true",
                     help="exit non-zero on warnings too")
-    ap.add_argument("--engine", choices=("auto", "device", "host"),
-                    help="override the engine mode assumed by the SP0xx "
-                         "performance passes")
+    ap.add_argument("--engine", nargs="?", const="self",
+                    choices=("auto", "device", "host", "self"),
+                    help="with a value (auto/device/host): override the "
+                         "engine mode assumed by the SP0xx performance "
+                         "passes.  Bare --engine (no value): run the "
+                         "engine self-analysis instead — the CE0xx "
+                         "lock-order/blocking audit and CE1xx hot-path "
+                         "lint over siddhi_tpu's own source (no app "
+                         "argument, no jax import).  Note: bare --engine "
+                         "greedily consumes a following app path; use "
+                         "--engine=auto etc. when combining with an app.")
     ap.add_argument("--plan", action="store_true",
                     help="build the runtime and run the plan-level "
                          "verifier + cost model (imports jax)")
@@ -87,6 +98,19 @@ def main(argv=None) -> int:
     if args.catalog_md:
         from .analysis import catalog_markdown
         print(catalog_markdown())
+        return 0
+    if args.engine == "self":
+        from .analysis.engine import analyze_engine
+        report = analyze_engine()
+        if args.json:
+            print(json.dumps({"ok": report.ok,
+                              "engine_audit": report.as_dicts()},
+                             indent=1))
+        else:
+            print(report.render())
+        if report.errors or report.stale_allowlist \
+                or (args.strict and report.warnings):
+            return 1
         return 0
     if not args.app:
         ap.print_usage(sys.stderr)

@@ -38,6 +38,8 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from .hotpath import hot_path
+
 log = logging.getLogger(__name__)
 
 #: Kill switch for the whole flight-recorder subsystem.
@@ -56,9 +58,35 @@ DEFAULT_KEEP = 16
 DEFAULT_QUARANTINE_BURST = 50
 
 
+# record_block asks "am I on?" once per ingest block; os.environ.get
+# pays ~0.9 us per call (key encode + value decode), so the check rides
+# the same direct-``_data`` read as core/ledger.py's ledger_enabled —
+# still re-read per call, so flipping SIDDHI_TPU_FLIGHT mid-process
+# keeps working.  Falls back to the public API if the internals move.
+_ENV_DATA = getattr(os.environ, "_data", None)
+_FLIGHT_KEY = (os.environ.encodekey(FLIGHT_ENV)
+               if _ENV_DATA is not None and hasattr(os.environ, "encodekey")
+               else FLIGHT_ENV)
+if _ENV_DATA is not None and _FLIGHT_KEY not in _ENV_DATA and \
+        FLIGHT_ENV in os.environ:
+    _ENV_DATA = None        # key codec mismatch: use the public API
+
+_PARSED: Dict[Any, bool] = {}       # raw env value -> parsed verdict
+
+
 def flight_enabled() -> bool:
-    raw = os.environ.get(FLIGHT_ENV, "").strip().lower()
-    return raw not in ("0", "false", "off", "no")
+    if _ENV_DATA is not None:
+        raw = _ENV_DATA.get(_FLIGHT_KEY)
+    else:
+        raw = os.environ.get(FLIGHT_ENV)
+    if raw is None:
+        return True
+    v = _PARSED.get(raw)
+    if v is None:
+        s = os.fsdecode(raw) if isinstance(raw, bytes) else raw
+        v = s.strip().lower() not in ("0", "false", "off", "no")
+        _PARSED[raw] = v
+    return v
 
 
 def _env_int(key: str, default: int) -> int:
@@ -121,6 +149,7 @@ class FlightRecorder:
 
     # ------------------------------------------------------------ ring
 
+    @hot_path("per-block flight-ring append")
     def record_block(self, app: str, stream: str = "", batch: int = 0,
                      dispatches: int = 0, scan_ticks: int = 0,
                      junction=None, scheduler=None,

@@ -48,6 +48,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from .hotpath import hot_path
 from .statistics import Histogram
 
 LEDGER_ENV = "SIDDHI_TPU_LEDGER"
@@ -337,6 +338,7 @@ class LatencyLedger:
                 h = self._hist.setdefault((app, stage), Histogram())
         return h
 
+    @hot_path("per-block stage-delta banking + SLO evaluation")
     def note_block(self, app: str, owner, runtime=None,
                    want_row: bool = True) -> Optional[Dict[str, float]]:
         """Bank one ingest block's stage deltas (global accumulators vs

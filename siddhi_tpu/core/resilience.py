@@ -35,7 +35,9 @@ from collections import deque
 from dataclasses import dataclass, replace
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .lockwitness import maybe_wrap
 from .statistics import Counter, Gauge
+from .threads import engine_thread_name
 
 log = logging.getLogger(__name__)
 
@@ -140,7 +142,8 @@ class CircuitBreaker:
         self._state = CLOSED
         self._failures = 0
         self._opened_at = 0.0
-        self._lock = threading.Lock()
+        self._lock = maybe_wrap(
+            threading.Lock(), "core.resilience.CircuitBreaker._lock")
         self._pending: list = []     # transitions awaiting callback
 
     @classmethod
@@ -351,7 +354,8 @@ class InMemoryErrorStore(ErrorStore):
         self.capacity = capacity
         self._entries: "deque[ErrorEntry]" = deque(maxlen=capacity)
         self._next_id = 1
-        self._lock = threading.Lock()
+        self._lock = maybe_wrap(
+            threading.Lock(), "core.resilience.InMemoryErrorStore._lock")
 
     def store(self, entry: ErrorEntry) -> int:
         with self._lock:
@@ -468,7 +472,8 @@ class SinkRetryWorker:
     def _ensure_thread(self):
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
-                target=self._run, name=f"sink-retry-{self.name}",
+                target=self._run,
+                name=engine_thread_name("siddhi-retry-", self.name),
                 daemon=True)
             self._thread.start()
 

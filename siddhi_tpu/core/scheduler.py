@@ -15,6 +15,8 @@ import heapq
 import threading
 from typing import Callable, List, Optional, Tuple
 
+from .lockwitness import maybe_wrap
+from .threads import engine_thread_name
 from .timestamp import TimestampGenerator
 
 
@@ -29,7 +31,8 @@ class Scheduler:
         self._ts_gen = ts_gen
         self._heap: List[Tuple[int, int, Callable[[int], None]]] = []
         self._seq = 0
-        self._lock = threading.RLock()
+        self._lock = maybe_wrap(
+            threading.RLock(), "core.scheduler.Scheduler._lock")
         self._timer: Optional[threading.Timer] = None
         self._stopped = False
         #: cumulative fired-target count (flight-recorder block records)
@@ -58,6 +61,7 @@ class Scheduler:
             self._timer.cancel()
         self._timer = threading.Timer(delay, self._fire)
         self._timer.daemon = True
+        self._timer.name = engine_thread_name("siddhi-sched-timer")
         self._timer.start()
 
     def _fire(self):

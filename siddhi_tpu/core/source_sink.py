@@ -24,6 +24,7 @@ from ..query_api.annotation import Annotation, find_all, find_annotation
 from ..utils.errors import (ConnectionUnavailableError, MappingFailedError,
                             SiddhiAppCreationError)
 from .event import CURRENT, Event, EventChunk, LazyEvents, dtype_for
+from .hotpath import hot_path
 from .ledger import ledger as _ledger
 from .resilience import (CircuitBreaker, RetryPolicy, SinkRetryWorker,
                          make_entry)
@@ -369,6 +370,7 @@ class Sink:
         self._retry_capacity = int(options.get("retry.queue.size", "1024"))
         self._retry_worker_inst = None
         self._retry_lock = threading.Lock()
+        self._stop_retry = threading.Event()
         self._runtime = None      # set by attach_sources_and_sinks
 
     # ---- runtime binding (error store + metrics) ----------------------
@@ -429,7 +431,11 @@ class Sink:
         delays = [0.0] + self.retry_policy.delays()
         for i, delay in enumerate(delays):
             if delay:
-                time.sleep(delay)
+                # interruptible backoff (mirrors Source.connect_with_retry):
+                # a time.sleep here pinned shutdown for the full remaining
+                # ladder — CE003's one real engine hit
+                if self._stop_retry.wait(delay):
+                    return
             try:
                 self.connect()
                 self.connected = True
@@ -438,6 +444,7 @@ class Sink:
                 log.warning("sink connect failed (attempt %d): %s", i + 1, e)
 
     def shutdown(self):
+        self._stop_retry.set()
         worker = self._retry_worker_inst
         if worker is not None:
             # graceful drain: let pending retry ladders run their natural
@@ -463,6 +470,7 @@ class Sink:
         self.publish(payload, Event(ts, row))
 
     # junction-facing
+    @hot_path("per-block egress: map + publish")
     def receive_chunk(self, chunk: EventChunk):
         cur = chunk.only(CURRENT)
         if cur.is_empty:

@@ -37,6 +37,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .threads import engine_thread_name
+
 # ------------------------------------------------------------------ histogram
 
 _SUB_BITS = 5                    # 2^5 sub-buckets per octave
@@ -439,7 +441,9 @@ class StatisticsManager:
                     if self.enabled:
                         print(json.dumps({"siddhi_stats": self.snapshot()}),
                               file=sys.stderr)
-            self._thread = threading.Thread(target=loop, daemon=True)
+            self._thread = threading.Thread(
+                target=loop, daemon=True,
+                name=engine_thread_name("siddhi-stats-reporter"))
             self._thread.start()
 
     def stop_reporting(self):

@@ -27,7 +27,10 @@ from ..utils.errors import BufferOverflowError, SiddhiAppRuntimeException
 from .context import SiddhiAppContext
 from .event import CURRENT, EXPIRED, Event, EventChunk, LazyEvents
 from .ledger import ledger as _ledger, ledger_enabled
+from .hotpath import hot_path
+from .lockwitness import maybe_wrap
 from .profiling import rim_stats
+from .threads import engine_thread_name
 from .tracing import tracer as _tracer
 
 log = logging.getLogger(__name__)
@@ -162,7 +165,8 @@ class StreamJunction:
         self._worker_threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self._drain = threading.Event()
-        self._flush_lock = threading.Lock()
+        self._flush_lock = maybe_wrap(
+            threading.Lock(), "core.stream.StreamJunction._flush_lock")
         self._configure_from_annotations()
 
     @property
@@ -217,8 +221,10 @@ class StreamJunction:
             self._stop.clear()
             self._drain.clear()
             for i in range(self.workers):
-                t = threading.Thread(target=self._worker_loop, daemon=True,
-                                     name=f"junction-{self.definition.id}-{i}")
+                t = threading.Thread(
+                    target=self._worker_loop, daemon=True,
+                    name=engine_thread_name(
+                        "siddhi-junction-", self.definition.id, i))
                 t.start()
                 self._worker_threads.append(t)
 
@@ -511,6 +517,7 @@ class StreamJunction:
         rt = getattr(self.app_ctx, "runtime", None)
         return getattr(rt, "ingest_metrics", None)
 
+    @hot_path("per-block fan-out to every subscriber")
     def _deliver(self, chunk: EventChunk):
         tr = _tracer()
         led = _LED if ledger_enabled() else None
@@ -719,6 +726,7 @@ class InputHandler:
                                for reason, c in chunk_rejects])
         self._send_chunk(chunk, t0)
 
+    @hot_path("per-block ingest core: clock observe + deliver")
     def _send_chunk(self, chunk: EventChunk, t0: int) -> None:
         """Shared chunk core: observe the clock, deliver, advance
         playback.  ``t0`` is the caller's entry stamp — everything up to

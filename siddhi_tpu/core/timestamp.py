@@ -10,6 +10,9 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from .lockwitness import maybe_wrap
+from .threads import engine_thread_name
+
 
 class TimestampGenerator:
     def __init__(self):
@@ -19,7 +22,9 @@ class TimestampGenerator:
         self._increment_ms: Optional[int] = None
         self._listeners: List[Callable[[int], None]] = []
         self._heartbeat: Optional[threading.Timer] = None
-        self._lock = threading.Lock()
+        self._stopped = False
+        self._lock = maybe_wrap(
+            threading.Lock(), "core.timestamp.TimestampGenerator._lock")
 
     # ------------------------------------------------------------ config
     def enable_playback(self, idle_time_ms: Optional[int] = None,
@@ -52,21 +57,34 @@ class TimestampGenerator:
     def _arm_heartbeat(self):
         if not self._playback or self._idle_time_ms is None:
             return
-        if self._heartbeat is not None:
-            self._heartbeat.cancel()
 
         def tick():
             with self._lock:
+                if self._stopped:
+                    return
                 self._last_event_time += (self._increment_ms or 0)
                 now = self._last_event_time
             for fn in list(self._listeners):
                 fn(now)
             self._arm_heartbeat()
-        self._heartbeat = threading.Timer(self._idle_time_ms / 1000.0, tick)
-        self._heartbeat.daemon = True
-        self._heartbeat.start()
+
+        # Timer swap rides _lock: two racing observe_event_time callers
+        # used to cancel/replace unguarded and orphan a live timer, and a
+        # tick in flight across shutdown() would re-arm forever.
+        with self._lock:
+            if self._stopped:
+                return
+            if self._heartbeat is not None:
+                self._heartbeat.cancel()
+            t = threading.Timer(self._idle_time_ms / 1000.0, tick)
+            t.daemon = True
+            t.name = engine_thread_name("siddhi-heartbeat")
+            self._heartbeat = t
+            t.start()
 
     def shutdown(self):
-        if self._heartbeat is not None:
-            self._heartbeat.cancel()
-            self._heartbeat = None
+        with self._lock:
+            self._stopped = True
+            if self._heartbeat is not None:
+                self._heartbeat.cancel()
+                self._heartbeat = None

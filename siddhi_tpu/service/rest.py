@@ -26,6 +26,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..core.runtime import SiddhiManager
+from ..core.threads import engine_thread_name
 
 
 class SiddhiService:
@@ -73,7 +74,8 @@ class SiddhiService:
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
+                                        daemon=True,
+                                        name=engine_thread_name("siddhi-rest"))
         self._thread.start()
         return self
 

@@ -382,3 +382,48 @@ class VirtualClock:
     def advance(self, dt: float) -> "VirtualClock":
         self.t += dt
         return self
+
+
+# ------------------------------------------------------------------ locks
+
+class LockOrderInversion:
+    """Seeded lock-order inversion for the runtime lock-witness
+    (core/lockwitness.py).
+
+    Two witnessed locks, two phases, fully serialized by events so the
+    scenario is deterministic and can never actually deadlock: thread 1
+    takes A then B and completes; only after it has released both does
+    thread 2 take B then A.  The interleaving that *would* deadlock
+    never runs, but the acquisition-order history is exactly the LW001
+    evidence — which is the point: the witness convicts on order, not
+    on luck.
+    """
+
+    def __init__(self, witness, name_a: str = "chaos.A",
+                 name_b: str = "chaos.B"):
+        self.witness = witness
+        self.lock_a = witness.wrap(threading.Lock(), name_a)
+        self.lock_b = witness.wrap(threading.Lock(), name_b)
+
+    def run(self, timeout: float = 5.0) -> None:
+        phase1_done = threading.Event()
+
+        def forward():            # A -> B
+            with self.lock_a:
+                with self.lock_b:
+                    pass
+            phase1_done.set()
+
+        def backward():           # B -> A, strictly after phase 1
+            if not phase1_done.wait(timeout):
+                return
+            with self.lock_b:
+                with self.lock_a:
+                    pass
+
+        t1 = threading.Thread(target=forward, name="chaos-inv-fwd")
+        t2 = threading.Thread(target=backward, name="chaos-inv-bwd")
+        t1.start()
+        t2.start()
+        t1.join(timeout)
+        t2.join(timeout)

@@ -149,3 +149,68 @@ def _refresh_conformance_map(counts):
         text = text.rstrip() + "\n\n" + block + "\n"
     with open(path, "w") as f:
         f.write(text)
+
+
+# --------------------------------------------------------------------------
+# Engine-thread leak sentinel (PR 13): every engine thread carries a
+# siddhi- prefixed name from core/threads.py, so after each test file we
+# can assert the file joined what it started.  Non-daemon leftovers are a
+# hard failure (they block interpreter exit); daemon leftovers get a
+# short grace join, then fail too — a daemon junction worker still alive
+# after its module means some shutdown() path was skipped.
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _engine_thread_leak_sentinel(request):
+    yield
+    import threading
+    import time as _time
+    from siddhi_tpu.core.threads import attribute
+
+    deadline = _time.monotonic() + 2.0
+    leftovers = [t for t in threading.enumerate()
+                 if t.name.startswith("siddhi-") and t.is_alive()]
+    while leftovers and _time.monotonic() < deadline:
+        for t in leftovers:
+            t.join(timeout=0.1)
+        leftovers = [t for t in threading.enumerate()
+                     if t.name.startswith("siddhi-") and t.is_alive()]
+    assert not leftovers, (
+        f"{request.module.__name__} leaked engine threads: "
+        + "; ".join(f"{t.name} (daemon={t.daemon}) — {attribute(t.name)}"
+                    for t in leftovers))
+
+
+# Lock-witness arming (PR 13): the chaos/resilience/overload files run
+# with the runtime lock-witness armed against the static lock graph, so
+# every tier-1 run doubles as a lock-order race regression gate.  The
+# teardown asserts the GLOBAL witness saw no inversions; seeded
+# inversion scenarios (tests/chaos.py LockOrderInversion) use private
+# LockWitness instances precisely so this gate stays meaningful.
+
+_WITNESSED_FILES = {"test_resilience", "test_overload", "test_flight"}
+_STATIC_EDGES_CACHE = []
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _lock_witness_gate(request):
+    if request.module.__name__ not in _WITNESSED_FILES:
+        yield
+        return
+    from siddhi_tpu.core import lockwitness
+    if not _STATIC_EDGES_CACHE:
+        from siddhi_tpu.analysis.engine import static_lock_edges
+        _STATIC_EDGES_CACHE.append(static_lock_edges())
+    w = lockwitness.arm(static_edges=_STATIC_EDGES_CACHE[0])
+    w.reset()
+    try:
+        yield
+        inv = w.inversions()
+        assert not inv, (
+            f"{request.module.__name__}: lock-witness observed lock-order "
+            f"inversions (LW001): {inv}")
+    finally:
+        lockwitness.disarm()
+        w.reset()

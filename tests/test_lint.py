@@ -32,6 +32,18 @@ def test_ruff_clean():
     assert res.returncode == 0, f"ruff violations:\n{res.stdout}{res.stderr}"
 
 
+def test_engine_lint_strict():
+    """The CE/LW engine self-audit rides the lint step: `analyze
+    --engine --strict` must exit 0 (clean modulo the justified
+    allowlist in analysis/engine/__init__.py).  Runs as a subprocess so
+    it also re-proves the no-jax guarantee of the analyze CLI."""
+    res = subprocess.run(
+        [sys.executable, "-m", "siddhi_tpu.analyze", "--engine", "--strict"],
+        cwd=ROOT, capture_output=True, text=True)
+    assert res.returncode == 0, (
+        f"engine audit not clean:\n{res.stdout}{res.stderr}")
+
+
 def _py_files():
     for dirpath, dirnames, filenames in os.walk(PKG):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]

@@ -1,0 +1,337 @@
+"""Runtime lock-witness (core/lockwitness.py) + PR 13 defect regressions.
+
+Covers: inversion detection (observed-order and against the static
+graph), LW002 long holds, reentrancy, the off-by-default zero-wrap
+contract, the seeded chaos inversion round-tripping through
+GET /incidents as an LW001 bundle, the armed-witness overhead smoke
+bound, and regression tests for the three auditor-surfaced defects
+fixed in this PR (sink retry sleep, heartbeat re-arm race, flight env
+read on the hot path).
+"""
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from siddhi_tpu import SiddhiManager, StreamCallback  # noqa: E402
+from siddhi_tpu.core import lockwitness  # noqa: E402
+from siddhi_tpu.core.flight import flight, flight_enabled  # noqa: E402
+from siddhi_tpu.core.lockwitness import (LockWitness,  # noqa: E402
+                                         maybe_wrap)
+from siddhi_tpu.core.source_sink import Sink  # noqa: E402
+from siddhi_tpu.core.timestamp import TimestampGenerator  # noqa: E402
+from siddhi_tpu.utils.errors import ConnectionUnavailableError  # noqa: E402
+
+from chaos import LockOrderInversion  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _witness_isolation():
+    """The module-global witness must stay disarmed and clean around
+    every test here; seeded scenarios use private instances."""
+    lockwitness.disarm()
+    lockwitness.witness().reset()
+    yield
+    lockwitness.disarm()
+    lockwitness.witness().reset()
+
+
+# ------------------------------------------------------------- detection
+
+
+def test_inversion_detected_across_threads():
+    w = LockWitness(emit_incidents=False)
+    w.arm()
+    inv = LockOrderInversion(w)
+    inv.run()
+    found = w.inversions()
+    assert len(found) == 1
+    assert found[0]["code"] == "LW001"
+    assert sorted(found[0]["first"] + found[0]["second"]) == sorted(
+        ["chaos.A", "chaos.B", "chaos.B", "chaos.A"])
+    assert found[0]["other_thread"] == "chaos-inv-fwd"
+
+
+def test_inversion_against_static_graph_single_thread():
+    """The witness convicts against the *static* graph too: one runtime
+    B->A acquisition is enough when the source proves A->B elsewhere."""
+    w = LockWitness(emit_incidents=False,
+                    static_edges={("s.A", "s.B")})
+    w.arm()
+    a = w.wrap(threading.Lock(), "s.A")
+    b = w.wrap(threading.Lock(), "s.B")
+    with b:
+        with a:
+            pass
+    found = w.inversions()
+    assert len(found) == 1
+    assert found[0]["static"] is True
+
+
+def test_consistent_order_is_clean():
+    w = LockWitness(emit_incidents=False)
+    w.arm()
+    a = w.wrap(threading.Lock(), "c.A")
+    b = w.wrap(threading.Lock(), "c.B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert w.inversions() == []
+    assert ("c.A", "c.B") in w.edges()
+
+
+def test_long_hold_reports_lw002():
+    w = LockWitness(hold_ms=5.0, emit_incidents=False)
+    w.arm()
+    lock = w.wrap(threading.Lock(), "h.L")
+    with lock:
+        time.sleep(0.03)
+    holds = w.holds()
+    assert holds and holds[0]["code"] == "LW002"
+    assert holds[0]["lock"] == "h.L"
+    assert holds[0]["held_ms"] >= 5.0
+
+
+def test_rlock_reentrancy_single_report():
+    w = LockWitness(emit_incidents=False)
+    w.arm()
+    rl = w.wrap(threading.RLock(), "r.L")
+    with rl:
+        with rl:      # reentrant: no self-edge, no imbalance
+            pass
+    assert w.edges() == {}
+    assert w.inversions() == []
+    # still usable afterwards (balanced depth)
+    with rl:
+        pass
+
+
+# ------------------------------------------------------------ off switch
+
+
+def test_maybe_wrap_is_identity_when_disarmed(monkeypatch):
+    monkeypatch.delenv("SIDDHI_TPU_LOCKWITNESS", raising=False)
+    lock = threading.Lock()
+    assert maybe_wrap(lock, "x.L") is lock
+
+
+def test_maybe_wrap_env_knob_arms(monkeypatch):
+    monkeypatch.setenv("SIDDHI_TPU_LOCKWITNESS", "1")
+    lock = threading.Lock()
+    wrapped = maybe_wrap(lock, "x.L")
+    assert wrapped is not lock
+    assert wrapped.name == "x.L"
+    with wrapped:       # protocol intact
+        pass
+
+
+def test_engine_locks_plain_by_default(monkeypatch):
+    monkeypatch.delenv("SIDDHI_TPU_LOCKWITNESS", raising=False)
+    from siddhi_tpu.core.resilience import CircuitBreaker
+    assert isinstance(CircuitBreaker()._lock, type(threading.Lock()))
+
+
+# ------------------------------------------------- LW001 incident bundle
+
+
+def _req(url):
+    with urllib.request.urlopen(url) as r:
+        return json.loads(r.read().decode())
+
+
+def test_seeded_inversion_round_trips_through_rest(tmp_path, monkeypatch):
+    monkeypatch.setenv("SIDDHI_TPU_FLIGHT_DIR", str(tmp_path / "bundles"))
+    flight().reset()
+    w = LockWitness()                 # emit_incidents=True: the real bus
+    w.arm()
+    LockOrderInversion(w).run()
+    assert w.inversions(), "seeded inversion not observed"
+
+    from siddhi_tpu.service.rest import SiddhiService
+    svc = SiddhiService(port=0).start()
+    base = f"http://127.0.0.1:{svc.port}"
+    try:
+        incs = _req(f"{base}/incidents")["incidents"]
+        ids = [i["id"] for i in incs if i["kind"] == "lock_inversion"]
+        assert ids, f"no lock_inversion incident on the bus: {incs}"
+        bundle = _req(f"{base}/incidents/{ids[0]}/bundle")
+        assert bundle["detail"]["code"] == "LW001"
+        assert bundle["detail"]["first"] == ["chaos.A", "chaos.B"]
+        assert bundle["detail"]["second"] == ["chaos.B", "chaos.A"]
+    finally:
+        svc.stop()
+        flight().reset()
+
+
+# ------------------------------------------------------- overhead smoke
+
+
+def test_witness_overhead_smoke():
+    """bench --smoke style: identical ingest work with witnessed (armed)
+    vs plain engine locks, alternated per round, GC off, medians.  The
+    armed bound here is deliberately generous for CI jitter; the
+    measured number (~1-2%) is documented in docs/robustness.md."""
+    import gc
+    import statistics as stats
+
+    app = """
+        define stream S (v float);
+        @info(name='q') from S[v > 0.5] select v insert into Out;
+    """
+
+    def build(armed):
+        if armed:
+            lockwitness.arm(hold_ms=60_000.0)
+        else:
+            lockwitness.disarm()
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(app)
+        rt.add_callback("Out", StreamCallback(lambda evs: None))
+        rt.start()
+        return m, rt, rt.get_input_handler("S")
+
+    m_wit, rt_wit, h_wit = build(True)      # locks wrapped at construction
+    m_pl, rt_pl, h_pl = build(False)        # plain locks
+    lockwitness.witness().arm()             # armed during the timed phase
+
+    batch = [[float(i % 7)] for i in range(64)]
+
+    def round_time(h):
+        t0 = time.perf_counter()
+        for row in batch:
+            h.send(row)
+        return time.perf_counter() - t0
+
+    try:
+        for row in batch:                   # warmup / trace both
+            h_wit.send(row)
+            h_pl.send(row)
+        wit_times, plain_times = [], []
+        gc.disable()
+        try:
+            for _ in range(7):              # block-paired alternation
+                plain_times.append(round_time(h_pl))
+                wit_times.append(round_time(h_wit))
+        finally:
+            gc.enable()
+        wit, plain = stats.median(wit_times), stats.median(plain_times)
+        assert wit < plain * 1.5, (
+            f"armed lock-witness overhead too high: witnessed {wit:.6f}s "
+            f"vs plain {plain:.6f}s per 64-event round")
+        assert lockwitness.witness().inversions() == []
+    finally:
+        lockwitness.disarm()
+        lockwitness.witness().reset()
+        rt_wit.shutdown()
+        rt_pl.shutdown()
+        m_wit.shutdown()
+        m_pl.shutdown()
+
+
+# ------------------------------------- regressions for PR 13 fixed defects
+
+
+def test_sink_connect_retry_is_interruptible():
+    """CE003's one real engine hit: Sink.connect_with_retry slept out
+    its whole backoff ladder through shutdown().  Now the backoff rides
+    an Event and shutdown returns promptly mid-ladder."""
+
+    class NeverUpSink(Sink):
+        def connect(self):
+            raise ConnectionUnavailableError("endpoint down")
+
+    s = NeverUpSink(stream_def=None,
+                    options={"retry.max.attempts": "6",
+                             "retry.base.delay.ms": "400",
+                             "retry.max.delay.ms": "400"},
+                    mapper=None)
+    t = threading.Thread(target=s.connect_with_retry,
+                         name="test-connect-retry")
+    t0 = time.perf_counter()
+    t.start()
+    time.sleep(0.15)                 # let it enter the backoff ladder
+    s.shutdown()
+    t.join(timeout=2.0)
+    elapsed = time.perf_counter() - t0
+    assert not t.is_alive(), "connect_with_retry ignored shutdown"
+    assert elapsed < 1.5, (
+        f"shutdown waited out the backoff ladder: {elapsed:.2f}s")
+    assert not s.connected
+
+
+def test_heartbeat_stops_after_shutdown():
+    """Pre-fix, a tick in flight across shutdown() re-armed the
+    playback heartbeat forever (the round-5 timer re-arm spin class)."""
+    g = TimestampGenerator()
+    ticks = []
+    g.add_time_change_listener(ticks.append)
+    g.enable_playback(idle_time_ms=10, increment_ms=5)
+    g.observe_event_time(1_000)
+    deadline = time.monotonic() + 2.0
+    while not ticks and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert ticks, "heartbeat never ticked"
+    g.shutdown()
+    time.sleep(0.05)                 # drain any tick already in flight
+    seen = len(ticks)
+    time.sleep(0.08)                 # several would-be intervals
+    assert len(ticks) == seen, "heartbeat re-armed after shutdown"
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        if not [t for t in threading.enumerate()
+                if t.name == "siddhi-heartbeat"]:
+            break
+        time.sleep(0.02)
+    assert not [t for t in threading.enumerate()
+                if t.name == "siddhi-heartbeat"], "heartbeat timer leaked"
+
+
+def test_heartbeat_concurrent_observe_no_orphan_timers():
+    """Pre-fix, racing observe_event_time callers cancel/replaced the
+    timer unguarded and could orphan a live timer."""
+    g = TimestampGenerator()
+    g.enable_playback(idle_time_ms=25, increment_ms=1)
+
+    def hammer(base):
+        for i in range(300):
+            g.observe_event_time(base + i)
+
+    threads = [threading.Thread(target=hammer, args=(k * 10_000,),
+                                name=f"test-observe-{k}")
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    g.shutdown()
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.name == "siddhi-heartbeat"]
+        if not alive:
+            break
+        time.sleep(0.02)
+    assert not alive, f"orphaned heartbeat timers: {alive}"
+
+
+def test_flight_enabled_fast_path_still_flippable(monkeypatch):
+    """CE101's engine hit: flight_enabled paid the ~0.9 us
+    os.environ.get on every record_block.  The fast _data read must
+    keep the runtime-flip contract."""
+    monkeypatch.delenv("SIDDHI_TPU_FLIGHT", raising=False)
+    assert flight_enabled() is True
+    monkeypatch.setenv("SIDDHI_TPU_FLIGHT", "0")
+    assert flight_enabled() is False
+    monkeypatch.setenv("SIDDHI_TPU_FLIGHT", "off")
+    assert flight_enabled() is False
+    monkeypatch.setenv("SIDDHI_TPU_FLIGHT", "1")
+    assert flight_enabled() is True
+    monkeypatch.delenv("SIDDHI_TPU_FLIGHT")
+    assert flight_enabled() is True

@@ -137,6 +137,15 @@ def compare(old, new, ratio=2.0):
             regressed = True
         elif os_ > max(ns_ * ratio, _COMPARE_MIN_S):
             lines.append(f"faster   {path}  {os_:.2f}s -> {ns_:.2f}s")
+    oe, ne = old.get("engine_lint"), new.get("engine_lint")
+    if ne is not None:
+        od = oe.get("diagnostics", 0) if oe else 0
+        nd = ne.get("diagnostics", 0)
+        if nd != od:
+            lines.append(f"engine   diagnostics: {od} -> {nd}  "
+                         f"(codes: {','.join(ne.get('codes', [])) or '-'})")
+            if nd > od:     # new CE/LW findings are a regression, full stop
+                regressed = True
     ot, nt = old.get("tallies", {}), new.get("tallies", {})
     for key in sorted(set(ot) | set(nt)):
         a, b = ot.get(key, 0), nt.get(key, 0)
@@ -150,6 +159,25 @@ def compare(old, new, ratio=2.0):
                  f"{new.get('timed_s')}s   wall {old.get('wall_s')}s -> "
                  f"{new.get('wall_s')}s")
     return lines, regressed
+
+
+def _engine_lint_summary():
+    """Snapshot of the CE/LW engine self-audit, carried in the round
+    artifact so --compare flags newly-introduced findings.  Returns
+    None (key still written, tolerated by compare) if the package is
+    not importable from here."""
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        from siddhi_tpu.analysis.engine import analyze_engine
+        rep = analyze_engine()
+    except Exception as e:
+        sys.stderr.write(f"[t1_report] engine lint skipped: {e}\n")
+        return None
+    return {"diagnostics": len(rep.diagnostics),
+            "allowlisted": len(rep.allowlisted),
+            "codes": sorted({d.code for d in rep.diagnostics})}
 
 
 def main(argv=None):
@@ -183,6 +211,7 @@ def main(argv=None):
                          "run pytest with --durations=0\n")
     print(render_table(report, top=args.top))
     if args.out:
+        report["engine_lint"] = _engine_lint_summary()
         with open(args.out, "w") as f:
             json.dump(report, f, indent=1, sort_keys=True)
             f.write("\n")
