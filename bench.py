@@ -1053,6 +1053,150 @@ def bench_oracle():
     return n / elapsed
 
 
+# --------------------------------------------------------------- mtenant
+# Cross-tenant super-dispatch (round 14, plan/xtenant.py): N small apps
+# on one backend.  A "block" here is one round-robin ingest wall — every
+# app sends one block — so dispatches/block ~O(1) in N means the packer
+# is stepping all tenants with one gang launch, while the kill switch
+# (SIDDHI_TPU_XTENANT=0) pays the legacy ~2N (step + egress per app).
+
+
+def _mtenant_app(i: int) -> str:
+    """One tiny tenant app.  The per-app threshold constant bakes a
+    DISTINCT condition program into the shared gang trace — tenants are
+    heterogeneous, not copies.  @app:pipeline('4') opts into deferred
+    retirement, which is what lets blocks from different tenants
+    accumulate into one gang flush."""
+    thr = round(0.05 * (i % 10), 2)
+    return (
+        f"@app:name('mt{i}') @app:pipeline('4') "
+        "define stream S (k int, v double); "
+        f"@info(name='q') from every e1=S[v > {thr}] -> "
+        "e2=S[v > e1.v] "
+        "select e1.v as a, e2.v as b insert into Out;")
+
+
+def _mtenant_run(n_apps: int, rounds: int, events: int, packed: bool,
+                 warm_rounds: int = 1):
+    """Feed `rounds` measured round-robin walls of one `events`-event
+    block per app; returns (per-app match tuples, dispatch delta over
+    the measured walls, walls, packer snapshot).  Same seed both modes,
+    so packed-vs-unpacked match parity is bit-exact by construction."""
+    from siddhi_tpu import SiddhiManager, StreamCallback
+    from siddhi_tpu.core.profiling import profiler
+    from siddhi_tpu.plan.xtenant import XTENANT_ENV, tenant_packer
+    prev = os.environ.get(XTENANT_ENV)
+    prev_mesh = os.environ.get("SIDDHI_TPU_MESH")
+    os.environ[XTENANT_ENV] = "1" if packed else "0"
+    # the phase measures the single-device packing layer; a host that
+    # inherits --xla_force_host_platform_device_count (the tier-1 env)
+    # would otherwise build meshed, pack-ineligible tenants
+    os.environ["SIDDHI_TPU_MESH"] = "off"
+    profiler().enable()
+    try:
+        m = SiddhiManager()
+        matches = [[] for _ in range(n_apps)]
+        rts = []
+        for i in range(n_apps):
+            rt = m.create_siddhi_app_runtime(_mtenant_app(i))
+            rt.add_callback("Out", StreamCallback(
+                lambda evs, _s=matches[i]: _s.extend(
+                    tuple(e.data) for e in evs)))
+            rt.start()
+            rts.append(rt)
+        handlers = [rt.get_input_handler("S") for rt in rts]
+        rng = np.random.default_rng(11)
+        t = [1_000_000]
+
+        def feed(n_walls):
+            for _ in range(n_walls):
+                for h in handlers:
+                    vs = rng.uniform(0.0, 1.0, events)
+                    h.send_batch(
+                        {"k": np.arange(events, dtype=np.int64) % 4,
+                         "v": vs},
+                        timestamps=t[0] + np.arange(events,
+                                                    dtype=np.int64))
+                t[0] += events
+        feed(warm_rounds)            # compiles + fills the pipelines
+        d0 = profiler().total_dispatches()
+        feed(rounds)
+        d1 = profiler().total_dispatches()
+        for rt in rts:
+            rt.flush()
+        snap = tenant_packer().snapshot() if packed else None
+        m.shutdown()
+        return matches, d1 - d0, rounds, snap
+    finally:
+        if prev is None:
+            os.environ.pop(XTENANT_ENV, None)
+        else:
+            os.environ[XTENANT_ENV] = prev
+        if prev_mesh is None:
+            os.environ.pop("SIDDHI_TPU_MESH", None)
+        else:
+            os.environ["SIDDHI_TPU_MESH"] = prev_mesh
+
+
+def bench_mtenant(n_apps_list=(1, 10, 100), rounds=4, events=8,
+                  assert_parity=True):
+    """--phase mtenant: dispatches per round-robin ingest wall vs app
+    count, packed (SIDDHI_TPU_XTENANT on) against the kill switch, with
+    bit-identical matches asserted in-phase at every N."""
+    rows = []
+    for n in n_apps_list:
+        mp, dp, walls, snap = _mtenant_run(n, rounds, events, packed=True)
+        mu, du, _, _ = _mtenant_run(n, rounds, events, packed=False)
+        if assert_parity:
+            assert sum(map(len, mp)) > 0, \
+                f"mtenant N={n}: packed run matched nothing"
+            assert mp == mu, \
+                f"mtenant N={n}: packed vs unpacked match parity FAILED"
+        rows.append({
+            "n_apps": n,
+            "packed_dispatches_per_block": round(dp / walls, 2),
+            "unpacked_dispatches_per_block": round(du / walls, 2),
+            "matches": int(sum(map(len, mp))),
+            # the packer is process-global: count only THIS phase's
+            # tenants (mtN/q labels), not leftovers from earlier phases.
+            # Bucket count is at END of run — a tenant whose slot ring
+            # grew mid-feed re-keys into its own bucket, so this can
+            # exceed the co-scheduled count the dispatch figures measured
+            "tenants": sum(1 for b in (snap["buckets"] if snap else [])
+                           for t in b["tenants"]
+                           if t.startswith("mt") and t.endswith("/q")),
+            "buckets": sum(1 for b in (snap["buckets"] if snap else [])
+                           if any(t.startswith("mt") and t.endswith("/q")
+                                  for t in b["tenants"])),
+        })
+    top = rows[-1]
+    return {
+        "mtenant": rows,
+        # the gating figure: packed dispatches/block at the largest N
+        "mtenant_dispatches_per_block":
+            top["packed_dispatches_per_block"],
+        "mtenant_apps": top["n_apps"],
+        "mtenant_matches": top["matches"],
+    }
+
+
+def _check_mtenant_dispatches(limit, mt) -> None:
+    """--fail-on-dispatches gate body for `--phase mtenant` and the full
+    run: the packed dispatches/block at the largest app count must not
+    exceed the limit (a regression means packing silently fell back to
+    per-app dispatch)."""
+    if limit is None or mt is None:
+        return
+    measured = mt.get("mtenant_dispatches_per_block")
+    if measured is not None and measured > limit:
+        sys.stderr.write(
+            f"[bench] FAIL: cross-tenant packer measured {measured} "
+            f"dispatches per ingest wall at N={mt.get('mtenant_apps')} "
+            f"apps, exceeds --fail-on-dispatches {limit} — super-"
+            f"dispatch packing regressed (see mtenant rows)\n")
+        sys.exit(1)
+
+
 def _force_cpu():
     """--smoke: pin the CPU backend even though the axon plugin
     registers from a sitecustomize hook at interpreter start with
@@ -1296,6 +1440,21 @@ def bench_smoke():
         m: {"dispatches_per_block": d_rows[m]["dispatches_per_block"],
             "matches": int(d_rows[m]["counts"].sum())}
         for m in d_rows}
+
+    # ---- cross-tenant super-dispatch (round 14): two heterogeneous
+    # tenant apps must share one gang dispatch per ingest wall — fewer
+    # dispatches than the SIDDHI_TPU_XTENANT=0 kill-switch run, with
+    # bit-identical matches (both assertions are real; bench_mtenant
+    # asserts parity in-phase)
+    mt = bench_mtenant(n_apps_list=(2,), rounds=3, events=8)
+    mt_row = mt["mtenant"][0]
+    assert mt_row["packed_dispatches_per_block"] < \
+        mt_row["unpacked_dispatches_per_block"], \
+        f"smoke mtenant FAILED: packing did not consolidate: {mt_row}"
+    assert mt_row["matches"] > 0, mt_row
+    assert mt_row["tenants"] == 2 and mt_row["buckets"] >= 1, \
+        f"smoke mtenant FAILED: tenants never packed: {mt_row}"
+    res["mtenant_smoke"] = mt_row
 
     # ---- ingest armor (round 9): SHED_OLDEST under a wedged consumer —
     # the send path must stay alive and admitted == delivered + shed
@@ -1772,6 +1931,10 @@ def main():
             print(json.dumps(_with_profile(bench_engine_absent)))
         elif phase == "overload":
             print(json.dumps(bench_overload()))
+        elif phase == "mtenant":
+            mt = bench_mtenant()
+            print(json.dumps(mt))
+            _check_mtenant_dispatches(fail_on_dispatches, mt)
         elif phase == "waterfall":
             wf = bench_waterfall(blocks=wf_blocks, chunk=wf_chunk)
             print(json.dumps(wf))
@@ -1789,6 +1952,7 @@ def main():
     eng_wagg = _run_phase("engine_wagg")
     eng_absent = _run_phase("engine_absent")
     overload = _run_phase("overload")
+    mten = _run_phase("mtenant")
     wf = _run_phase("waterfall")
     tpu_rate = thru["thru_rate"]
     p99_ms, p50_ms = lat["p99_ms"], lat["p50_ms"]
@@ -1890,6 +2054,14 @@ def main():
         # overload policy + the @quarantine validator's batch-path cost;
         # admitted == delivered + shed asserted in-phase
         "ingest_overload": overload,
+        # cross-tenant super-dispatch (round 14): dispatches per
+        # round-robin ingest wall vs app count, packed vs
+        # SIDDHI_TPU_XTENANT=0, parity asserted in-phase — future
+        # rounds gate on mtenant_dispatches_per_block
+        "mtenant_sweep": mten["mtenant"],
+        "mtenant_dispatches_per_block":
+            mten["mtenant_dispatches_per_block"],
+        "mtenant_apps": mten["mtenant_apps"],
         # latency ledger (round 12): per-stage attribution of the
         # engine-path block latency, reconciled against an independent
         # e2e wall clock (coverage = attributed / e2e at p50/p99)
@@ -1928,6 +2100,7 @@ def main():
                 f"{fail_on_dispatches} — dispatch consolidation "
                 f"regressed (see dispatch_sweep)\n")
             sys.exit(1)
+        _check_mtenant_dispatches(fail_on_dispatches, mten)
     if fail_on_rim is not None:
         rim_measured = eng.get("engine_columnar_rim_materialized")
         if rim_measured is not None and rim_measured > fail_on_rim:

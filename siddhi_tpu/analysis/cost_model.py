@@ -131,6 +131,30 @@ def stacked_bank_state_bytes(a: AutomatonIR, n_chunks: int, chunk: int,
     return n_chunks * bank_state_bytes(a, chunk, n_partitions)
 
 
+def packed_bucket_state_bytes(autos: "List[AutomatonIR]") -> int:
+    """Persistent carry bytes of one cross-tenant dispatch bucket
+    (plan/xtenant.TenantBucket): tenants keep their OWN carries — the
+    gang unrolls each tenant's step over its own arrays, padding only
+    ever happens inside a tenant's own block — so the bucket holds
+    exactly the sum of its members' individual carries.  Like stacking,
+    packing changes dispatch count, never bytes; asserted against the
+    live carries in tests/test_multitenant.py."""
+    return sum(sum(nfa_state_bytes(a).values()) for a in autos)
+
+
+def packed_bucket_egress_bytes(autos: "List[AutomatonIR]") -> int:
+    """Shared egress-slab bytes of one bucket flush: the concatenated
+    D2H slab is the per-tenant compacted buffers laid end to end (plus
+    telemetry rows when enabled) — again a pure sum, no cross-tenant
+    padding."""
+    total = 0
+    for a in autos:
+        total += nfa_egress_bytes(a)
+        if a.telemetry:
+            total += a.n_partitions * (3 * len(a.states) + 1) * I32
+    return total
+
+
 #: Measured round 6 (docs/perf_notes.md): XLA's fusion of the hoisted
 #: gate tensors back into the unrolled inner scan duplicates step
 #: intermediates ~3.2x per B-doubling (cost_analysis bytes, v5e + CPU).

@@ -96,6 +96,9 @@ class AutomatonIR:
     #                                (n_chunks when sequential, 1 stacked)
     telemetry: bool = False       # opt-in on-device state telemetry leaf
     #                               (@app:statistics(telemetry='true'))
+    packed: bool = False          # adopted by the cross-tenant packer
+    #                               (plan/xtenant.py, round 14)
+    pack_bucket: str = ""         # shape-class bucket label (e.g. S2K8P1B4)
 
     @property
     def accept(self) -> int:
@@ -115,6 +118,8 @@ class AutomatonIR:
             "simplified_conditions": self.simplified_conditions,
             "statically_dead": self.statically_dead,
             "telemetry": self.telemetry,
+            "packed": self.packed,
+            "pack_bucket": self.pack_bucket,
         }
 
 
@@ -169,7 +174,10 @@ class PlanIR:
                 f"R={a.n_rows} C={a.n_caps} within={a.within_ms} "
                 f"pruned={a.pruned_states} "
                 f"stacked={int(a.stacked)} dpb={a.dispatches_per_block} "
-                f"flags=[{','.join(flags)}]")
+                # rendered only when the cross-tenant packer adopted the
+                # automaton, so unpacked goldens stay byte-identical
+                + (f"packed={a.pack_bucket} " if a.packed else "")
+                + f"flags=[{','.join(flags)}]")
             for s in a.states:
                 extra = ""
                 if s.kind == "count":
@@ -300,7 +308,10 @@ def automaton_ir_from_nfa(nfa, query: str) -> AutomatonIR:
         egress_cap=int(getattr(nfa, "_egress_cap", 1024)),
         meshed=getattr(nfa, "mesh", None) is not None,
         batch_b=max(int(getattr(nfa, "batch_b", 1)), 1),
-        telemetry=bool(getattr(spec, "telemetry", False)))
+        telemetry=bool(getattr(spec, "telemetry", False)),
+        packed=getattr(nfa, "_tenant_bucket", None) is not None,
+        pack_bucket=getattr(getattr(nfa, "_tenant_bucket", None),
+                            "label", ""))
 
 
 def _array_bytes(obj) -> int:

@@ -42,6 +42,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -303,6 +304,174 @@ def route_rejects(junction, events_by_reason: List[Tuple[str, list]]):
         else:
             log.error("dropping %d quarantined event(s) on '%s' (%s): no "
                       "error store configured", len(events), sid, reason)
+
+
+# ------------------------------------------------------------------ fair share
+
+
+class TenantQuota:
+    """Token-bucket ingest quota for one tenant app (``@app:quota``).
+
+    ``rate`` is the sustained external-ingest budget in events/second;
+    ``burst`` is the bucket capacity (default ``2*rate``, floor 1).
+    ``admit(n)`` returns how many of the next ``n`` events may pass —
+    the ingest boundary sheds the rest (reason ``'quota'``), so one
+    greedy tenant saturating its own budget can never starve the shared
+    device of co-tenants' dispatch slots.
+
+    ``now`` is injectable for deterministic tests; production callers
+    use the monotonic clock.  ``breach`` latches per episode so the
+    flight recorder emits ONE quota_breach bundle per excursion instead
+    of one per shed chunk.
+    """
+
+    __slots__ = ("app_name", "rate", "burst", "tokens", "_last",
+                 "_lock", "breach")
+
+    def __init__(self, app_name: str, rate: float,
+                 burst: Optional[float] = None):
+        self.app_name = app_name
+        self.rate = max(float(rate), 0.0)
+        b = float(burst) if burst is not None else self.rate * 2.0
+        self.burst = max(b, 1.0)
+        self.tokens = self.burst
+        self._last: Optional[float] = None
+        self._lock = threading.Lock()
+        self.breach = False
+
+    @staticmethod
+    def from_annotation(app_name: str, ann) -> Optional["TenantQuota"]:
+        def num(key):
+            raw = ann.get(key, None)
+            if raw is None:
+                return None
+            try:
+                return float(raw)
+            except (TypeError, ValueError):
+                log.warning("@app:quota(%s=%r) is not numeric: ignored "
+                            "(see analyzer diagnostic SA064)", key, raw)
+                return None
+        pos = ann.positional()
+        rate = num("rate")
+        if rate is None and pos:
+            try:
+                rate = float(pos[0])
+            except (TypeError, ValueError):
+                rate = None
+        if rate is None or rate <= 0:
+            log.warning("@app:quota on '%s' has no positive rate: quota "
+                        "disabled (see analyzer diagnostic SA064)", app_name)
+            return None
+        return TenantQuota(app_name, rate, num("burst"))
+
+    def admit(self, n: int, now: Optional[float] = None) -> int:
+        """How many of ``n`` offered events fit the budget right now."""
+        if n <= 0:
+            return 0
+        with self._lock:
+            t = time.monotonic() if now is None else now
+            if self._last is None:
+                self._last = t
+            dt = t - self._last
+            if dt > 0:
+                self.tokens = min(self.burst, self.tokens + dt * self.rate)
+                self._last = t
+            take = int(min(n, self.tokens))
+            self.tokens -= take
+            return take
+
+    def level(self) -> float:
+        """Remaining token fraction (1.0 = idle budget, 0.0 = exhausted)
+        — the per-tenant saturation gauge on /metrics."""
+        with self._lock:
+            return self.tokens / self.burst if self.burst > 0 else 0.0
+
+
+class FairShare:
+    """Process-global fair-share registry: one ``TenantQuota`` per app
+    plus the per-tenant admitted/shed counters rendered on /metrics.
+
+    Registration rides ``@app:quota`` parsing (before junctions exist),
+    eviction rides app shutdown; the ingest boundary caches the quota
+    object at InputHandler construction, so the hot path never touches
+    this registry's lock.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._quotas: Dict[str, TenantQuota] = {}
+        self.tenant_admitted_total = Counter("tenant_admitted_total")
+        self.tenant_shed_total = Counter("tenant_shed_total")
+
+    def register(self, quota: TenantQuota) -> None:
+        with self._lock:
+            self._quotas[quota.app_name] = quota
+
+    def unregister(self, app_name: str) -> None:
+        with self._lock:
+            self._quotas.pop(app_name, None)
+
+    def quota_for(self, app_name: str) -> Optional[TenantQuota]:
+        with self._lock:
+            return self._quotas.get(app_name)
+
+    def note(self, app_name: str, admitted: int, shed: int) -> None:
+        if admitted:
+            self.tenant_admitted_total.inc(admitted, app=app_name)
+        if shed:
+            self.tenant_shed_total.inc(shed, app=app_name)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            quotas = list(self._quotas.values())
+        return {q.app_name: {"rate": q.rate, "burst": q.burst,
+                             "level": q.level(),
+                             "admitted": self.tenant_admitted_total.value(
+                                 app=q.app_name),
+                             "shed": self.tenant_shed_total.value(
+                                 app=q.app_name)}
+                for q in quotas}
+
+    def prometheus_lines(self) -> List[str]:
+        from .statistics import _fmt_labels
+        out: List[str] = []
+        with self._lock:
+            quotas = list(self._quotas.values())
+        for q in quotas:
+            lb = _fmt_labels({"app": q.app_name})
+            out.append(f"siddhi_tenant_quota_rate{lb} {q.rate:.9g}")
+            out.append(f"siddhi_tenant_quota_burst{lb} {q.burst:.9g}")
+            out.append(f"siddhi_tenant_quota_level{lb} {q.level():.9g}")
+        for lkey, v in self.tenant_admitted_total.series().items():
+            out.append(
+                f"siddhi_tenant_admitted_total{_fmt_labels(dict(lkey))} {v}")
+        for lkey, v in self.tenant_shed_total.series().items():
+            out.append(
+                f"siddhi_tenant_shed_total{_fmt_labels(dict(lkey))} {v}")
+        return out
+
+
+_FAIR_SHARE = FairShare()
+
+
+def fair_share() -> FairShare:
+    return _FAIR_SHARE
+
+
+#: HELP/TYPE headers for the fair-share series (statistics.prometheus_text)
+TENANT_TYPES = [
+    ("siddhi_tenant_quota_rate", "gauge",
+     "Configured @app:quota sustained ingest rate (events/second)"),
+    ("siddhi_tenant_quota_burst", "gauge",
+     "Configured @app:quota burst capacity (events)"),
+    ("siddhi_tenant_quota_level", "gauge",
+     "Remaining quota-bucket fraction per tenant (1 = idle budget)"),
+    ("siddhi_tenant_admitted_total", "counter",
+     "Events admitted under a tenant's fair-share quota"),
+    ("siddhi_tenant_shed_total", "counter",
+     "Events shed at the ingest boundary by fair-share quota "
+     "enforcement"),
+]
 
 
 # ------------------------------------------------------------------ watchdog

@@ -244,6 +244,21 @@ class SiddhiAppRuntime:
             from .ledger import SloConfig, ledger
             self.slo_config = SloConfig.from_annotation(slo)
             ledger().register_slo(self.name, self.slo_config)
+        # @app:quota(rate='1000', burst='2000') — fair-share ingest
+        # admission for multi-tenant deployments (core/overload.py):
+        # a token-bucket budget enforced at the InputHandler boundary,
+        # layered UNDER the per-stream @Async overload policies.  Parsed
+        # here (before _build) so junctions and input handlers see the
+        # registered quota at construction
+        self.quota = None
+        qa = find_annotation(self.app.annotations, "app:quota")
+        if qa is None:
+            qa = find_annotation(self.app.annotations, "quota")
+        if qa is not None:
+            from .overload import TenantQuota, fair_share
+            self.quota = TenantQuota.from_annotation(self.name, qa)
+            if self.quota is not None:
+                fair_share().register(self.quota)
 
     def _build(self):
         from .source_sink import attach_sources_and_sinks
@@ -507,6 +522,9 @@ class SiddhiAppRuntime:
             self.app_ctx.statistics_manager.stop_reporting()
         from .ledger import ledger
         ledger().drop_app(self.name)
+        if self.quota is not None:
+            from .overload import fair_share
+            fair_share().unregister(self.name)
         self._started = False
 
     def debug(self):

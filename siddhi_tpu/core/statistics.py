@@ -641,7 +641,7 @@ class DeviceTelemetry:
 
 def prometheus_text(managers: List[StatisticsManager],
                     kernel_profiler=None, resilience=None,
-                    ingest=None, telemetry=None) -> str:
+                    ingest=None, telemetry=None, tenants=None) -> str:
     """Full Prometheus/OpenMetrics text exposition over any number of app
     StatisticsManagers plus the (process-global) kernel profiler, the
     per-runtime ResilienceMetrics (core/resilience.py), the per-runtime
@@ -649,13 +649,14 @@ def prometheus_text(managers: List[StatisticsManager],
     holders.  Every series family gets its # HELP/# TYPE header exactly
     once, before any samples."""
     from .ledger import ledger
-    from .overload import INGEST_TYPES
+    from .overload import INGEST_TYPES, TENANT_TYPES
     from .profiling import rim_stats
     from .resilience import RESILIENCE_TYPES
+    from ..plan.xtenant import XTENANT_TYPES
     lines: List[str] = []
     for name, typ, help_ in (_TYPES + RIM_TYPES + LEDGER_TYPES +
                              TELEMETRY_TYPES + RESILIENCE_TYPES +
-                             INGEST_TYPES):
+                             INGEST_TYPES + TENANT_TYPES + XTENANT_TYPES):
         lines.append(f"# HELP {name} {help_}")
         lines.append(f"# TYPE {name} {typ}")
     lines.extend(rim_stats().prometheus_lines())
@@ -670,4 +671,8 @@ def prometheus_text(managers: List[StatisticsManager],
         lines.extend(im.prometheus_lines())
     for dt in (telemetry or []):
         lines.extend(dt.prometheus_lines())
+    for tn in (tenants or []):
+        # fair-share quotas (overload.FairShare) and the cross-tenant
+        # packer (plan/xtenant.TenantPacker): per-tenant / per-bucket
+        lines.extend(tn.prometheus_lines())
     return "\n".join(lines) + "\n"

@@ -655,6 +655,15 @@ class InputHandler:
         self.junction = junction
         self.app_ctx = app_ctx
         self.definition = junction.definition
+        # fair-share quota (@app:quota, core/overload.py) cached at
+        # construction: the registry registers during annotation parsing
+        # — before any handler exists — so the hot path below never
+        # takes the process-global FairShare lock
+        rt = getattr(app_ctx, "runtime", None)
+        self.quota = getattr(rt, "quota", None)
+        if self.quota is not None:
+            from .overload import fair_share
+            self._fair = fair_share()
 
     def send(self, data, timestamp: Optional[int] = None):
         """send(Object[]) / send(Event) / send([Event,...]) /
@@ -726,6 +735,27 @@ class InputHandler:
                                for reason, c in chunk_rejects])
         self._send_chunk(chunk, t0)
 
+    def _quota_shed(self, shed: int) -> None:
+        """Per-tenant shed accounting + ONE flight bundle per breach
+        episode (the latch resets when a send fully admits again)."""
+        qt = self.quota
+        rt = getattr(self.app_ctx, "runtime", None)
+        m = getattr(rt, "ingest_metrics", None)
+        if m is not None:
+            m.ingest_shed_total.inc(shed, stream=self.definition.id,
+                                    reason="quota")
+        if not qt.breach:
+            qt.breach = True
+            try:
+                from .flight import flight
+                flight().emit(
+                    "quota_breach", app=qt.app_name,
+                    detail={"stream": self.definition.id, "shed": shed,
+                            "rate": qt.rate, "burst": qt.burst},
+                    runtime=rt)
+            except Exception:   # noqa: BLE001 — shedding must never raise
+                log.exception("quota-breach flight emit failed")
+
     @hot_path("per-block ingest core: clock observe + deliver")
     def _send_chunk(self, chunk: EventChunk, t0: int) -> None:
         """Shared chunk core: observe the clock, deliver, advance
@@ -735,6 +765,23 @@ class InputHandler:
         if n == 0:
             _RIM.rim_ns += time.perf_counter_ns() - t0
             return
+        qt = self.quota
+        if qt is not None:
+            # fair-share admission (@app:quota): shed the tail of the
+            # chunk that exceeds this tenant's token budget — UNDER the
+            # per-stream @Async overload policies, which still apply to
+            # whatever is admitted here
+            take = qt.admit(n)
+            self._fair.note(qt.app_name, take, n - take)
+            if take < n:
+                self._quota_shed(n - take)
+                if take == 0:
+                    _RIM.rim_ns += time.perf_counter_ns() - t0
+                    return
+                chunk = chunk.mask(np.arange(n) < take)
+                n = take
+            elif qt.breach:
+                qt.breach = False     # budget recovered: episode closed
         mx = int(chunk.timestamps.max())
         self.app_ctx.timestamp_generator.observe_event_time(mx)
         now = time.perf_counter_ns()

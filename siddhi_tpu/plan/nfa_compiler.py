@@ -1583,6 +1583,7 @@ class CompiledPatternNFA:
                                 np.asarray(fresh[k])], axis=0)
              for k in self.carry})
         self.n_partitions = n_partitions
+        self._xt_rebucket()
 
     def grow_slots(self, n_slots: int) -> None:
         """Widen the K (concurrent-partials) axis: the host oracle's pending
@@ -1614,6 +1615,15 @@ class CompiledPatternNFA:
         self.carry = self._place_carry(c)
         self.spec = self.spec._replace(n_slots=n_slots)
         self._step = self._jit_step()
+        self._xt_rebucket()
+
+    def _xt_rebucket(self) -> None:
+        """Shape change (K/P growth, snapshot restore): a packed tenant
+        re-keys into the bucket matching its new shape class — its old
+        gang signatures are stale (plan/xtenant.py)."""
+        bucket = getattr(self, "_tenant_bucket", None)
+        if bucket is not None:
+            bucket.packer.rebucket(self)
 
     def max_active_slots(self) -> int:
         """Device reduction: the fullest partition's live-partial count."""
@@ -1636,6 +1646,9 @@ class CompiledPatternNFA:
         return int(jnp.min(dl)) + (self.base_ts or 0)
 
     def current_state(self) -> Dict[str, Any]:
+        bucket = getattr(self, "_tenant_bucket", None)
+        if bucket is not None:
+            bucket.sync(self)   # snapshot must see the pending block
         return {"carry": {k: np.asarray(v) for k, v in self.carry.items()},
                 "base_ts": self.base_ts,
                 "n_partitions": self.n_partitions,
@@ -1670,9 +1683,16 @@ class CompiledPatternNFA:
         if k != self.spec.n_slots:    # snapshot taken after slot growth
             self.spec = self.spec._replace(n_slots=k)
             self._step = self._jit_step()
+        self._xt_rebucket()
 
     def process_block(self, block: Dict[str, np.ndarray]):
         """Run one [P, T] packed block; returns raw match buffers."""
+        bucket = getattr(self, "_tenant_bucket", None)
+        if bucket is not None:
+            # packed tenant stepped out-of-band (timer rows, replay):
+            # its deferred block must land first — ordering, and the
+            # gang must never race a host-side carry mutation
+            bucket.sync(self)
         if self.mesh is not None and jax.process_count() > 1:
             # multiprocess jit refuses to auto-shard numpy inputs even on
             # an all-local mesh — device_put the block explicitly
@@ -1684,21 +1704,13 @@ class CompiledPatternNFA:
                                                              block)
         return mask, caps, ts, enter, seq
 
-    def egress_dispatch(self, outs):
-        """Phase 1 of the compacted egress: dispatch the device-side match
-        compaction for one block's raw outputs and start the D2H transfer
-        (copy_to_host_async), WITHOUT blocking.  Returns an opaque handle
-        for egress_retire.  Splitting dispatch from retire lets the engine
-        pipeline chunks: the ~100-300 ms tunnel round-trip of chunk N's
-        read overlaps chunk N+1's dispatch + host work (≙ the ingest/
-        compute overlap the reference gets from its @Async disruptor
-        junction, stream/StreamJunction.java:280-316)."""
-        mask, caps, ts, enter, seq = outs
-        P, T, K = mask.shape
-        R = max(int(caps.shape[-2]), 1)
-        C = max(int(caps.shape[-1]), 1)
-        if not hasattr(self, "_egress_cap"):
-            self._egress_cap = 1024
+    def _egress_pack_fn(self):
+        """The traceable match-compaction program, shared by the per-NFA
+        egress jit (egress_dispatch) and the cross-tenant gang step
+        (plan/xtenant.py) — one definition, so packed and unpacked
+        egress are bit-identical by construction."""
+        R = max(self.spec.n_rows, 1)
+        C = max(self.spec.n_caps, 1)
 
         def pack(mask, caps, ts, enter, seq, dropped, dl_st, dl, cap):
             flat = mask.reshape(-1)
@@ -1727,10 +1739,30 @@ class CompiledPatternNFA:
                 tail = tail.at[0, 2].set(dmin)
             return jnp.concatenate([rows, tail], axis=0)
 
+        return pack
+
+    def _ensure_egress_jit(self):
         if not hasattr(self, "_egress_jit"):
             from ..core.profiling import wrap_kernel
             self._egress_jit = wrap_kernel(
-                "nfa.egress_pack", jax.jit(pack, static_argnums=8))
+                "nfa.egress_pack",
+                jax.jit(self._egress_pack_fn(), static_argnums=8))
+        return self._egress_jit
+
+    def egress_dispatch(self, outs):
+        """Phase 1 of the compacted egress: dispatch the device-side match
+        compaction for one block's raw outputs and start the D2H transfer
+        (copy_to_host_async), WITHOUT blocking.  Returns an opaque handle
+        for egress_retire.  Splitting dispatch from retire lets the engine
+        pipeline chunks: the ~100-300 ms tunnel round-trip of chunk N's
+        read overlaps chunk N+1's dispatch + host work (≙ the ingest/
+        compute overlap the reference gets from its @Async disruptor
+        junction, stream/StreamJunction.java:280-316)."""
+        mask, caps, ts, enter, seq = outs
+        P, T, K = mask.shape
+        if not hasattr(self, "_egress_cap"):
+            self._egress_cap = 1024
+        self._ensure_egress_jit()
         dropped = self.carry["dropped"]
         dl_st = self.carry["slot_state"] if self.has_absent else None
         dl = self.carry.get("deadline") if self.has_absent else None
@@ -1786,7 +1818,7 @@ class CompiledPatternNFA:
             handle["cap"] = cap
             self._egress_cap = max(self._egress_cap, cap)
             mask, caps, ts, enter, seq = handle["outs"]
-            buf = np.asarray(self._egress_jit(
+            buf = np.asarray(self._ensure_egress_jit()(
                 mask, caps, ts, enter, seq, handle["dropped"],
                 handle["dl_st"], handle["dl"], cap))
             count = int(buf[-1, 0])
@@ -1983,6 +2015,12 @@ class CompiledPatternNFA:
             return {"dead": True, "pre_carry": self.carry,
                     "pre_base": self.base_ts, "base_ts": self.base_ts,
                     "ts_range": None, "block": None}
+        bucket = getattr(self, "_tenant_bucket", None)
+        if bucket is not None:
+            # a still-pending earlier block of THIS tenant must step
+            # before the rebase below mutates the carry it will read
+            # (and before two blocks of one tenant could coexist)
+            bucket.sync(self)
         if self.base_ts is None:
             self.base_ts = int(timestamps[0]) if len(timestamps) else 0
         ts_range = None
@@ -2011,6 +2049,12 @@ class CompiledPatternNFA:
                             np.asarray(timestamps), codes,
                             self.n_partitions, base_ts=self.base_ts,
                             pad_t_pow2=pad_t_pow2)
+        if bucket is not None:
+            # cross-tenant super-dispatch (plan/xtenant.py): defer the
+            # block into the tenant's bucket — the gang step runs it
+            # with every co-tenant's pending block as ONE device launch;
+            # any read of the handle forces the flush
+            return bucket.submit(self, block, ts_range)
         pre_carry, pre_base = self.carry, self.base_ts
         outs = self.process_block(block)
         h = self.egress_dispatch(outs)
@@ -2034,6 +2078,8 @@ class CompiledPatternNFA:
     def retire_events(self, h: dict):
         """Block on a dispatched handle → (pids, ts, columns) in emission
         order (columnar decode).  Sets self.last_dropped_total."""
+        if "xpend" in h:
+            h["xpend"].resolve(h)
         if h.get("dead"):
             self.last_dropped_total = 0
             if self.has_absent:

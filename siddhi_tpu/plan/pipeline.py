@@ -231,6 +231,16 @@ class EgressFuser:
             grp.entries.append(list(buffers))
             return _FuseToken(grp, len(grp.entries) - 1)
 
+    def seal_block(self) -> None:
+        """Close the open group explicitly.  The cross-tenant packer
+        (plan/xtenant.py) registers every co-scheduled tenant's buffers
+        during one gang flush and knows the block boundary exactly —
+        sealing here starts the shared slab's D2H immediately instead of
+        waiting for the next repeat registration."""
+        with self._lock:
+            if self._current.entries:
+                self._rotate()
+
 
 def egress_fuser_for(app) -> Optional[EgressFuser]:
     """The app runtime's shared fuser (lazily created), or None when

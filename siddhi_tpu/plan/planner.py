@@ -73,6 +73,13 @@ def _record_block(rt_obj, prof, disp0: int, ticks0: int, stream: str,
     fuser = getattr(app, "_egress_fuser", None) if app is not None else None
     extra = ({"egress_bytes": fuser.last_slab_bytes}
              if fuser is not None and fuser.last_slab_bytes else None)
+    bucket = getattr(getattr(rt_obj, "nfa", None), "_tenant_bucket", None)
+    if bucket is not None:
+        # per-tenant attribution for packed runtimes: which shared
+        # bucket this app's blocks ride, and how many tenants co-pay
+        # the gang launch (flight rows already carry the app label)
+        extra = dict(extra or {}, xtenant={"bucket": bucket.label,
+                                           "tenants": len(bucket.tenants)})
     if ledger_row:
         extra = dict(extra or {}, ledger=ledger_row)
     # rim-vs-kernel ms split: delta of the always-on host-rim clock (and,
@@ -274,6 +281,14 @@ class DevicePatternRuntime:
         # on-device telemetry sink (@app:statistics(telemetry='true')):
         # per-state occupancy / gate rates mirrored on /metrics
         self._telemetry_sink = getattr(app, "device_telemetry", None)
+        # cross-tenant super-dispatch (plan/xtenant.py): eligible small
+        # automata from DIFFERENT apps bucket by shape class and step as
+        # one gang launch per bucket per block.  No-op when the
+        # SIDDHI_TPU_XTENANT kill switch is off or the NFA is meshed/
+        # dead/donated; with pipeline depth 0 the bucket flushes inside
+        # every ingest and dispatch counts match the unpacked path.
+        from .xtenant import tenant_packer
+        tenant_packer().register(self.nfa, app=app.name, query=qr.name)
 
     # ------------------------------------------------------------ ingest
 
@@ -392,6 +407,14 @@ class DevicePatternRuntime:
             # ring — rewind to this chunk's pre-carry, grow, replay all
             pending = [h] + list(self._inflight)
             self._inflight.clear()
+            # packed tenant (plan/xtenant.py): later in-flight chunks may
+            # still sit in the bucket queue; gang-step them NOW, before
+            # the rewind.  Otherwise grow_slots' rebucket would flush
+            # them onto the rewound carry AND the loop below would replay
+            # them — the same block applied twice
+            for e in pending:
+                if "xpend" in e:
+                    e["xpend"].resolve(e)
             self.nfa.carry = h["pre_carry"]
             self.nfa.base_ts = h["pre_base"]
             self.nfa.grow_slots(self.nfa.spec.n_slots * 2)
@@ -498,6 +521,10 @@ class DevicePatternRuntime:
     def shutdown(self) -> None:
         self.flush()
         self._shutdown = True
+        # packed tenants leave their bucket on shutdown; co-tenants'
+        # shared-gang state is untouched (plan/xtenant.py evict contract)
+        from .xtenant import tenant_packer
+        tenant_packer().evict(self.nfa)
 
     # ------------------------------------------------------------ snapshot
 

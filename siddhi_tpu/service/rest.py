@@ -274,8 +274,12 @@ class SiddhiService:
         telemetry = [rt.device_telemetry
                      for rt in self.manager.runtimes.values()
                      if getattr(rt, "device_telemetry", None) is not None]
+        from ..core.overload import fair_share
+        from ..plan.xtenant import tenant_packer
         body = prometheus_text(managers, profiler(), resilience,
-                               ingest, telemetry).encode()
+                               ingest, telemetry,
+                               tenants=[fair_share(), tenant_packer()]
+                               ).encode()
         h.send_response(200)
         h.send_header("Content-Type",
                       "text/plain; version=0.0.4; charset=utf-8")

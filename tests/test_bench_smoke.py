@@ -51,6 +51,16 @@ def test_bench_smoke_runs_clean():
     assert dsm["sequential"]["dispatches_per_block"] == 2
     assert dsm["stacked"]["dispatches_per_block"] == 1
     assert dsm["stacked"]["matches"] == dsm["sequential"]["matches"] > 0
+    # cross-tenant super-dispatch (round 14): 2 heterogeneous tenant
+    # apps share one bucket and one gang launch per ingest wall — fewer
+    # dispatches than the SIDDHI_TPU_XTENANT=0 run, bit-identical
+    # matches asserted inside bench_mtenant itself
+    msm = out["mtenant_smoke"]
+    assert msm["n_apps"] == 2 and msm["tenants"] == 2
+    assert msm["buckets"] >= 1
+    assert msm["matches"] > 0
+    assert msm["packed_dispatches_per_block"] < \
+        msm["unpacked_dispatches_per_block"]
     # ingest armor (round 9): SHED_OLDEST under a wedged consumer, with
     # exact accounting asserted inside the smoke and visible here
     osm = out["overload_smoke"]
