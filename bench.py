@@ -1197,6 +1197,164 @@ def _check_mtenant_dispatches(limit, mt) -> None:
         sys.exit(1)
 
 
+SHARDSCALE_KEYS = (10_000, 100_000, 1_000_000)
+SHARDSCALE_SHARDS = (1, 2, 4, 8)
+SHARDSCALE_BLOCK = 65536
+
+
+def _shardscale_app(n_keys: int) -> str:
+    """Keyed running-sum app for the shard-out scaling curve.  The
+    @app:lanes declaration pre-sizes the per-shard key slabs to the
+    known population, so the measured passes run at final capacity
+    instead of paying the grow ladder's retraces mid-curve."""
+    return (
+        "@app:name('shardscale') "
+        f"@app:lanes('{n_keys}') "
+        "define stream S (k long, v double); "
+        "partition with (k of S) begin @info(name='q') "
+        "from S select k, sum(v) as total group by k "
+        "insert into Out; end;")
+
+
+def _shardscale_run(n_keys: int, n_shards: int, block_events: int,
+                    passes: int, collect: bool = False):
+    """One (keys x shards) config: a warm pass that touches every key
+    (allocates lanes, traces at final capacity), then `passes` measured
+    passes over the same shuffled key population.  Returns (row dict,
+    emitted rows or row count, expected per-key totals)."""
+    from siddhi_tpu import SiddhiManager, StreamCallback
+    prev_sh = os.environ.get("SIDDHI_TPU_SHARDS")
+    prev_mesh = os.environ.get("SIDDHI_TPU_MESH")
+    os.environ["SIDDHI_TPU_SHARDS"] = str(n_shards)
+    # the curve measures the shard fan itself; a mesh would fold the
+    # partition axis a second time
+    os.environ["SIDDHI_TPU_MESH"] = "off"
+    try:
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(_shardscale_app(n_keys))
+        rows, n_rows = [], [0]
+        if collect:
+            cb = StreamCallback(lambda evs: rows.extend(
+                tuple(e.data) for e in evs))
+        else:
+            cb = StreamCallback(lambda evs: n_rows.__setitem__(
+                0, n_rows[0] + len(evs)))
+        rt.add_callback("Out", cb)
+        rt.start()
+        h = rt.get_input_handler("S")
+        rng = np.random.default_rng(23)
+        keys = rng.permutation(np.arange(n_keys, dtype=np.int64))
+        expect = np.zeros(n_keys, np.float64)
+        state = {"n_ev": 0, "t": 1_000_000}
+
+        def feed():
+            for lo in range(0, n_keys, block_events):
+                kk = keys[lo:lo + block_events]
+                vv = rng.uniform(0.0, 1.0, len(kk))
+                np.add.at(expect, kk, vv)
+                h.send_batch({"k": kk, "v": vv},
+                             timestamps=state["t"] + np.arange(
+                                 len(kk), dtype=np.int64))
+                state["t"] += len(kk)
+                state["n_ev"] += len(kk)
+
+        feed()                          # warm: allocate + trace
+        rt.flush()
+        n_warm = state["n_ev"]
+        t0 = time.perf_counter()
+        for _ in range(passes):
+            feed()
+        rt.flush()
+        wall = time.perf_counter() - t0
+        snap = rt.statistics
+        srows = [r for rlist in (snap.get("shards") or {}).values()
+                 for r in rlist]
+        m.shutdown()
+        measured = state["n_ev"] - n_warm
+        row = {
+            "keys": n_keys, "shards": n_shards, "events": measured,
+            "events_per_sec": round(measured / wall, 1) if wall else None,
+            "wall_s": round(wall, 3),
+            "shard_keys": [r["keys"] for r in srows],
+            "shard_dispatches": [r["dispatches"] for r in srows],
+            "shard_grows": [r["grows"] for r in srows],
+        }
+        return row, (rows if collect else n_rows[0]), expect
+    finally:
+        for k, v in (("SIDDHI_TPU_SHARDS", prev_sh),
+                     ("SIDDHI_TPU_MESH", prev_mesh)):
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def bench_shardscale(keys_list=SHARDSCALE_KEYS,
+                     shards_list=SHARDSCALE_SHARDS,
+                     block_events=SHARDSCALE_BLOCK, passes=2):
+    """--phase shardscale: keyed-sum ingest rate vs (key population x
+    shard fan), per-shard key/dispatch balance, plus an in-phase parity
+    gate at the smallest population: every shard fan must emit rows
+    bit-identical to the monolithic run (sorted — cross-key emit order
+    is shard-interleaved by contract) and the final per-key totals must
+    match a numpy oracle."""
+    parity_keys = min(keys_list)
+    parity_blk = min(block_events, 8192)
+    baseline = None
+    for s in shards_list:
+        _, out, expect = _shardscale_run(parity_keys, s, parity_blk,
+                                         passes=1, collect=True)
+        assert out, f"shardscale parity S={s}: no rows emitted"
+        got = sorted(out)
+        if baseline is None:
+            baseline = got
+        else:
+            assert got == baseline, \
+                f"shardscale parity FAILED at S={s} vs monolithic"
+        final = np.zeros(parity_keys, np.float64)
+        for k, total in out:            # per-key order is preserved,
+            final[int(k)] = total       # so last row = final total
+        assert np.allclose(final, expect, rtol=1e-4, atol=1e-3), \
+            f"shardscale oracle FAILED at S={s}"
+    rows = []
+    for n_keys in keys_list:
+        for s in shards_list:
+            row, _, _ = _shardscale_run(n_keys, s, block_events, passes)
+            if row["shard_keys"]:
+                ks = np.asarray(row["shard_keys"], float)
+                row["imbalance"] = round(float(ks.max() / ks.mean()), 3)
+                assert int(ks.sum()) == n_keys, row
+            else:
+                row["imbalance"] = None     # monolithic: no shard rows
+            rows.append(row)
+    imbs = [r["imbalance"] for r in rows if r["imbalance"] is not None]
+    return {
+        "shardscale": rows,
+        "shardscale_parity_keys": parity_keys,
+        "shardscale_parity_rows": len(baseline),
+        # the gating figure: worst max/mean per-shard key-count ratio
+        # across every sharded config (1.0 = perfectly balanced FNV)
+        "shardscale_max_imbalance": max(imbs) if imbs else None,
+    }
+
+
+def _check_shard_imbalance(limit, sc) -> None:
+    """--fail-on-imbalance gate body for `--phase shardscale` and the
+    full run: the worst per-shard key-count max/mean ratio must not
+    exceed the limit (a regression means the FNV routing degraded or a
+    shard stopped taking ownership)."""
+    if limit is None or sc is None:
+        return
+    measured = sc.get("shardscale_max_imbalance")
+    if measured is not None and measured > limit:
+        sys.stderr.write(
+            f"[bench] FAIL: shard key imbalance {measured} (max/mean "
+            f"across shardscale configs) exceeds --fail-on-imbalance "
+            f"{limit} — key routing lost its balance (see shardscale "
+            f"rows)\n")
+        sys.exit(1)
+
+
 def _force_cpu():
     """--smoke: pin the CPU backend even though the axon plugin
     registers from a sitecustomize hook at interpreter start with
@@ -1455,6 +1613,23 @@ def bench_smoke():
     assert mt_row["tenants"] == 2 and mt_row["buckets"] >= 1, \
         f"smoke mtenant FAILED: tenants never packed: {mt_row}"
     res["mtenant_smoke"] = mt_row
+
+    # ---- partition-axis shard-out (round 15): the same keyed feed
+    # split across 1/2/4 shard fans must emit bit-identical rows (the
+    # parity gate inside bench_shardscale is real), every key must land
+    # in exactly one shard, and FNV ownership must stay balanced
+    sc = bench_shardscale(keys_list=(512,), shards_list=(1, 2, 4),
+                          block_events=256, passes=1)
+    sc4 = next(r for r in sc["shardscale"] if r["shards"] == 4)
+    assert len(sc4["shard_keys"]) == 4, sc4
+    assert sum(sc4["shard_keys"]) == 512, sc4
+    assert sc["shardscale_max_imbalance"] < 1.5, sc
+    res["shardscale_smoke"] = {
+        "keys": 512,
+        "parity_rows": sc["shardscale_parity_rows"],
+        "shard_keys": sc4["shard_keys"],
+        "max_imbalance": sc["shardscale_max_imbalance"],
+    }
 
     # ---- ingest armor (round 9): SHED_OLDEST under a wedged consumer —
     # the send path must stay alive and admitted == delivered + shed
@@ -1903,11 +2078,28 @@ def main():
     if "--fail-on-p99" in sys.argv:
         fail_on_p99 = float(
             sys.argv[sys.argv.index("--fail-on-p99") + 1])
+    # --fail-on-imbalance R: exit non-zero when the shardscale phase
+    # measures a per-shard key-count max/mean ratio above R — the
+    # mechanical gate of the round-15 partition-axis shard-out (a
+    # regression means consistent-hash routing stopped spreading keys)
+    fail_on_imbalance = None
+    if "--fail-on-imbalance" in sys.argv:
+        fail_on_imbalance = float(
+            sys.argv[sys.argv.index("--fail-on-imbalance") + 1])
     wf_blocks, wf_chunk = WF_BLOCKS, 4096
     if "--wf-blocks" in sys.argv:
         wf_blocks = int(sys.argv[sys.argv.index("--wf-blocks") + 1])
     if "--wf-chunk" in sys.argv:
         wf_chunk = int(sys.argv[sys.argv.index("--wf-chunk") + 1])
+    # --sc-keys / --sc-shards: comma-separated overrides for the
+    # shardscale grid (tier-1 gates the phase at a tiny shape)
+    sc_keys, sc_shards = SHARDSCALE_KEYS, SHARDSCALE_SHARDS
+    if "--sc-keys" in sys.argv:
+        sc_keys = tuple(int(x) for x in sys.argv[
+            sys.argv.index("--sc-keys") + 1].split(","))
+    if "--sc-shards" in sys.argv:
+        sc_shards = tuple(int(x) for x in sys.argv[
+            sys.argv.index("--sc-shards") + 1].split(","))
     if "--phase" in sys.argv:
         phase = sys.argv[sys.argv.index("--phase") + 1]
         if phase == "gate":
@@ -1939,6 +2131,12 @@ def main():
             wf = bench_waterfall(blocks=wf_blocks, chunk=wf_chunk)
             print(json.dumps(wf))
             _check_p99(fail_on_p99, wf.get("e2e_p99_ms"))
+        elif phase == "shardscale":
+            sc = bench_shardscale(
+                keys_list=sc_keys, shards_list=sc_shards,
+                block_events=min(SHARDSCALE_BLOCK, max(sc_keys)))
+            print(json.dumps(sc))
+            _check_shard_imbalance(fail_on_imbalance, sc)
         return
 
     import jax
@@ -1954,6 +2152,7 @@ def main():
     overload = _run_phase("overload")
     mten = _run_phase("mtenant")
     wf = _run_phase("waterfall")
+    shardsc = _run_phase("shardscale")
     tpu_rate = thru["thru_rate"]
     p99_ms, p50_ms = lat["p99_ms"], lat["p50_ms"]
     matches, payloads, sample = (thru["matches"], thru["payloads"],
@@ -2062,6 +2261,12 @@ def main():
         "mtenant_dispatches_per_block":
             mten["mtenant_dispatches_per_block"],
         "mtenant_apps": mten["mtenant_apps"],
+        # partition-axis shard-out (round 15): keyed ingest rate vs
+        # (key population x shard fan), per-shard balance, parity vs
+        # the monolithic run asserted in-phase — gated by
+        # --fail-on-imbalance
+        "shardscale_sweep": shardsc["shardscale"],
+        "shardscale_max_imbalance": shardsc["shardscale_max_imbalance"],
         # latency ledger (round 12): per-stage attribution of the
         # engine-path block latency, reconciled against an independent
         # e2e wall clock (coverage = attributed / e2e at p50/p99)
@@ -2112,6 +2317,7 @@ def main():
                 f"the per-event path; see "
                 f"engine_path_columnar_rim_materialized)\n")
             sys.exit(1)
+    _check_shard_imbalance(fail_on_imbalance, shardsc)
     _check_p99(fail_on_p99, p99_ms)
 
 

@@ -39,7 +39,7 @@ from ..query_api.query import (DeleteStream, Filter, JoinInputStream,
                                ValuePartitionType, WindowHandler)
 from .diagnostics import Diagnostic, DiagnosticSink, Severity
 from .passes import (_single_streams, deadcode_pass, partition_pass,
-                     perf_pass, state_pass)
+                     perf_pass, shard_pass, state_pass)
 from .scope import QueryScope, SymbolTable, scope_for_input
 from .typecheck import TypeChecker
 
@@ -393,6 +393,7 @@ def _analyze_partition(table: SymbolTable, part: Partition, pidx: int,
         _analyze_query(table, q, qname, sink, engine, insert_targets,
                        partition=part)
         partition_pass(table, part, q, qname, sink)
+        shard_pass(table, part, q, qname, sink)
 
 
 # ==================================================================== query

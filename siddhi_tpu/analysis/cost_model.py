@@ -242,7 +242,15 @@ def plan_cost(plan: PlanIR) -> CostReport:
     fixed at plan time) plus a condition-graph FLOP estimate."""
     rep = CostReport()
     for a in plan.automata:
-        bd = nfa_state_bytes(a)
+        if a.shards:
+            # partition-axis shard-out: one carry per shard, each sized
+            # by its own (elastically grown) lane capacity
+            bd: Dict[str, int] = {}
+            for p in (a.shard_partitions or (a.n_partitions,) * a.shards):
+                for k, v in nfa_state_bytes(a, n_partitions=p).items():
+                    bd[k] = bd.get(k, 0) + v
+        else:
+            bd = nfa_state_bytes(a)
         bd["egress_buffer"] = nfa_egress_bytes(a)
         rep.entries.append(CostEntry(
             query=a.query, kind="pattern-nfa",

@@ -211,6 +211,17 @@ CATALOG: Dict[str, CatalogEntry] = {e.code: e for e in [
        "health degradation or SLO001 bundle will ever fire.",
        "Add at least one target, e.g. "
        "`@app:slo(latency.p99.ms='200')`."),
+    # ---- partition shard-out ------------------------------------------
+    _C("SA080", _I, "partition-not-shardable",
+       "SIDDHI_TPU_SHARDS would be ignored for this partitioned query: "
+       "the app uses a feature that aggregates the whole key space "
+       "through one engine's carry (absent `not ... for` deadline "
+       "timers, on-device telemetry, or a statically dead automaton), "
+       "so the keyed runtime stays a single monolithic slab on one "
+       "device.",
+       "Drop the blocking feature to shard out, or leave "
+       "SIDDHI_TPU_SHARDS unset — the monolithic path is exact, just "
+       "bounded by one device's HBM."),
     # ---- TPU performance hazards ---------------------------------------
     _C("SP001", _W, "retrace-slot-growth",
        "A device-eligible `every` pattern without `within` will grow its "
@@ -484,6 +495,7 @@ _FAMILIES = (
     ("SA05", "Fault tolerance"),
     ("SA06", "Ingest protection"),
     ("SA07", "Service-level objectives"),
+    ("SA08", "Partition shard-out"),
     ("SP0", "TPU performance hazards"),
     ("PV00", "Plan verifier — automaton"),
     ("PV01", "Plan verifier — jaxpr kernel sanitizer"),

@@ -139,6 +139,38 @@ def partition_pass(table: SymbolTable, part: Partition, q: Query,
             pos=pos_of(out) or pos_of(q), query=qname)
 
 
+def shard_pass(table: SymbolTable, part: Partition, q: Query,
+               qname: Optional[str], sink: DiagnosticSink) -> None:
+    """SA080: partition queries the shard-out runtime must keep
+    monolithic.  Mirrors the planner's shard-eligibility gates
+    (plan/planner.py DevicePatternRuntime.__init__): absent (`not ...
+    for`) deadline timers and on-device telemetry both aggregate the
+    whole key space through ONE engine's carry, so SIDDHI_TPU_SHARDS is
+    recorded-and-ignored for the query.  INFO severity — the monolithic
+    path is correct, just single-device."""
+    blocker = None
+    ins = q.input_stream
+    if isinstance(ins, StateInputStream):
+        if any(isinstance(el, AbsentStreamStateElement)
+               for el in _flatten(ins.state)):
+            blocker = ("absent (`not ... for`) deadline timers arm off "
+                       "one engine's carry")
+    if blocker is None:
+        ann = find_annotation(table.app.annotations, "app:statistics") or \
+            find_annotation(table.app.annotations, "statistics")
+        if ann is not None and \
+                str(ann.get("telemetry", "false")).lower() == "true":
+            blocker = "on-device telemetry aggregates one engine's planes"
+    if blocker is not None:
+        sink.emit(
+            "SA080",
+            f"partitioned query is not shardable: {blocker} — with "
+            f"SIDDHI_TPU_SHARDS set the keyed runtime stays one "
+            f"monolithic slab (reason is also recorded on the runtime's "
+            f"shard_report)",
+            pos=pos_of(q) or pos_of(part), query=qname)
+
+
 # ==================================================================== perf
 
 def perf_pass(table: SymbolTable, q: Query, qname: Optional[str],

@@ -99,13 +99,16 @@ class AutomatonIR:
     packed: bool = False          # adopted by the cross-tenant packer
     #                               (plan/xtenant.py, round 14)
     pack_bucket: str = ""         # shape-class bucket label (e.g. S2K8P1B4)
+    shards: int = 0               # partition-axis shard-out fan (round 15;
+    #                               0 = monolithic single-device engine)
+    shard_partitions: Tuple[int, ...] = ()  # per-shard lane capacity
 
     @property
     def accept(self) -> int:
         return len(self.states)
 
     def as_dict(self) -> Dict[str, Any]:
-        return {
+        d = {
             "query": self.query, "kind": "pattern-nfa",
             "n_states": len(self.states),
             "n_slots": self.n_slots, "n_partitions": self.n_partitions,
@@ -121,6 +124,10 @@ class AutomatonIR:
             "packed": self.packed,
             "pack_bucket": self.pack_bucket,
         }
+        if self.shards:
+            d["shards"] = self.shards
+            d["shard_partitions"] = list(self.shard_partitions)
+        return d
 
 
 @dataclass
@@ -177,6 +184,8 @@ class PlanIR:
                 # rendered only when the cross-tenant packer adopted the
                 # automaton, so unpacked goldens stay byte-identical
                 + (f"packed={a.pack_bucket} " if a.packed else "")
+                # likewise only when the partition axis is sharded out
+                + (f"shards={a.shards} " if a.shards else "")
                 + f"flags=[{','.join(flags)}]")
             for s in a.states:
                 extra = ""
@@ -351,12 +360,34 @@ def _program_ir(qr, qname: str) -> ProgramIR:
             state_bytes=0)      # stateless program
     if cls == "DeviceGroupedAggRuntime":
         cga = dev.cga
+        shards = getattr(dev, "shards", None)
+        if shards:
+            # sharded runtime: total capacity and carry bytes across the
+            # per-device engines (dims stay flat ints for goldens)
+            return ProgramIR(
+                query=qname, kind="gagg", backend="device",
+                dims={"n_lanes": sum(int(sh.engine.n_lanes)
+                                     for sh in shards),
+                      "shards": len(shards)},
+                state_bytes=sum(_array_bytes(getattr(sh.engine, "carry",
+                                                     None))
+                                for sh in shards))
         return ProgramIR(
             query=qname, kind="gagg", backend="device",
             dims={"n_lanes": int(getattr(cga, "n_lanes", 1))},
             state_bytes=_array_bytes(getattr(cga, "carry", None)))
     if cls == "DeviceWindowedAggRuntime":
         cwa = dev.cwa
+        shards = getattr(dev, "shards", None)
+        if shards:
+            return ProgramIR(
+                query=qname, kind="wagg", backend="device",
+                dims={"n_partitions": sum(int(sh.engine.n_partitions)
+                                          for sh in shards),
+                      "shards": len(shards)},
+                state_bytes=sum(_array_bytes(getattr(sh.engine, "carry",
+                                                     None))
+                                for sh in shards))
         return ProgramIR(
             query=qname, kind="wagg", backend="device",
             dims={"n_partitions": int(getattr(cwa, "n_partitions", 1))},
@@ -386,7 +417,13 @@ def extract_plan(rt) -> PlanIR:
     def add_query(qr, qname: str) -> None:
         dev = getattr(qr, "device_runtime", None)
         if type(dev).__name__ == "DevicePatternRuntime":
-            plan.automata.append(automaton_ir_from_nfa(dev.nfa, qname))
+            ir = automaton_ir_from_nfa(dev.nfa, qname)
+            shards = getattr(dev, "shards", None)
+            if shards:
+                ir.shards = len(shards)
+                ir.shard_partitions = tuple(
+                    int(sh.engine.n_partitions) for sh in shards)
+            plan.automata.append(ir)
         else:
             plan.programs.append(_program_ir(qr, qname))
 

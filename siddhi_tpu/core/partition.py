@@ -279,6 +279,18 @@ class PartitionRuntime:
                 purge.get("interval", "1 min"))
             self._schedule_purge()
 
+    def shard_report(self) -> Dict[str, dict]:
+        """Per-query partition shard-out status (round 15,
+        parallel/shards.py): shard count when the keyed device runtime
+        split out, else the recorded monolithic-fallback reason."""
+        out: Dict[str, dict] = {}
+        for name, qr in self.device_query_runtimes.items():
+            dev = getattr(qr, "device_runtime", None)
+            shards = getattr(dev, "shards", None)
+            out[name] = {"shards": len(shards) if shards else 0,
+                         "reason": getattr(dev, "shard_reason", None)}
+        return out
+
     def _try_device_mode(self) -> bool:
         """Compile every partition query onto keyed device lanes; any
         incompatibility rolls back cleanly to the host clone machinery."""

@@ -666,6 +666,27 @@ class SiddhiAppRuntime:
         snap["ledger"] = ledger().snapshot(app=self.name)
         if self.device_telemetry is not None:
             snap["telemetry"] = self.device_telemetry.snapshot()
+        # partition shard-out rows (round 15): per-shard key/capacity/
+        # dispatch counters for every sharded keyed runtime.  This
+        # host-side gather is the shard set's one cross-device
+        # aggregation point — the hot path never reduces across shards.
+        shard_rows: Dict[str, list] = {}
+
+        def _scan(label, qr):
+            dev = getattr(qr, "device_runtime", None)
+            ss = getattr(dev, "shard_stats", None)
+            rows = ss() if ss is not None else None
+            if rows:
+                shard_rows[label] = rows
+
+        for qname, qr in self.query_runtimes.items():
+            _scan(qname, qr)
+        for pr in self.partition_runtimes:
+            for qname, qr in getattr(pr, "device_query_runtimes",
+                                     {}).items():
+                _scan(f"{pr.name}/{qname}", qr)
+        if shard_rows:
+            snap["shards"] = shard_rows
         return snap
 
     # ------------------------------------------------------------ tracing

@@ -33,6 +33,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from .distributed import init_distributed, process_info
+from .shards import fnv1a, owner_ids
 
 
 def partition_key_attrs(app) -> Dict[str, str]:
@@ -62,16 +63,16 @@ def partition_key_attrs(app) -> Dict[str, str]:
     return out
 
 
-_FNV_MASK = (1 << 64) - 1
-
-
 def owner_of(key, num_processes: int) -> int:
-    """Stable key → owning process (FNV-1a over the repr, so every host
-    computes the same answer with no coordination)."""
-    h = 0xCBF29CE484222325
-    for b in repr(key).encode():
-        h = ((h ^ b) * 0x100000001B3) & _FNV_MASK
-    return h % num_processes
+    """Stable key → owning process: the CANONICAL FNV-1a over
+    ``str(key)`` UTF-8 bytes (parallel/shards.fnv1a), so every host
+    computes the same answer with no coordination — and the same hash
+    the partition shard router uses, so a fronting router can compute
+    both process and shard from one pass.  Round 15 moved the byte
+    source from ``repr(key)`` (numpy-major-unstable) to ``str(key)``;
+    tests/test_shards.py pins literal vectors so the assignment can
+    never silently shift again."""
+    return fnv1a(key) % num_processes
 
 
 class MultiHostAppRuntime:
@@ -110,8 +111,12 @@ class MultiHostAppRuntime:
         if key_attr is None:
             keep = np.ones(len(timestamps), bool)     # broadcast stream
         else:
+            # vectorized routing (round 15): one FNV pass over the
+            # batch's DISTINCT keys (np.unique + inverse scatter) instead
+            # of a pure-Python hash loop per ROW — shared with the
+            # partition shard router (parallel/shards.py)
             keys = columns[key_attr]
-            keep = np.asarray([self.owns(k) for k in keys], bool)
+            keep = owner_ids(keys, self.nproc) == self.pid
         n = int(keep.sum())
         if n:
             self.runtime.get_input_handler(stream_id).send_batch(

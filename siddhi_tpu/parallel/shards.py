@@ -1,0 +1,228 @@
+"""Partition-axis shard-out: consistent key→shard routing + per-shard
+engine clones pinned to their own devices (round 15, ROADMAP item 2).
+
+The paper's thesis is thousands of partitions' NFA states stepped as one
+batched kernel; production means *millions* of keys.  One monolithic
+``[P, ...]`` slab tops out at a single device's HBM and re-keys the
+whole slab on growth.  This module supplies the scale-out mechanics the
+keyed device runtimes (plan/planner.py) compose:
+
+  * **Canonical FNV-1a** over ``str(key)`` UTF-8 bytes — scalar and
+    NumPy-vectorized forms that agree bit-for-bit, shared by the shard
+    router here and the multi-host process router
+    (parallel/multihost.owner_of).  The assignment is part of the
+    checkpoint contract (a restored per-shard snapshot only makes sense
+    if every key still routes to the same shard), so
+    tests/test_shards.py pins literal hash vectors: any change to this
+    function is a breaking format change, not a refactor.
+  * **One hash pass per batch, not per event**: ``split_rows`` routes
+    via ``np.unique(return_inverse=True)`` — FNV runs over the DISTINCT
+    keys only and the inverse scatter fans the shard ids back out.
+  * **Per-shard elastic state** (:class:`EngineShard`): each shard owns
+    an engine clone, its own key→lane map, its own in-flight queue and
+    grow-and-replay bookkeeping.  A hot shard overflowing its lane
+    capacity grows and replays AT SHARD GRANULARITY — siblings' carries
+    are never touched (tests assert object identity).
+
+Shard-local dispatch means NO collectives on the hot path: every
+shard's jitted step runs on committed operands pinned to that shard's
+device, so XLA dispatches device-locally.  Statistics aggregation
+(``shard_stats`` rows summed into rt.statistics) is the one allowed
+reduction, and it is a host-side sum over tiny counters.
+
+Kill switch: ``SIDDHI_TPU_SHARDS=N`` (N >= 2) enables sharded keyed
+runtimes; unset/``0``/``off`` keeps the single-slab path byte-identical
+to previous rounds.
+"""
+from __future__ import annotations
+
+import os
+from collections import deque
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+SHARDS_ENV = "SIDDHI_TPU_SHARDS"
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_FNV_MASK = (1 << 64) - 1
+
+_U64_OFFSET = np.uint64(_FNV_OFFSET)
+_U64_PRIME = np.uint64(_FNV_PRIME)
+
+
+def resolve_shards(n: Optional[int] = None) -> int:
+    """Requested shard count: explicit arg wins, else ``SIDDHI_TPU_SHARDS``.
+    Returns 0 (disabled) unless the resolved value is >= 2 — one shard IS
+    the monolithic slab, so it routes through the unsharded path."""
+    if n is None:
+        raw = os.environ.get(SHARDS_ENV, "").strip().lower()
+        if raw in ("", "0", "off", "false", "no"):
+            return 0
+        try:
+            n = int(raw)
+        except ValueError:
+            return 0
+    return int(n) if int(n) >= 2 else 0
+
+
+# ===================================================================
+# canonical FNV-1a (scalar + vectorized, bit-identical)
+# ===================================================================
+
+def fnv1a(key: Any) -> int:
+    """64-bit FNV-1a over the canonical ``str(key)`` UTF-8 bytes.
+
+    ``str()`` (not ``repr()``) is the canonical form: ``repr`` of numpy
+    scalars changed across numpy majors (``repr(np.str_('a'))`` is
+    ``"np.str_('a')"`` on numpy 2), which would silently re-route every
+    key.  ``str(np.str_('a')) == 'a'`` and ``str(np.int64(5)) == '5'``
+    are stable, and match the vectorized form's ``astype('U')``."""
+    h = _FNV_OFFSET
+    for b in str(key).encode("utf-8"):
+        h = ((h ^ b) * _FNV_PRIME) & _FNV_MASK
+    return h
+
+
+def fnv1a_vec(keys: Sequence[Any]) -> np.ndarray:
+    """Vectorized :func:`fnv1a`: uint64 hash per key, one fused pass over
+    the character columns instead of a Python loop per byte.  Agrees
+    bit-for-bit with the scalar form for str/int keys (pinned by
+    tests/test_shards.py).  Keys with embedded NUL bytes have no stable
+    fixed-width representation and take the scalar fallback upstream."""
+    arr = np.asarray(keys)
+    if arr.dtype.kind != "U":
+        arr = arr.astype("U")           # canonical str() form
+    n = arr.shape[0]
+    if n == 0:
+        return np.empty(0, np.uint64)
+    enc = np.char.encode(arr, "utf-8")  # S<w>, NUL-padded
+    w = enc.dtype.itemsize
+    h = np.full(n, _U64_OFFSET, np.uint64)
+    if w == 0:                          # all-empty keys hash to the basis
+        return h
+    u8 = np.ascontiguousarray(enc).view(np.uint8).reshape(n, w)
+    live = np.ones(n, bool)
+    for i in range(w):
+        byte = u8[:, i]
+        live &= byte != 0               # NUL padding = end of string
+        if not live.any():
+            break
+        mixed = (h ^ byte.astype(np.uint64)) * _U64_PRIME   # wraps mod 2^64
+        h = np.where(live, mixed, h)
+    return h
+
+
+def owner_ids(keys: Sequence[Any], n_owners: int) -> np.ndarray:
+    """Per-row owner index (shard or process) for a key column — one
+    vectorized hash pass over the batch's DISTINCT keys.  Arrays whose
+    elements do not sort (mixed-type object columns) fall back to the
+    scalar hash per distinct key; the assignment is identical."""
+    arr = np.asarray(keys)
+    if arr.shape[0] == 0:
+        return np.empty(0, np.int64)
+    try:
+        uniq, inv = np.unique(arr, return_inverse=True)
+        owners_u = (fnv1a_vec(uniq) % np.uint64(n_owners)).astype(np.int64)
+    except TypeError:                   # unsortable object column
+        seen = {}
+        owners = np.empty(arr.shape[0], np.int64)
+        for i, k in enumerate(arr.tolist()):
+            o = seen.get(k)
+            if o is None:
+                o = fnv1a(k) % n_owners
+                seen[k] = o
+            owners[i] = o
+        return owners
+    return owners_u[inv.reshape(-1)]
+
+
+def split_rows(keys: Sequence[Any],
+               n_shards: int) -> List[Tuple[int, np.ndarray]]:
+    """Route a batch: ``[(shard_id, row_indices), ...]`` for the
+    NON-EMPTY shards, in shard order.  Row indices are ascending, so
+    per-key event order is preserved inside each shard's sub-block."""
+    sids = owner_ids(keys, n_shards)
+    order = np.argsort(sids, kind="stable")
+    sorted_sids = sids[order]
+    bounds = np.searchsorted(sorted_sids,
+                             np.arange(n_shards + 1, dtype=np.int64))
+    out = []
+    for s in range(n_shards):
+        lo, hi = bounds[s], bounds[s + 1]
+        if hi > lo:
+            out.append((s, np.sort(order[lo:hi])))
+    return out
+
+
+# ===================================================================
+# shard set construction
+# ===================================================================
+
+def shard_devices(n_shards: int) -> List[Any]:
+    """Round-robin device pinning: shard i lives on
+    ``jax.devices()[i % ndev]``.  On the 8-virtual-device tier-1 CPU
+    mesh this spreads 8 shards across all 8 devices; with fewer devices
+    shards share (still shard-local dispatch, just co-resident)."""
+    import jax
+    devs = jax.devices()
+    return [devs[i % len(devs)] for i in range(n_shards)]
+
+
+class EngineShard:
+    """One shard of a keyed device runtime: an engine clone pinned to a
+    device, plus ALL the per-shard mutable state (key→lane map, in-flight
+    queue, grow-and-replay bookkeeping, stats counters).  The runtime
+    never mixes state across EngineShards — that isolation is what makes
+    growth and checkpointing shard-granular."""
+
+    __slots__ = ("idx", "engine", "device", "key_lanes", "inflight",
+                 "dropped_seen", "events", "dispatches", "grows")
+
+    def __init__(self, idx: int, engine: Any, device: Any,
+                 key_lanes: Optional[dict] = None):
+        self.idx = idx
+        self.engine = engine
+        self.device = device
+        self.key_lanes = key_lanes if key_lanes is not None else {}
+        self.inflight: deque = deque()
+        self.dropped_seen = 0
+        self.events = 0
+        self.dispatches = 0
+        self.grows = 0
+
+    def stats_row(self) -> dict:
+        cap = getattr(self.engine, "n_partitions",
+                      getattr(self.engine, "n_lanes", 1))
+        return {"shard": self.idx, "device": str(self.device),
+                "keys": len(self.key_lanes), "capacity": int(cap),
+                "events": self.events, "dispatches": self.dispatches,
+                "grows": self.grows}
+
+
+def build_shards(template: Any, n_shards: int) -> List[EngineShard]:
+    """Template engine → N EngineShards.  Shard 0 adopts the template
+    itself (re-pinned to device 0); shards 1..N-1 are fresh-state clones
+    via the engine's ``clone_for_shard(device)``.  Clones share the
+    compiled jitted step (one XLA trace cache across the shard set) but
+    own their carry, dictionaries and growth axes."""
+    devs = shard_devices(n_shards)
+    template.pin_to_device(devs[0])
+    shards = [EngineShard(0, template, devs[0])]
+    for i in range(1, n_shards):
+        shards.append(EngineShard(i, template.clone_for_shard(devs[i]),
+                                  devs[i]))
+    return shards
+
+
+def routing_digest(n_owners: int = 8, n_keys: int = 64) -> str:
+    """Stable fingerprint of the key→owner assignment over a fixed probe
+    vector — carried in tools/t1_report.py round artifacts so `--compare`
+    flags any silent routing shift (which would orphan every per-shard
+    checkpoint) as a regression."""
+    import hashlib
+    probe = [f"key-{i}" for i in range(n_keys)] + \
+        [str(i) for i in range(n_keys)]
+    owners = owner_ids(np.asarray(probe), n_owners)
+    return hashlib.sha256(owners.tobytes()).hexdigest()[:16]

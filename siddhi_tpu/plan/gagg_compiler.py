@@ -309,6 +309,36 @@ class CompiledGroupedAgg:
               for a, b in zip(self.carry, fresh)])
         self.n_lanes = n_lanes
 
+    # ------------------------------------------------ partition shard-out
+
+    def pin_to_device(self, device) -> None:
+        """Commit the carry to one device (parallel/shards.py): jit
+        dispatch follows committed operands, so steps, group growth and
+        ring compaction stay shard-local."""
+        self.shard_device = device
+        self.carry = jax.device_put(self.carry, device)
+
+    def clone_for_shard(self, device) -> "CompiledGroupedAgg":
+        """Fresh-state shard clone pinned to `device`: shares the jitted
+        step and compiled value/filter plans; owns its carry AND its
+        group-id dictionaries — gid_map/_lane_gids mutate in place, so
+        sharing them across shards would hand one shard's group slots to
+        another's keys."""
+        import copy
+        cl = copy.copy(self)
+        cl.shard_device = device
+        cl.gid_map = {}
+        cl._lane_gids = {}
+        cl.n_groups = G_START
+        if cl.window_kind == "time":
+            cl._ts_base = None
+        cl.carry = jax.device_put(cl._make_carry(cl.n_lanes), device)
+        # never fused into the app egress slab: cross-device concat
+        # would force a device hop
+        cl.egress_fuser = None
+        cl.flush_hook = None
+        return cl
+
     def _grow_groups(self, n_groups: int) -> None:
         if n_groups <= self.n_groups:
             return

@@ -1505,12 +1505,21 @@ class CompiledPatternNFA:
 
     def _place_carry(self, carry: Dict[str, Any]) -> Dict[str, jnp.ndarray]:
         """Device placement: partition-axis sharded over the mesh when one
-        is set (parallel/mesh.py), plain device arrays otherwise.  When
-        profiling is on, the placed carry's total bytes feed the
-        KernelProfiler ``live_bytes`` gauge — the measured side of the
-        static cost model's HBM prediction (analysis/cost_model.py)."""
+        is set (parallel/mesh.py), plain device arrays otherwise.  A
+        shard-pinned engine (parallel/shards.py round 15) commits its
+        carry to its own device instead — jit dispatch follows committed
+        operands, so every step (including growth re-placement) stays
+        shard-local with no collective.  When profiling is on, the placed
+        carry's total bytes feed the KernelProfiler ``live_bytes`` gauge
+        — the measured side of the static cost model's HBM prediction
+        (analysis/cost_model.py)."""
         if self.mesh is None:
-            placed = {k: jnp.asarray(v) for k, v in carry.items()}
+            dev = getattr(self, "shard_device", None)
+            if dev is not None:
+                placed = {k: jax.device_put(np.asarray(v), dev)
+                          for k, v in carry.items()}
+            else:
+                placed = {k: jnp.asarray(v) for k, v in carry.items()}
         else:
             from ..parallel.mesh import shard_carry
             placed = shard_carry(carry, self.mesh)
@@ -1521,6 +1530,42 @@ class CompiledPatternNFA:
                 "nfa.step" if self.mesh is None else "nfa.mesh_step",
                 sum(int(getattr(v, "nbytes", 0)) for v in placed.values()))
         return placed
+
+    # ------------------------------------------------ partition shard-out
+
+    def pin_to_device(self, device) -> None:
+        """Commit this engine's carry to one device (parallel/shards.py):
+        subsequent steps, growth and replay all stay on it.  Only valid
+        for single-device engines — a meshed carry is already placed."""
+        if self.mesh is not None:
+            raise SiddhiAppCreationError(
+                "shard pinning requires a single-device engine "
+                "(mesh=None)")
+        self.shard_device = device
+        self.carry = self._place_carry(
+            {k: np.asarray(v) for k, v in self.carry.items()})
+
+    def clone_for_shard(self, device) -> "CompiledPatternNFA":
+        """A fresh-state shard clone pinned to `device`.  Shares the
+        compiled artifacts (spec, jitted step, attribute plans) and — by
+        design — the string dictionary (str_encoder/str_decoder mutate
+        in place, so encoded values stay comparable across shards and
+        one decode table serves the whole set).  Owns its carry, base_ts
+        and growth axes: a clone growing slots re-jits only itself."""
+        import copy
+        if self.mesh is not None:
+            raise SiddhiAppCreationError(
+                "shard clones require a single-device template "
+                "(mesh=None)")
+        cl = copy.copy(self)
+        cl.shard_device = device
+        cl.carry = cl._place_carry(make_carry(cl.spec, cl.n_partitions))
+        cl.base_ts = None
+        # never packed (plan/xtenant.py) and never fused into the app
+        # slab: cross-device buffer concat would force a device hop
+        cl.egress_fuser = None
+        cl._tenant_bucket = None
+        return cl
 
     def _effective_donate(self) -> bool:
         """Resolved carry-donation policy (see __init__ docstring):

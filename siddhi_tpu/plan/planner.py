@@ -29,12 +29,31 @@ from ..query_api.query import OutputEventsFor
 from ..utils.errors import (SiddhiAppCreationError,
                             SiddhiAppRuntimeException)
 from ..core.ledger import ledger as _ledger
+from ..parallel.shards import build_shards, resolve_shards, split_rows
 from .nfa_compiler import CompiledPatternNFA
 from .pipeline import PipelinedDeviceIngest
 
 ENGINE_ENV = "SIDDHI_TPU_ENGINE"
 DEFAULT_SLOTS = 8
 GROW_START = 8          # initial keyed-lane capacity (doubles on demand)
+
+
+def initial_lanes(app, n_shards: int = 0) -> int:
+    """``@app:lanes('N')`` — declared distinct-key population.  Keyed
+    slabs start at the next power of two ≥ N instead of GROW_START, so a
+    known-large key domain (bench.py shardscale runs 1M keys) skips the
+    log2(N/8) grow ladder and its per-double jit retrace.  Sharded
+    runtimes split the population: each shard pre-sizes to ceil(N/S)."""
+    ann = find_annotation(app.annotations, "app:lanes") or \
+        find_annotation(app.annotations, "lanes")
+    n = GROW_START
+    if ann is not None:
+        pos = ann.positional()
+        n = int(pos[0] if pos else ann.get("n", GROW_START))
+    if n_shards >= 2:
+        n = -(-n // n_shards)
+    n = max(n, GROW_START)
+    return 1 << (n - 1).bit_length()
 
 
 def _record_block(rt_obj, prof, disp0: int, ticks0: int, stream: str,
@@ -99,23 +118,73 @@ def _record_block(rt_obj, prof, disp0: int, ticks0: int, stream: str,
                     scheduler=sched, telemetry=telemetry, extra=extra)
 
 
+class KeyLanes(dict):
+    """key → lane map with a cached vectorized lookup for steady state.
+
+    After the key population stops growing (the common regime: every
+    batch revisits known keys), per-batch work drops to one
+    np.searchsorted over the batch's DISTINCT keys — zero dict probes.
+    The cache (sorted key array + parallel lane array) is rebuilt lazily
+    whenever the population size changed; lanes are append-only, so a
+    length check is a complete staleness test."""
+
+    __slots__ = ("_vkeys", "_vlanes", "_vn")
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._vkeys = None
+        self._vlanes = None
+        self._vn = -1
+
+    def lookup(self, uniq: np.ndarray) -> Optional[np.ndarray]:
+        """Lanes for ``uniq`` (sorted distinct keys) when EVERY key is
+        already mapped; None → caller falls back to the probing path
+        (which admits the new keys and implicitly invalidates us)."""
+        if len(self) != self._vn:
+            if not self:
+                return None
+            ks = np.asarray(list(self.keys()))
+            if ks.dtype.kind not in "USiu":
+                return None        # mixed/object keys: no vector order
+            order = np.argsort(ks, kind="stable")
+            self._vkeys = ks[order]
+            self._vlanes = np.fromiter(self.values(), np.int64,
+                                       len(self))[order]
+            self._vn = len(self)
+        vk = self._vkeys
+        if vk is None or vk.dtype.kind != uniq.dtype.kind:
+            return None
+        pos = np.searchsorted(vk, uniq)
+        if pos.size and int(pos.max()) >= len(vk):
+            return None
+        if not (vk[pos] == uniq).all():
+            return None
+        return self._vlanes[pos]
+
+
 def map_keys_to_lanes(key_lanes: Dict[Any, int], keys: List[Any],
                       capacity: int, grow_fn) -> np.ndarray:
     """Assign each key a stable lane index, growing the device slab (via
     grow_fn(new_capacity)) when the key population exceeds capacity.
-    String keys take a vectorized path: one dict probe per DISTINCT key in
-    the batch (np.unique in C) instead of one per event."""
+    String AND integer keys take a vectorized path: one dict probe per
+    DISTINCT key in the batch (np.unique in C) instead of one per event —
+    and zero probes in steady state when key_lanes is a KeyLanes with a
+    warm cache (one searchsorted over the distinct keys)."""
     arr = np.asarray(keys)
-    if arr.dtype.kind in "US" and len(keys) > 256:
+    if arr.dtype.kind in "USiu" and len(keys) > 64:
         uniq, inv = np.unique(arr, return_inverse=True)
-        lane_of = np.empty(len(uniq), np.int64)
-        for i, k in enumerate(uniq.tolist()):
-            lane = key_lanes.get(k)
-            if lane is None:
-                lane = len(key_lanes)
-                key_lanes[k] = lane
-            lane_of[i] = lane
-        lanes = lane_of[inv]
+        lane_of = None
+        if isinstance(key_lanes, KeyLanes):
+            lane_of = key_lanes.lookup(uniq)
+        if lane_of is None:
+            lane_of = np.empty(len(uniq), np.int64)
+            for i, k in enumerate(uniq.tolist()):
+                lane = key_lanes.get(k)
+                if lane is None:
+                    lane = len(key_lanes)
+                    key_lanes[k] = lane
+                lane_of[i] = lane
+        lanes = lane_of[inv.reshape(-1)]
     else:
         lanes = np.empty(len(keys), np.int64)
         for i, k in enumerate(keys):
@@ -130,6 +199,19 @@ def map_keys_to_lanes(key_lanes: Dict[Any, int], keys: List[Any],
             cap *= 2
         grow_fn(cap)
     return lanes
+
+
+def _check_shard_count(shards, snap_shards) -> None:
+    """Shard-count mismatch on restore is a routing change: key→shard
+    assignment is modular in the shard count, so a snapshot taken at S
+    shards only restores into S shards."""
+    have = len(shards) if shards else 0
+    want = len(snap_shards) if snap_shards else 0
+    if have != want:
+        raise SiddhiAppRuntimeException(
+            f"sharded snapshot carries {want} shard slab(s) but the "
+            f"runtime has {have} — restore requires the same "
+            f"SIDDHI_TPU_SHARDS the snapshot was taken with")
 
 
 def _scan_fns(e, pred) -> bool:
@@ -217,12 +299,35 @@ class DevicePatternRuntime:
                 "host-only")
         self.keyed = key_executors is not None
         self.key_executors = key_executors or {}
-        capacity = GROW_START if self.keyed else 1
+        telemetry = bool(getattr(app.app_ctx, "telemetry_enabled", False))
+        # partition shard-out (round 15, parallel/shards.py): with
+        # SIDDHI_TPU_SHARDS=N (N>=2) a keyed runtime splits its key space
+        # over N engine clones pinned to their own devices.  The shard
+        # router owns the partition axis, so mesh sharding is superseded
+        # (mesh=None) for the shard set
+        want_shards = resolve_shards() if self.keyed else 0
+        capacity = initial_lanes(app.app, want_shards) if self.keyed else 1
         self.nfa = CompiledPatternNFA(
             app.app, n_partitions=capacity, n_slots=n_slots, query=q,
-            telemetry=bool(getattr(app.app_ctx, "telemetry_enabled",
-                                   False)))
-        self.key_lanes: Dict[Any, int] = {}
+            mesh=None if want_shards >= 2 else "auto",
+            telemetry=telemetry)
+        self.key_lanes: Dict[Any, int] = KeyLanes()
+        self.shards: Optional[List[Any]] = None
+        self.shard_reason: Optional[str] = None
+        if want_shards >= 2:
+            # shard-eligibility gates: these features aggregate across
+            # the whole key space through ONE engine's carry, so the app
+            # stays monolithic (single slab) with the reason recorded —
+            # surfaced by the SA080 diagnostic and partition shard_report
+            if self.nfa.has_absent:
+                self.shard_reason = ("absent (`not ... for`) deadline "
+                                     "timers arm off one engine's carry")
+            elif telemetry:
+                self.shard_reason = ("on-device telemetry aggregates one "
+                                     "engine's occupancy planes")
+            elif self.nfa.statically_dead:
+                self.shard_reason = "statically dead automaton"
+        self._shard_want = want_shards
         self.qr = qr
         self._dtype_for = dtype_for
         # mesh path: host-side upper bound on the fullest lane's live
@@ -288,7 +393,19 @@ class DevicePatternRuntime:
         # dead/donated; with pipeline depth 0 the bucket flushes inside
         # every ingest and dispatch counts match the unpacked path.
         from .xtenant import tenant_packer
-        tenant_packer().register(self.nfa, app=app.name, query=qr.name)
+        if self._shard_want >= 2 and self.shard_reason is None:
+            # fused egress concatenates buffers on ONE device; sharded
+            # engines live on several, so they take the async-copy
+            # egress path instead.  Shard 0 adopts the template engine
+            # (pinned); siblings are fresh-state clones sharing its
+            # jitted step.  Sharded NFAs never join the cross-tenant
+            # packer — gang launches assume co-resident carries.
+            self.nfa.egress_fuser = None
+            self.shards = build_shards(self.nfa, self._shard_want)
+            for sh in self.shards:
+                sh.key_lanes = KeyLanes()
+        else:
+            tenant_packer().register(self.nfa, app=app.name, query=qr.name)
 
     # ------------------------------------------------------------ ingest
 
@@ -302,43 +419,11 @@ class DevicePatternRuntime:
         return map_keys_to_lanes(self.key_lanes, keys,
                                  self.nfa.n_partitions, grow)
 
-    def ingest(self, stream_code: int, stream_id: str, chunk) -> None:
-        from ..core.event import CURRENT, EventChunk
-        from ..core.profiling import profiler
-        data = chunk.only(CURRENT)
-        if data.is_empty:
-            return
-        prof = profiler()
-        disp0 = prof.total_dispatches() if prof.enabled else 0
-        ticks0 = prof.total_scan_ticks() if prof.enabled else 0
-        n = len(data)
-        if self.keyed:
-            ex = self.key_executors.get(stream_id)
-            if ex is None:
-                raise SiddhiAppCreationError(
-                    f"device pattern path: stream '{stream_id}' has no "
-                    f"partition key executor")
-            keys = ex.keys(data)
-            keep = np.asarray([k is not None for k in keys], bool)
-            if not keep.all():
-                data = data.mask(keep)
-                keys = [k for k in keys if k is not None]
-                n = len(data)
-                if n == 0:
-                    return
-            pids = self._lanes_for_keys(keys)
-        else:
-            pids = np.zeros(n, np.int64)
-        if self.nfa.mesh is not None:
-            t_max = int(np.bincount(pids, minlength=1).max())
-            if self._ub_active + t_max > self.nfa.spec.n_slots:
-                actual = self.nfa.max_active_slots()
-                need = actual + t_max
-                if need > self.nfa.spec.n_slots:
-                    self.nfa.grow_slots(1 << (need - 1).bit_length())
-                self._ub_active = actual
-            self._ub_active = min(self._ub_active + t_max,
-                                  self.nfa.spec.n_slots)
+    def _event_cols(self, data, n: int) -> Dict[str, np.ndarray]:
+        """Kernel input columns for a chunk (float32 lanes, raw string
+        columns for dictionary encoding, exact-int companion lanes).
+        Shared by the monolithic and sharded ingest paths — the attr
+        metadata lives on the spec, identical across shard clones."""
         cols = {}
         for a in self.nfa.attr_names:
             if a in self.nfa.derived:
@@ -365,6 +450,132 @@ class DevicePatternRuntime:
             else:
                 cols[a] = (np.asarray(col, np.float32) if col is not None
                            else np.zeros(n, np.float32))
+        return cols
+
+    # ------------------------------------------------------- sharded path
+
+    def _ingest_sharded(self, stream_code: int, data, keys: List[Any],
+                        n: int) -> None:
+        """Route the chunk by consistent key hash and dispatch each
+        shard's sub-block on that shard's own engine/device.  One hash
+        pass per batch (split_rows); per-key event order is preserved
+        (row indices ascend inside each sub-block); NO collectives —
+        every dispatch runs on operands committed to the shard's
+        device."""
+        keys_arr = np.asarray(keys)
+        cols = self._event_cols(data, n)
+        ts_arr = np.asarray(data.timestamps, np.int64)
+        for sid, rows in split_rows(keys_arr, len(self.shards)):
+            sh = self.shards[sid]
+
+            def grow(cap, sh=sh):
+                # shard-local growth: only THIS engine's in-flight
+                # pre-carries go stale, so only its queue is retired and
+                # only its slab re-keys — sibling shards' carries are
+                # untouched (tests assert object identity)
+                self._flush_shard(sh)
+                sh.engine.grow(cap)
+                sh.grows += 1
+
+            pids = map_keys_to_lanes(sh.key_lanes, keys_arr[rows],
+                                     sh.engine.n_partitions, grow)
+            sub_cols = {k: np.asarray(v)[rows] for k, v in cols.items()}
+            codes = np.full(len(rows), stream_code, np.int32)
+            with _ledger().span("device"):
+                h = sh.engine.dispatch_events(pids, sub_cols, ts_arr[rows],
+                                              stream_codes=codes,
+                                              pad_t_pow2=True)
+            sh.inflight.append(h)
+            sh.events += len(rows)
+            sh.dispatches += 1
+            while len(sh.inflight) > self.pipeline_depth:
+                self._retire_shard(sh)
+
+    def _retire_shard(self, sh) -> None:
+        """Per-shard twin of _retire_one: block on the shard's oldest
+        in-flight chunk; on slot-ring overflow rewind/grow/replay THIS
+        shard only."""
+        h = sh.inflight.popleft()
+        eng = sh.engine
+        with _ledger().span("device"):
+            pids, ts, cols = eng.retire_events(h)
+        dropped = eng.last_dropped_total
+        if dropped > sh.dropped_seen and eng.replayable:
+            pending = [h] + list(sh.inflight)
+            sh.inflight.clear()
+            eng.carry = h["pre_carry"]
+            eng.base_ts = h["pre_base"]
+            eng.grow_slots(eng.spec.n_slots * 2)
+            sh.grows += 1
+            for e in pending:
+                while True:
+                    pre_carry, pre_base = eng.carry, eng.base_ts
+                    with _ledger().span("device"):
+                        r = eng.replay_block(e)
+                        pids, ts, cols = eng.retire_events(r)
+                    if eng.last_dropped_total <= sh.dropped_seen:
+                        break
+                    eng.carry = pre_carry
+                    eng.base_ts = pre_base
+                    eng.grow_slots(eng.spec.n_slots * 2)
+                    sh.grows += 1
+                self._emit_columns(pids, ts, cols)
+            return
+        sh.dropped_seen = max(dropped, sh.dropped_seen)
+        self._emit_columns(pids, ts, cols)
+
+    def _flush_shard(self, sh) -> None:
+        while sh.inflight:
+            self._retire_shard(sh)
+
+    def shard_stats(self) -> Optional[List[dict]]:
+        if self.shards is None:
+            return None
+        return [sh.stats_row() for sh in self.shards]
+
+    def ingest(self, stream_code: int, stream_id: str, chunk) -> None:
+        from ..core.event import CURRENT, EventChunk
+        from ..core.profiling import profiler
+        data = chunk.only(CURRENT)
+        if data.is_empty:
+            return
+        prof = profiler()
+        disp0 = prof.total_dispatches() if prof.enabled else 0
+        ticks0 = prof.total_scan_ticks() if prof.enabled else 0
+        n = len(data)
+        if self.keyed:
+            ex = self.key_executors.get(stream_id)
+            if ex is None:
+                raise SiddhiAppCreationError(
+                    f"device pattern path: stream '{stream_id}' has no "
+                    f"partition key executor")
+            keys = ex.keys(data)
+            keep = np.asarray([k is not None for k in keys], bool)
+            if not keep.all():
+                data = data.mask(keep)
+                keys = [k for k in keys if k is not None]
+                n = len(data)
+                if n == 0:
+                    return
+            if self.shards is not None:
+                self._ingest_sharded(stream_code, data, keys, n)
+                _record_block(self, prof, disp0, ticks0, stream_id, n,
+                              junction=self._junctions.get(stream_id))
+                return
+            pids = self._lanes_for_keys(keys)
+        else:
+            pids = np.zeros(n, np.int64)
+        if self.nfa.mesh is not None:
+            t_max = int(np.bincount(pids, minlength=1).max())
+            if self._ub_active + t_max > self.nfa.spec.n_slots:
+                actual = self.nfa.max_active_slots()
+                need = actual + t_max
+                if need > self.nfa.spec.n_slots:
+                    self.nfa.grow_slots(1 << (need - 1).bit_length())
+                self._ub_active = actual
+            self._ub_active = min(self._ub_active + t_max,
+                                  self.nfa.spec.n_slots)
+        cols = self._event_cols(data, n)
         ts_arr = np.asarray(data.timestamps, np.int64)
         codes = np.full(n, stream_code, np.int32)
         with _ledger().span("device"):
@@ -446,6 +657,9 @@ class DevicePatternRuntime:
         query lock (re-entrant) — state reads can race the junction
         worker's ingest."""
         with self.qr.lock:
+            if self.shards is not None:
+                for sh in self.shards:
+                    self._flush_shard(sh)
             while self._inflight:
                 self._retire_one()
 
@@ -522,7 +736,8 @@ class DevicePatternRuntime:
         self.flush()
         self._shutdown = True
         # packed tenants leave their bucket on shutdown; co-tenants'
-        # shared-gang state is untouched (plan/xtenant.py evict contract)
+        # shared-gang state is untouched (plan/xtenant.py evict contract).
+        # Sharded NFAs never registered, and evict is a no-op for them.
         from .xtenant import tenant_packer
         tenant_packer().evict(self.nfa)
 
@@ -531,17 +746,34 @@ class DevicePatternRuntime:
     def current_state(self) -> dict:
         with self.qr.lock:
             self.flush()
+            if self.shards is not None:
+                # shard-granular checkpoint: each slab snapshots
+                # independently (keys route by the pinned FNV hash, so a
+                # restored shard's keys still land on it)
+                return {"shards": [{"nfa": sh.engine.current_state(),
+                                    "key_lanes": dict(sh.key_lanes)}
+                                   for sh in self.shards]}
             return {"nfa": self.nfa.current_state(),
                     "key_lanes": dict(self.key_lanes)}
 
     def restore_state(self, state: dict) -> None:
         with self.qr.lock:
             self.flush()
+            snap_shards = state.get("shards")
+            if snap_shards is not None or self.shards is not None:
+                _check_shard_count(self.shards, snap_shards)
+                for sh, s in zip(self.shards, snap_shards):
+                    sh.engine.restore_state(s["nfa"])
+                    sh.engine.pin_to_device(sh.device)
+                    sh.key_lanes = KeyLanes(s.get("key_lanes") or {})
+                    sh.dropped_seen = int(
+                        np.asarray(sh.engine.carry["dropped"]).sum())
+                return
             self.nfa.restore_state(state["nfa"])
             # the restored carry's lanes are only meaningful with the
             # snapshot's key→lane map; dropping it would hand restored
             # lanes of one key to fresh keys
-            self.key_lanes = dict(state.get("key_lanes") or {})
+            self.key_lanes = KeyLanes(state.get("key_lanes") or {})
             # force the overflow guard to re-sync against the restored
             # carry
             self._ub_active = self.nfa.spec.n_slots
@@ -580,8 +812,12 @@ class DeviceWindowedAggRuntime(PipelinedDeviceIngest):
                    OutputEventsFor.CURRENT) != OutputEventsFor.CURRENT:
             raise SiddhiAppCreationError(
                 "device wagg path: expired-event output is host-only")
-        self.cwa = CompiledWindowedAgg(app.app, n_partitions=GROW_START,
-                                       query=q, use_pallas=False)
+        # always keyed (partition-driven); shard-out splits the key space
+        # over engine clones when SIDDHI_TPU_SHARDS >= 2
+        self._shard_want = resolve_shards()
+        self.cwa = CompiledWindowedAgg(
+            app.app, n_partitions=initial_lanes(app.app, self._shard_want),
+            query=q, use_pallas=False)
         # the kernel sees int32 ts offsets while the host-twin emission
         # filter sees true int64 — absolute-timestamp filters would diverge
         if any(_scan_fns(e, _is_time_fn) for e in self.cwa.filter_exprs):
@@ -610,7 +846,7 @@ class DeviceWindowedAggRuntime(PipelinedDeviceIngest):
                     "key")
         self.key_executor = ex
         self.qr = qr
-        self.key_lanes: Dict[Any, int] = {}
+        self.key_lanes: Dict[Any, int] = KeyLanes()
         self._dtype_for = dtype_for
 
         # host-side twin of the filters for emission masking (same exprs,
@@ -674,6 +910,16 @@ class DeviceWindowedAggRuntime(PipelinedDeviceIngest):
         from .pipeline import egress_fuser_for
         self.app_name = app.name
         self._fuser = egress_fuser_for(app)
+        self.shards: Optional[List[Any]] = None
+        if self._shard_want >= 2:
+            # fused egress concatenates on one device — sharded engines
+            # span several, so each shard's outputs ride async copies.
+            # Built AFTER the warm trace so every clone shares the
+            # template's already-compiled step.
+            self._fuser = None
+            self.shards = build_shards(self.cwa, self._shard_want)
+            for sh in self.shards:
+                sh.key_lanes = KeyLanes()
 
     # ------------------------------------------------------------ ingest
 
@@ -701,6 +947,10 @@ class DeviceWindowedAggRuntime(PipelinedDeviceIngest):
             if data.is_empty:
                 return
         n = len(data)
+        if self.shards is not None:
+            self._ingest_sharded(data, keys)
+            _record_block(self, prof, disp0, ticks0, stream_id, n)
+            return
         lanes = map_keys_to_lanes(self.key_lanes, keys,
                                   self.cwa.n_partitions, self._grow)
         P = self.cwa.n_partitions
@@ -738,6 +988,63 @@ class DeviceWindowedAggRuntime(PipelinedDeviceIngest):
         self._submit({"outs": outs, "fuse": token, "data": data,
                       "lanes": lanes, "rows": rows})
         _record_block(self, prof, disp0, ticks0, stream_id, n)
+
+    def _ingest_sharded(self, data, keys: List[Any]) -> None:
+        """Hash-route the chunk and run each shard's sub-block through
+        its own window slab.  The retire path is untouched: a work item
+        carries its own lanes/rows/data, and _retire never mutates
+        engine state, so shard works share the pipeline queue safely."""
+        from ..ops.nfa import pack_blocks
+        keys_arr = np.asarray(keys)
+        ts_all = np.asarray(data.timestamps, np.int64)
+        for sid, rows_idx in split_rows(keys_arr, len(self.shards)):
+            sh = self.shards[sid]
+            m = np.zeros(len(data), bool)
+            m[rows_idx] = True
+            sub = data.mask(m)
+            n = len(sub)
+
+            def grow(cap, sh=sh):
+                # same width contract as _grow; the full flush is cheap
+                # (retire only reads) and keeps one code path
+                self.flush()
+                sh.engine.grow(cap)
+                sh.grows += 1
+
+            lanes = map_keys_to_lanes(sh.key_lanes, keys_arr[rows_idx],
+                                      sh.engine.n_partitions, grow)
+            P = sh.engine.n_partitions
+            cols = {a.name: np.asarray(sub.columns[a.name])
+                    for a in self.cwa.input_definition.attributes
+                    if a.name in sub.columns and
+                    sub.columns[a.name].dtype != object}
+            ts_arr = ts_all[rows_idx]
+            block, rows = pack_blocks(lanes, cols, ts_arr,
+                                      np.zeros(n, np.int32), P,
+                                      base_ts=int(ts_arr[0]),
+                                      pad_t_pow2=True, return_rows=True)
+            if self.cwa.window_kind == "time":
+                src = (np.asarray(sub.columns[self.cwa.ts_attr], np.int64)
+                       if self.cwa.ts_attr else ts_arr)
+                ts64 = np.zeros(block["__ts"].shape, np.int64)
+                ts64[lanes, rows] = src
+                block["__ts64"] = ts64
+            with _ledger().span("device"):
+                outs = sh.engine.process_block(block)
+            for o in outs:
+                try:
+                    o.copy_to_host_async()
+                except Exception:
+                    break
+            sh.events += n
+            sh.dispatches += 1
+            self._submit({"outs": outs, "fuse": None, "data": sub,
+                          "lanes": lanes, "rows": rows})
+
+    def shard_stats(self) -> Optional[List[dict]]:
+        if self.shards is None:
+            return None
+        return [sh.stats_row() for sh in self.shards]
 
     def _retire(self, work) -> None:
         from ..core.event import EventChunk
@@ -801,14 +1108,26 @@ class DeviceWindowedAggRuntime(PipelinedDeviceIngest):
     def current_state(self) -> dict:
         with self.qr.lock:
             self.flush()
+            if self.shards is not None:
+                return {"shards": [{"cwa": sh.engine.current_state(),
+                                    "key_lanes": dict(sh.key_lanes)}
+                                   for sh in self.shards]}
             return {"cwa": self.cwa.current_state(),
                     "key_lanes": dict(self.key_lanes)}
 
     def restore_state(self, state: dict) -> None:
         with self.qr.lock:
             self.flush()
+            snap_shards = state.get("shards")
+            if snap_shards is not None or self.shards is not None:
+                _check_shard_count(self.shards, snap_shards)
+                for sh, s in zip(self.shards, snap_shards):
+                    sh.engine.restore_state(s["cwa"])
+                    sh.engine.pin_to_device(sh.device)
+                    sh.key_lanes = KeyLanes(s["key_lanes"])
+                return
             self.cwa.restore_state(state["cwa"])
-            self.key_lanes = dict(state["key_lanes"])
+            self.key_lanes = KeyLanes(state["key_lanes"])
 
 
 class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
@@ -855,9 +1174,11 @@ class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
             raise SiddhiAppCreationError(
                 "device grouped-agg path: named-window input is host-only")
         self.keyed = key_executors is not None
-        self.cga = CompiledGroupedAgg(app.app, q,
-                                      n_lanes=GROW_START if self.keyed
-                                      else 1)
+        self._shard_want = resolve_shards() if self.keyed else 0
+        self.cga = CompiledGroupedAgg(
+            app.app, q,
+            n_lanes=initial_lanes(app.app, self._shard_want)
+            if self.keyed else 1)
         if self.keyed:
             ex = key_executors.get(self.cga.stream_id)
             if ex is None:
@@ -865,7 +1186,7 @@ class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
                     f"device grouped-agg path: stream "
                     f"'{self.cga.stream_id}' has no partition key executor")
             self.key_executor = ex
-        self.key_lanes: Dict[Any, int] = {}
+        self.key_lanes: Dict[Any, int] = KeyLanes()
         self.qr = qr
         self._dtype_for = dtype_for
 
@@ -889,6 +1210,18 @@ class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
         # the compiler owns dispatch/decode, so it registers its own
         # output buffers on the app slab
         self.cga.egress_fuser = egress_fuser_for(app)
+        self.shards: Optional[List[Any]] = None
+        if self._shard_want >= 2:
+            # per-device engines can't share the one-device egress slab;
+            # clones share the template's jitted planes but own fresh
+            # group dictionaries (clone_for_shard), so group ids stay
+            # shard-local.  Every shard's group growth funnels through
+            # the shared flush (pre-carries of in-flight works go stale)
+            self.cga.egress_fuser = None
+            self.shards = build_shards(self.cga, self._shard_want)
+            for sh in self.shards:
+                sh.key_lanes = KeyLanes()
+                sh.engine.flush_hook = self.flush
 
     # ------------------------------------------------------------ ingest
 
@@ -915,6 +1248,11 @@ class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
                 keys = [k for k in keys if k is not None]
                 if data.is_empty:
                     return
+            if self.shards is not None:
+                self._ingest_sharded(data, keys)
+                _record_block(self, prof, disp0, ticks0, stream_id,
+                              len(data))
+                return
             lanes = map_keys_to_lanes(self.key_lanes, keys,
                                       self.cga.n_lanes,
                                       self._grow_lanes)
@@ -927,27 +1265,82 @@ class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
         self._submit(work)
         _record_block(self, prof, disp0, ticks0, stream_id, len(data))
 
+    def _ingest_sharded(self, data, keys: List[Any]) -> None:
+        """Hash-route the chunk; each shard's sub-block dispatches on its
+        own engine.  Works carry a "shard" tag so the retire path decodes
+        (and, on overflow, rewinds/replays) against the right engine
+        while sibling shards' in-flight works stay queued untouched."""
+        keys_arr = np.asarray(keys)
+        for sid, rows in split_rows(keys_arr, len(self.shards)):
+            sh = self.shards[sid]
+            m = np.zeros(len(data), bool)
+            m[rows] = True
+            sub = data.mask(m)
+
+            def grow(cap, sh=sh):
+                self.flush()
+                sh.engine.grow_lanes(cap)
+                sh.grows += 1
+
+            lanes = map_keys_to_lanes(sh.key_lanes, keys_arr[rows],
+                                      sh.engine.n_lanes, grow)
+            with _ledger().span("device"):
+                work = sh.engine.dispatch(lanes, sub)
+            sh.events += len(rows)
+            if work is None:
+                continue
+            sh.dispatches += 1
+            work["shard"] = sh
+            self._submit(work)
+
+    def shard_stats(self) -> Optional[List[dict]]:
+        if self.shards is None:
+            return None
+        return [sh.stats_row() for sh in self.shards]
+
+    def _take_same_shard(self, sh) -> list:
+        """Pull the failing engine's LATER in-flight works out of the
+        shared queue for replay; other shards' works keep their queue
+        positions (their pre-carries reference different engines and
+        stay valid).  Unsharded: takes everything — the original
+        behavior."""
+        if sh is None:
+            rest = list(self._inflight)
+            self._inflight.clear()
+            return rest
+        mine = [w for w in self._inflight if w.get("shard") is sh]
+        keep = [w for w in self._inflight if w.get("shard") is not sh]
+        self._inflight.clear()
+        self._inflight.extend(keep)
+        return mine
+
     def _retire(self, work) -> None:
         from .gagg_compiler import GaggOverflow
+        sh = work.get("shard")
+        eng = sh.engine if sh is not None else self.cga
         try:
-            res = self.cga.decode(work)
+            res = eng.decode(work)
         except GaggOverflow:
             # a still-in-window time-ring entry was evicted: rewind to
             # this chunk's pre-carry, grow the ring, replay it and every
-            # later in-flight chunk (exact — no undercounted windows)
-            pending = [work] + list(self._inflight)
-            self._inflight.clear()
-            self.cga.carry = work["pre_carry"]
-            self.cga.grow_time_window()
+            # later in-flight chunk OF THIS ENGINE (exact — no
+            # undercounted windows); sibling shards are untouched
+            pending = [work] + self._take_same_shard(sh)
+            eng.carry = work["pre_carry"]
+            eng.grow_time_window()
+            if sh is not None:
+                sh.grows += 1
             for w in pending:
                 while True:
-                    self.cga.redispatch(w)
+                    eng.redispatch(w)
                     try:
-                        res = self.cga.decode(w)
+                        res = eng.decode(w)
                         break
                     except GaggOverflow:
-                        self.cga.carry = w["pre_carry"]
-                        self.cga.grow_time_window()
+                        eng.carry = w["pre_carry"]
+                        eng.grow_time_window()
+                        if sh is not None:
+                            sh.grows += 1
                 self._emit(w, res)
             return
         except SiddhiAppRuntimeException:
@@ -959,15 +1352,14 @@ class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
             # trips the bound AGAIN (the rewind moved it closer to the
             # limit) is un-applied and dropped the same way, never left
             # half-applied
-            rest = list(self._inflight)
-            self._inflight.clear()
-            self.cga.carry = work["pre_carry"]
+            rest = self._take_same_shard(sh)
+            eng.carry = work["pre_carry"]
             for w in rest:
-                self.cga.redispatch(w)
+                eng.redispatch(w)
                 try:
-                    res = self.cga.decode(w)
+                    res = eng.decode(w)
                 except SiddhiAppRuntimeException:
-                    self.cga.carry = w["pre_carry"]
+                    eng.carry = w["pre_carry"]
                     continue
                 self._emit(w, res)
             raise
@@ -1004,14 +1396,26 @@ class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
     def current_state(self) -> dict:
         with self.qr.lock:
             self.flush()
+            if self.shards is not None:
+                return {"shards": [{"cga": sh.engine.current_state(),
+                                    "key_lanes": dict(sh.key_lanes)}
+                                   for sh in self.shards]}
             return {"cga": self.cga.current_state(),
                     "key_lanes": dict(self.key_lanes)}
 
     def restore_state(self, state: dict) -> None:
         with self.qr.lock:
             self.flush()
+            snap_shards = state.get("shards")
+            if snap_shards is not None or self.shards is not None:
+                _check_shard_count(self.shards, snap_shards)
+                for sh, s in zip(self.shards, snap_shards):
+                    sh.engine.restore_state(s["cga"])
+                    sh.engine.pin_to_device(sh.device)
+                    sh.key_lanes = KeyLanes(s["key_lanes"])
+                return
             self.cga.restore_state(state["cga"])
-            self.key_lanes = dict(state["key_lanes"])
+            self.key_lanes = KeyLanes(state["key_lanes"])
 
 
 class DeviceFilterRuntime(PipelinedDeviceIngest):

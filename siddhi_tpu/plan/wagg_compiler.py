@@ -196,7 +196,9 @@ class CompiledWindowedAgg:
                 else make_time_wagg_carry(n, self.window))
 
     def grow(self, n_partitions: int) -> None:
-        """Widen the group-lane axis (keyed partitioning slab growth)."""
+        """Widen the group-lane axis (keyed partitioning slab growth).
+        Growth concatenates onto the COMMITTED carry, so a shard-pinned
+        engine (parallel/shards.py) grows on its own device."""
         if n_partitions <= self.n_partitions:
             return
         if self.use_pallas and n_partitions % LANES:
@@ -206,6 +208,27 @@ class CompiledWindowedAgg:
             *[jnp.concatenate([a, b], axis=0)
               for a, b in zip(self.carry, fresh)])
         self.n_partitions = n_partitions
+
+    # ------------------------------------------------ partition shard-out
+
+    def pin_to_device(self, device) -> None:
+        """Commit the carry to one device (parallel/shards.py): jit
+        dispatch follows committed operands, so steps and growth stay
+        shard-local."""
+        self.shard_device = device
+        self.carry = jax.device_put(self.carry, device)
+
+    def clone_for_shard(self, device) -> "CompiledWindowedAgg":
+        """Fresh-state shard clone pinned to `device`: shares the jitted
+        step and all compiled plans; owns its carry (and time-ring
+        rebasing base), so capacity growth is shard-local."""
+        import copy
+        cl = copy.copy(self)
+        cl.shard_device = device
+        if cl.window_kind == "time":
+            cl._ts_base = None
+        cl.carry = jax.device_put(cl._make_carry(cl.n_partitions), device)
+        return cl
 
     # ------------------------------------------------- time-window capacity
 

@@ -61,6 +61,16 @@ def test_bench_smoke_runs_clean():
     assert msm["matches"] > 0
     assert msm["packed_dispatches_per_block"] < \
         msm["unpacked_dispatches_per_block"]
+    # partition-axis shard-out (round 15): 1/2/4-shard fans over the
+    # same keyed feed emit bit-identical rows (parity asserted inside
+    # bench_shardscale), every key owned by exactly one shard, FNV
+    # ownership balanced
+    ssm = out["shardscale_smoke"]
+    assert ssm["keys"] == 512
+    assert ssm["parity_rows"] > 0
+    assert len(ssm["shard_keys"]) == 4
+    assert sum(ssm["shard_keys"]) == 512
+    assert 1.0 <= ssm["max_imbalance"] < 1.5
     # ingest armor (round 9): SHED_OLDEST under a wedged consumer, with
     # exact accounting asserted inside the smoke and visible here
     osm = out["overload_smoke"]
@@ -119,6 +129,29 @@ def test_fail_on_p99_gate():
     assert res.returncode == 0, res.stdout + res.stderr
     wf = json.loads(res.stdout.strip().splitlines()[-1])
     assert wf["waterfall"] and wf["coverage_p50"] > 0
+
+
+def test_fail_on_imbalance_gate():
+    """--fail-on-imbalance on the shardscale phase: the max/mean key
+    ratio is >= 1 by construction, so a sub-1 threshold must exit 1
+    with the FAIL line; a generous one must pass rc 0."""
+    args = ["--phase", "shardscale", "--sc-keys", "1024",
+            "--sc-shards", "1,4"]
+    env = {"JAX_PLATFORMS": "cpu", "SIDDHI_TPU_MESH": "off"}
+    res = _run(args + ["--fail-on-imbalance", "0.99"], env_extra=env)
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "[bench] FAIL" in res.stderr
+    assert "--fail-on-imbalance" in res.stderr
+    # the phase still printed its JSON before the gate tripped
+    sc = json.loads(res.stdout.strip().splitlines()[-1])
+    assert sc["shardscale_max_imbalance"] >= 1.0
+
+    res = _run(args + ["--fail-on-imbalance", "10.0"], env_extra=env)
+    assert res.returncode == 0, res.stdout + res.stderr
+    sc = json.loads(res.stdout.strip().splitlines()[-1])
+    row4 = next(r for r in sc["shardscale"] if r["shards"] == 4)
+    assert len(row4["shard_keys"]) == 4
+    assert sum(row4["shard_keys"]) == 1024
 
 
 def test_bench_skips_on_unreachable_backend():

@@ -1,0 +1,473 @@
+"""Partition-axis shard-out (round 15).
+
+The routing contract is load-bearing: ``fnv1a(str(key))`` picks the
+owning shard (and the owning PROCESS in parallel/multihost.py), and
+per-shard checkpoints are addressed by that assignment — so the literal
+hash vectors pinned here must NEVER change.  A drift would silently
+re-route keys away from their carried NFA state after a restore.
+
+Beyond the routing pins: randomized sharded-vs-monolithic parity for
+the pattern / windowed-agg / grouped-agg device runtimes, elastic
+per-shard growth that provably leaves sibling carries untouched
+(object identity), the per-shard snapshot/restore path, and the
+plan-IR / cost-model / statistics shard surfaces.
+"""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.core.snapshot import InMemoryPersistenceStore
+from siddhi_tpu.parallel.shards import (fnv1a, fnv1a_vec, owner_ids,
+                                        routing_digest, split_rows)
+from siddhi_tpu.utils.errors import SiddhiAppRuntimeException
+
+PATTERN_APP = """
+@app:name('ShardPat')
+define stream In (k string, v double);
+partition with (k of In)
+begin
+  @info(name='q')
+  from every e1=In[v > 1.0] -> e2=In[v > 2.0]
+  select e1.k as k, e1.v as a, e2.v as b insert into Out;
+end;
+"""
+
+WAGG_APP = """
+@app:name('ShardWagg')
+define stream S (k int, v float);
+partition with (k of S)
+begin
+  @info(name='q')
+  from S[v > 2.0]#window.length(5)
+  select k, sum(v) as total, count() as n group by k
+  insert into Out;
+end;
+"""
+
+GAGG_APP = """
+@app:name('ShardGagg')
+define stream S (k string, v double);
+partition with (k of S)
+begin
+  @info(name='q')
+  from S select k, sum(v) as total group by k insert into Out;
+end;
+"""
+
+
+def _shard_env(monkeypatch, n):
+    monkeypatch.setenv("SIDDHI_TPU_MESH", "off")
+    monkeypatch.setenv("SIDDHI_TPU_SHARDS", str(n))
+
+
+def _pattern_dev(rt):
+    pr = rt.partition_runtimes[0]
+    assert pr.device_mode
+    (qr,) = pr.device_query_runtimes.values()
+    return qr.device_runtime
+
+
+# ------------------------------------------------------------ routing pins
+
+def test_fnv1a_pinned_literals():
+    # canonical FNV-1a 64 over str(key) utf-8 — the checkpoint contract
+    assert fnv1a("") == 0xCBF29CE484222325          # offset basis
+    assert fnv1a("a") == 0xAF63DC4C8601EC8C
+    assert fnv1a("key-0") == 0x71135BF295F28059
+    assert fnv1a("key-1") == 0x71135AF295F27EA6
+    assert fnv1a("ABC") == 0xFA2FE219A07442EB
+    # int keys hash via str(key) — NOT repr, NOT the raw bytes
+    assert fnv1a(0) == 0xAF63AD4C86019CAF
+    assert fnv1a(1) == 0xAF63AC4C86019AFC
+    assert fnv1a(42) == 0x07EE7E07B4B19223
+    assert fnv1a(12345678901234) == 0x687867B9E0181BF8
+    assert fnv1a(7) == fnv1a("7") == fnv1a(np.int64(7))
+
+
+def test_routing_digest_pinned():
+    assert routing_digest() == "8ab7ab948ebacb18"
+
+
+def test_owner_ids_pinned_vectors():
+    keys = np.array([f"key-{i}" for i in range(8)], object)
+    assert owner_ids(keys, 8).tolist() == [1, 6, 3, 0, 5, 2, 7, 4]
+    assert owner_ids(keys, 4).tolist() == [1, 2, 3, 0, 1, 2, 3, 0]
+    assert owner_ids(np.arange(8), 8).tolist() == [7, 4, 5, 2, 3, 0, 1, 6]
+
+
+def test_multihost_owner_of_matches_shard_router():
+    from siddhi_tpu.parallel.multihost import owner_of
+    for key in ("key-0", "key-1", "ABC", 0, 42, "", "k" * 100):
+        for nproc in (2, 4, 8):
+            assert owner_of(key, nproc) == fnv1a(key) % nproc
+    # the pinned process assignment at nproc=8 (satellite 1: the
+    # vectorized send_batch router must keep this forever)
+    assert [owner_of(f"key-{i}", 8) for i in range(8)] == \
+        [1, 6, 3, 0, 5, 2, 7, 4]
+
+
+def test_fnv1a_vec_matches_scalar():
+    rng = np.random.default_rng(5)
+    str_keys = np.array([f"sym-{i}" for i in range(200)] + ["", "a", "Z"],
+                        object)
+    int_keys = rng.integers(-10**12, 10**12, 200)
+    for arr in (str_keys, int_keys,
+                np.array(["x"], object), np.array([], object)):
+        vec = fnv1a_vec(arr)
+        assert vec.tolist() == [fnv1a(k) for k in arr.tolist()]
+
+
+def test_split_rows_partitions_by_owner():
+    rng = np.random.default_rng(7)
+    keys = np.array([f"k{i}" for i in rng.integers(0, 50, 400)], object)
+    for n in (2, 4, 8):
+        owners = owner_ids(keys, n)
+        seen = []
+        for sid, rows in split_rows(keys, n):
+            assert len(rows) > 0                      # empty shards omitted
+            assert (np.diff(rows) > 0).all()          # per-key order kept
+            assert (owners[rows] == sid).all()
+            seen.extend(rows.tolist())
+        assert sorted(seen) == list(range(len(keys)))  # disjoint cover
+
+
+def test_owner_balance_at_scale():
+    # 100k distinct keys over 8 owners: FNV must stay within a few
+    # percent of uniform (this is the bench --fail-on-imbalance contract)
+    keys = np.arange(100_000)
+    counts = np.bincount(owner_ids(keys, 8), minlength=8)
+    assert counts.sum() == 100_000
+    assert counts.max() / counts.mean() < 1.05
+
+
+# ------------------------------------------------------------ key lanes
+
+def test_keylanes_vectorized_lookup_all_hit():
+    from siddhi_tpu.plan.planner import KeyLanes, map_keys_to_lanes
+    kl = KeyLanes()
+    keys = np.arange(100, dtype=np.int64)
+    first = map_keys_to_lanes(kl, keys, 128, lambda c: None)
+    assert len(set(first.tolist())) == 100            # distinct lanes
+    again = map_keys_to_lanes(kl, keys[::-1].copy(), 128, lambda c: None)
+    assert np.array_equal(again, first[::-1])         # stable mapping
+    # the cached sorted-key view must notice appended keys
+    more = map_keys_to_lanes(kl, np.arange(100, 140, dtype=np.int64),
+                             256, lambda c: None)
+    assert len(set(kl.values())) == 140
+    assert not set(more.tolist()) & set(first.tolist())
+
+
+def test_keylanes_string_keys():
+    from siddhi_tpu.plan.planner import KeyLanes, map_keys_to_lanes
+    kl = KeyLanes()
+    keys = np.array([f"s{i:03d}" for i in range(80)], object)
+    first = map_keys_to_lanes(kl, keys, 128, lambda c: None)
+    again = map_keys_to_lanes(kl, keys, 128, lambda c: None)
+    assert np.array_equal(first, again)
+    assert kl.lookup(np.array(["s000", "s079"])) is not None
+
+
+# ------------------------------------------------------------ parity
+
+def _feed_pattern(n_shards, monkeypatch, n_keys=40, n_blocks=8,
+                  block=300, seed=11):
+    _shard_env(monkeypatch, n_shards)
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(PATTERN_APP)
+    got = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: got.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    h = rt.get_input_handler("In")
+    rng = np.random.default_rng(seed)
+    t0 = 1_000_000
+    for _ in range(n_blocks):
+        ki = rng.integers(0, n_keys, block)
+        h.send_batch(
+            {"k": np.array([f"key-{i}" for i in ki], object),
+             "v": rng.uniform(0.0, 3.0, block)},
+            timestamps=t0 + np.arange(block, dtype=np.int64))
+        t0 += block
+    rt.flush()
+    snap = rt.statistics
+    m.shutdown()
+    return sorted(got), snap
+
+
+def test_sharded_pattern_parity_and_stats(monkeypatch):
+    mono, snap0 = _feed_pattern(0, monkeypatch)
+    assert len(mono) > 0
+    assert "shards" not in snap0                    # kill switch: no rows
+    for n in (2, 4):
+        shard, snap = _feed_pattern(n, monkeypatch)
+        assert shard == mono, f"pattern parity FAILED at S={n}"
+        rows = next(iter(snap["shards"].values()))
+        assert len(rows) == n
+        assert sum(r["keys"] for r in rows) == 40
+        assert sum(r["events"] for r in rows) == 8 * 300
+        assert len({r["device"] for r in rows}) == n  # own device each
+
+
+def _feed_wagg(n_shards, monkeypatch, seed=3):
+    _shard_env(monkeypatch, n_shards)
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(WAGG_APP)
+    got = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: got.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    h = rt.get_input_handler("S")
+    rng = np.random.default_rng(seed)
+    t0 = 1_000_000
+    for _ in range(6):
+        n = 500
+        h.send_batch(
+            {"k": rng.integers(0, 24, n).astype(np.int32),
+             "v": rng.uniform(0.0, 10.0, n).astype(np.float32)},
+            timestamps=t0 + np.arange(n, dtype=np.int64))
+        t0 += n
+    rt.flush()
+    m.shutdown()
+    return sorted(got)
+
+
+def test_sharded_wagg_parity(monkeypatch):
+    mono = _feed_wagg(0, monkeypatch)
+    assert len(mono) > 0
+    for n in (2, 4):
+        assert _feed_wagg(n, monkeypatch) == mono, \
+            f"wagg parity FAILED at S={n}"
+
+
+def _feed_gagg(n_shards, monkeypatch, seed=9):
+    _shard_env(monkeypatch, n_shards)
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(GAGG_APP)
+    got = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: got.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    h = rt.get_input_handler("S")
+    rng = np.random.default_rng(seed)
+    t0 = 1_000_000
+    for _ in range(6):
+        n = 400
+        ki = rng.integers(0, 30, n)
+        h.send_batch(
+            {"k": np.array([f"g{i}" for i in ki], object),
+             "v": rng.uniform(0.0, 5.0, n)},
+            timestamps=t0 + np.arange(n, dtype=np.int64))
+        t0 += n
+    rt.flush()
+    m.shutdown()
+    return sorted(got)
+
+
+def test_sharded_gagg_parity(monkeypatch):
+    mono = _feed_gagg(0, monkeypatch)
+    assert len(mono) > 0
+    for n in (2, 4):
+        assert _feed_gagg(n, monkeypatch) == mono, \
+            f"gagg parity FAILED at S={n}"
+
+
+# ------------------------------------------------------------ elasticity
+
+def test_hot_shard_growth_leaves_siblings_untouched(monkeypatch):
+    """Mid-feed growth of ONE shard must not touch sibling engines: no
+    re-trace, no replay, not even a new carry object — the whole point
+    of per-shard elasticity."""
+    _shard_env(monkeypatch, 4)
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(PATTERN_APP)
+    got = [0]
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: got.__setitem__(0, got[0] + len(evs))))
+    rt.start()
+    h = rt.get_input_handler("In")
+
+    # phase 1: a few keys on every shard
+    warm = np.array([f"key-{i}" for i in range(8)], object)
+    h.send_batch({"k": warm[np.arange(64) % 8],
+                  "v": np.tile([1.5, 2.5], 32)},
+                 timestamps=1_000_000 + np.arange(64, dtype=np.int64))
+    rt.flush()
+
+    dev = _pattern_dev(rt)
+    assert dev.shards is not None and len(dev.shards) == 4
+    hot = dev.shards[0]
+    # keys owned by the hot shard only — enough distinct ones to force
+    # its lane slab past capacity
+    candidates = np.array([f"grow-{i}" for i in range(4000)], object)
+    mine = candidates[owner_ids(candidates, 4) == 0]
+    need = int(hot.engine.n_partitions) + 8
+    assert len(mine) >= need
+    mine = mine[:need]
+
+    before = {i: (sh.engine.carry, sh.engine.n_partitions, sh.grows)
+              for i, sh in enumerate(dev.shards) if i != 0}
+    cap0 = hot.engine.n_partitions
+
+    reps = np.repeat(mine, 2)           # e1 then e2 per key -> matches
+    vals = np.tile([1.5, 2.5], len(mine))
+    h.send_batch({"k": reps, "v": vals},
+                 timestamps=2_000_000 + np.arange(len(reps),
+                                                  dtype=np.int64))
+    rt.flush()
+
+    assert hot.engine.n_partitions > cap0, "hot shard never grew"
+    assert hot.grows > 0
+    for i, sh in enumerate(dev.shards):
+        if i == 0:
+            continue
+        carry, cap, grows = before[i]
+        assert sh.engine.carry is carry, \
+            f"sibling shard {i} carry was touched by shard 0's growth"
+        assert sh.engine.n_partitions == cap
+        assert sh.grows == grows
+    assert got[0] > 0
+    m.shutdown()
+
+
+# ------------------------------------------------------------ checkpoint
+
+def test_sharded_persist_restore_roundtrip(monkeypatch):
+    _shard_env(monkeypatch, 4)
+    store = InMemoryPersistenceStore()
+    rng = np.random.default_rng(21)
+    n = 600
+    ki = rng.integers(0, 32, 2 * n)
+    vv = rng.uniform(0.0, 5.0, 2 * n)
+
+    def fresh():
+        m = SiddhiManager()
+        m.set_persistence_store(store)
+        rt = m.create_siddhi_app_runtime(GAGG_APP)
+        last = {}
+        rt.add_callback("Out", StreamCallback(
+            lambda evs: [last.__setitem__(e.data[0], e.data[1])
+                         for e in evs]))
+        rt.start()
+        return m, rt, last
+
+    def feed(rt, lo, hi, t0):
+        rt.get_input_handler("S").send_batch(
+            {"k": np.array([f"g{i}" for i in ki[lo:hi]], object),
+             "v": vv[lo:hi]},
+            timestamps=t0 + np.arange(hi - lo, dtype=np.int64))
+        rt.flush()
+
+    m1, rt1, _ = fresh()
+    feed(rt1, 0, n, 1_000_000)
+    rt1.persist()
+    rt1.shutdown()
+
+    m2, rt2, last = fresh()
+    rt2.restore_last_revision()
+    feed(rt2, n, 2 * n, 2_000_000)
+    rt2.shutdown()
+
+    expect = {}
+    for i, v in zip(ki, vv):
+        expect[f"g{i}"] = expect.get(f"g{i}", 0.0) + v
+    # every key fed in phase 2 must report its FULL (pre+post restore)
+    # running sum — per-shard carries really came back
+    for key in {f"g{i}" for i in ki[n:]}:
+        assert last[key] == pytest.approx(expect[key], rel=1e-5)
+
+
+def test_shard_count_mismatch_rejected(monkeypatch):
+    _shard_env(monkeypatch, 4)
+    store = InMemoryPersistenceStore()
+    m = SiddhiManager()
+    m.set_persistence_store(store)
+    rt = m.create_siddhi_app_runtime(GAGG_APP)
+    rt.start()
+    rt.get_input_handler("S").send_batch(
+        {"k": np.array([f"g{i}" for i in range(16)], object),
+         "v": np.ones(16)},
+        timestamps=1_000_000 + np.arange(16, dtype=np.int64))
+    rt.flush()
+    rt.persist()
+    rt.shutdown()
+
+    # the routing is modular in the shard count: restoring 4-shard
+    # state into a 2-shard runtime would scatter keys away from their
+    # carries — must be rejected loudly
+    _shard_env(monkeypatch, 2)
+    m2 = SiddhiManager()
+    m2.set_persistence_store(store)
+    rt2 = m2.create_siddhi_app_runtime(GAGG_APP)
+    rt2.start()
+    with pytest.raises(SiddhiAppRuntimeException, match="shard"):
+        rt2.restore_last_revision()
+    rt2.shutdown()
+
+
+# ------------------------------------------------------------ surfaces
+
+def test_plan_ir_and_cost_model_shard_surfaces(monkeypatch):
+    from siddhi_tpu.analysis.cost_model import (nfa_egress_bytes,
+                                                nfa_state_bytes,
+                                                plan_cost)
+    from siddhi_tpu.analysis.plan_ir import extract_plan
+    _shard_env(monkeypatch, 4)
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(PATTERN_APP)
+    rt.start()
+    plan = extract_plan(rt)
+    (a,) = plan.automata
+    assert a.shards == 4
+    assert len(a.shard_partitions) == 4
+    assert f"shards={a.shards} " in plan.dump()
+    d = a.as_dict()
+    assert d["shards"] == 4 and len(d["shard_partitions"]) == 4
+    (entry,) = plan_cost(plan).entries
+    want = sum(sum(nfa_state_bytes(a, n_partitions=p).values())
+               for p in a.shard_partitions) + nfa_egress_bytes(a)
+    assert entry.hbm_bytes == want
+    m.shutdown()
+
+    # monolithic control: the new fields stay invisible (goldens)
+    _shard_env(monkeypatch, 0)
+    m2 = SiddhiManager()
+    rt2 = m2.create_siddhi_app_runtime(PATTERN_APP)
+    rt2.start()
+    p2 = extract_plan(rt2)
+    assert p2.automata[0].shards == 0
+    assert "shards" not in p2.automata[0].as_dict()
+    assert "shards=" not in p2.dump()
+    m2.shutdown()
+
+
+def test_shard_eligibility_gate_absent(monkeypatch):
+    _shard_env(monkeypatch, 4)
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        define stream S (k string, v double);
+        partition with (k of S) begin
+        @info(name='q')
+        from e1=S[v > 1.0] -> not S[v > e1.v] for 1 sec
+        select e1.k as k insert into Out; end;
+    """)
+    rt.start()
+    dev = _pattern_dev(rt)
+    assert dev.shards is None
+    assert "absent" in (dev.shard_reason or "")
+    m.shutdown()
+
+
+def test_sa080_diagnostic():
+    from siddhi_tpu.analysis import analyze
+    absent_app = """
+        define stream S (k string, v double);
+        partition with (k of S) begin
+        from e1=S[v > 1.0] -> not S[v > e1.v] for 1 sec
+        select e1.k as k insert into Out; end;
+    """
+    r = analyze(absent_app)
+    hits = [d for d in r.diagnostics if d.code == "SA080"]
+    assert hits and "absent" in hits[0].message
+    # an eligible keyed partition stays silent
+    assert not [d for d in analyze(PATTERN_APP).diagnostics
+                if d.code == "SA080"]

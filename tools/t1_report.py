@@ -137,6 +137,14 @@ def compare(old, new, ratio=2.0):
             regressed = True
         elif os_ > max(ns_ * ratio, _COMPARE_MIN_S):
             lines.append(f"faster   {path}  {os_:.2f}s -> {ns_:.2f}s")
+    osh, nsh = old.get("shards"), new.get("shards")
+    if nsh is not None and osh is not None:
+        od, nd = osh.get("routing_digest"), nsh.get("routing_digest")
+        if od != nd:
+            # the key->shard map is part of the checkpoint contract:
+            # a digest change silently orphans every saved shard state
+            lines.append(f"shards   routing_digest: {od} -> {nd}")
+            regressed = True
     oe, ne = old.get("engine_lint"), new.get("engine_lint")
     if ne is not None:
         od = oe.get("diagnostics", 0) if oe else 0
@@ -180,6 +188,22 @@ def _engine_lint_summary():
             "codes": sorted({d.code for d in rep.diagnostics})}
 
 
+def _shards_summary():
+    """Pin the key-routing contract into the round artifact: the FNV-1a
+    owner digest must never drift (it addresses per-shard checkpoint
+    state), so --compare treats any change as a regression.  Same
+    import/tolerance pattern as the engine lint."""
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        from siddhi_tpu.parallel.shards import routing_digest
+    except Exception as e:
+        sys.stderr.write(f"[t1_report] shards summary skipped: {e}\n")
+        return None
+    return {"routing_digest": routing_digest()}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("log", nargs="?",
@@ -212,6 +236,7 @@ def main(argv=None):
     print(render_table(report, top=args.top))
     if args.out:
         report["engine_lint"] = _engine_lint_summary()
+        report["shards"] = _shards_summary()
         with open(args.out, "w") as f:
             json.dump(report, f, indent=1, sort_keys=True)
             f.write("\n")
