@@ -30,6 +30,12 @@ ALLOWLIST: Dict[Tuple[str, str], str] = {
         "put is bounded by the worker-liveness wait loop directly "
         "below (b.done.wait(timeout=1.0) re-checks thread health), so "
         "a dead worker cannot park flush forever.",
+    ("CE003", "siddhi_tpu/plan/shapes.py::ShapeRegistry._prewarm_loop"):
+        "the prewarm grace sleep runs on the dedicated background "
+        "ladder thread, never on an ingest or dispatch path; it "
+        "deliberately yields the GIL so the foreground build finishes "
+        "its traces before AOT compiles start "
+        "(SIDDHI_TPU_PREWARM_GRACE_MS).",
 }
 
 

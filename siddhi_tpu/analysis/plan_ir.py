@@ -102,6 +102,8 @@ class AutomatonIR:
     shards: int = 0               # partition-axis shard-out fan (round 15;
     #                               0 = monolithic single-device engine)
     shard_partitions: Tuple[int, ...] = ()  # per-shard lane capacity
+    shape_class: str = ""         # canonical compile shape-class key of
+    #                               the step jit (plan/shapes.py registry)
 
     @property
     def accept(self) -> int:
@@ -127,6 +129,8 @@ class AutomatonIR:
         if self.shards:
             d["shards"] = self.shards
             d["shard_partitions"] = list(self.shard_partitions)
+        if self.shape_class:
+            d["shape_class"] = self.shape_class
         return d
 
 
@@ -140,6 +144,8 @@ class ProgramIR:
     dims: Dict[str, int] = field(default_factory=dict)
     state_bytes: int = 0      # persistent device state (0 for host)
     cond_ops: int = 0
+    shape_class: str = ""     # canonical compile shape-class key of the
+    #                           step jit (plan/shapes.py registry)
 
     def as_dict(self) -> Dict[str, Any]:
         d = {"query": self.query, "kind": self.kind,
@@ -148,6 +154,8 @@ class ProgramIR:
             d["reason"] = self.reason
         if self.dims:
             d["dims"] = dict(self.dims)
+        if self.shape_class:
+            d["shape_class"] = self.shape_class
         return d
 
 
@@ -186,7 +194,10 @@ class PlanIR:
                 + (f"packed={a.pack_bucket} " if a.packed else "")
                 # likewise only when the partition axis is sharded out
                 + (f"shards={a.shards} " if a.shards else "")
-                + f"flags=[{','.join(flags)}]")
+                + f"flags=[{','.join(flags)}]"
+                # the compile observatory's shape-class key (rendered
+                # only when the step jit went through the registry)
+                + (f" shape={a.shape_class}" if a.shape_class else ""))
             for s in a.states:
                 extra = ""
                 if s.kind == "count":
@@ -213,6 +224,8 @@ class PlanIR:
                 line += " " + dims
             if p.reason:
                 line += f" reason={p.reason!r}"
+            if p.shape_class:
+                line += f" shape={p.shape_class}"
             out.append(line)
         return "\n".join(out) + "\n"
 
@@ -320,7 +333,16 @@ def automaton_ir_from_nfa(nfa, query: str) -> AutomatonIR:
         telemetry=bool(getattr(spec, "telemetry", False)),
         packed=getattr(nfa, "_tenant_bucket", None) is not None,
         pack_bucket=getattr(getattr(nfa, "_tenant_bucket", None),
-                            "label", ""))
+                            "label", ""),
+        shape_class=_shape_class_of(getattr(nfa, "_step", None)))
+
+
+def _shape_class_of(step) -> str:
+    """Shape-class signature of a (possibly profiler-wrapped) registered
+    jit, or '' — attribute inspection only, tolerant of unrouted fns."""
+    rj = getattr(step, "fn", step)          # unwrap ProfiledKernel
+    entry = getattr(rj, "entry", None)
+    return getattr(entry, "signature", "") or ""
 
 
 def _array_bytes(obj) -> int:
@@ -357,7 +379,8 @@ def _program_ir(qr, qname: str) -> ProgramIR:
             dims={"n_outputs": len(getattr(dev, "outputs", ())),
                   "n_numeric": len(getattr(dev, "numeric", ())),
                   "n_str_lanes": n_str},
-            state_bytes=0)      # stateless program
+            state_bytes=0,      # stateless program
+            shape_class=_shape_class_of(getattr(dev, "_program", None)))
     if cls == "DeviceGroupedAggRuntime":
         cga = dev.cga
         shards = getattr(dev, "shards", None)
@@ -371,11 +394,14 @@ def _program_ir(qr, qname: str) -> ProgramIR:
                       "shards": len(shards)},
                 state_bytes=sum(_array_bytes(getattr(sh.engine, "carry",
                                                      None))
-                                for sh in shards))
+                                for sh in shards),
+                shape_class=_shape_class_of(
+                    getattr(shards[0].engine, "_step", None)))
         return ProgramIR(
             query=qname, kind="gagg", backend="device",
             dims={"n_lanes": int(getattr(cga, "n_lanes", 1))},
-            state_bytes=_array_bytes(getattr(cga, "carry", None)))
+            state_bytes=_array_bytes(getattr(cga, "carry", None)),
+            shape_class=_shape_class_of(getattr(cga, "_step", None)))
     if cls == "DeviceWindowedAggRuntime":
         cwa = dev.cwa
         shards = getattr(dev, "shards", None)
@@ -387,24 +413,32 @@ def _program_ir(qr, qname: str) -> ProgramIR:
                       "shards": len(shards)},
                 state_bytes=sum(_array_bytes(getattr(sh.engine, "carry",
                                                      None))
-                                for sh in shards))
+                                for sh in shards),
+                shape_class=_shape_class_of(
+                    getattr(shards[0].engine, "_step", None)))
         return ProgramIR(
             query=qname, kind="wagg", backend="device",
             dims={"n_partitions": int(getattr(cwa, "n_partitions", 1))},
-            state_bytes=_array_bytes(getattr(cwa, "carry", None)))
+            state_bytes=_array_bytes(getattr(cwa, "carry", None)),
+            shape_class=_shape_class_of(getattr(cwa, "_step", None)))
     if getattr(qr, "join_runtime", None) is not None and \
             getattr(qr.join_runtime, "device_probe", None) is not None:
         return ProgramIR(query=qname, kind="join", backend="device",
-                         dims={}, state_bytes=0)
+                         dims={}, state_bytes=0,
+                         shape_class=_shape_class_of(
+                             getattr(qr.join_runtime, "_probe_jit", None)))
     dwin = [w for w in getattr(qr, "windows", ())
             if type(w).__name__ == "DeviceWindowProcessor"]
     if dwin:
         w = dwin[0]
+        steps = getattr(w, "_steps", None) or {}
+        first = steps[min(steps)] if steps else None   # built lazily per T
         return ProgramIR(
             query=qname, kind="dwin", backend="hybrid",
             reason=getattr(qr, "backend_reason", None),
             dims={"window": int(getattr(w, "length", 0) or 0)},
-            state_bytes=_array_bytes(getattr(w, "carry", None)))
+            state_bytes=_array_bytes(getattr(w, "carry", None)),
+            shape_class=_shape_class_of(first))
     return ProgramIR(query=qname, kind="host", backend="host",
                      reason=getattr(qr, "backend_reason", None))
 

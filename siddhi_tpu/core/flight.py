@@ -182,6 +182,20 @@ class FlightRecorder:
                 rec["last_error"] = self._errors[-1]
             self._ring.append(rec)
 
+    def record_compile(self, kind: str, signature: str, trigger: str,
+                       blocked_s: float) -> None:
+        """One XLA compile on the ring — the same timeline as the ingest
+        blocks, so a bundle shows exactly which compile interleaved with
+        (or blocked) which block.  Called by plan/shapes.py."""
+        if not self.enabled:
+            return
+        rec = {"t": time.time(), "compile": signature, "kernel": kind,
+               "trigger": trigger, "blocked_s": round(blocked_s, 4)}
+        with self._lock:
+            self._seq += 1
+            rec["block"] = self._seq
+            self._ring.append(rec)
+
     def note_error(self, app: str, where: str, err: BaseException) -> None:
         """Track the most recent errors so block records and bundles can
         carry them (stream junction delivery failures, sink errors)."""

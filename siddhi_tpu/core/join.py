@@ -389,7 +389,11 @@ class JoinRuntime:
                 return idx.astype(jnp.int32), \
                     jnp.sum(flat.astype(jnp.int32))
 
-            self._probe_jit = jax.jit(probe, static_argnums=4)
+            from ..plan.shapes import shape_registry
+            self._probe_jit = shape_registry().jit(
+                "join.probe",
+                {"lcols": len(refs[0][1]), "rcols": len(refs[1][1])},
+                probe, static_argnums=4)
             self._probe_cap = 4096
             # warm trace at [1, 1] so untraceable conditions (functions,
             # scripts, table membership) reject at build time

@@ -664,6 +664,8 @@ class SiddhiAppRuntime:
         # asserts the parity)
         snap["rim"] = rim_stats().snapshot()
         snap["ledger"] = ledger().snapshot(app=self.name)
+        from ..plan.shapes import shape_registry
+        snap["shapes"] = shape_registry().snapshot()
         if self.device_telemetry is not None:
             snap["telemetry"] = self.device_telemetry.snapshot()
         # partition shard-out rows (round 15): per-shard key/capacity/
@@ -735,6 +737,11 @@ class SiddhiManager:
     """Top-level factory (reference SiddhiManager.java)."""
 
     def __init__(self):
+        # Persistent-compile-cache config must land before the first jax
+        # computation of the process — jax latches the cache decision at
+        # first compile and ignores later config updates.
+        from ..plan.shapes import configure_compile_cache
+        configure_compile_cache()
         self.siddhi_context = SiddhiContext()
         self.siddhi_context.extension_registry = ExtensionRegistry()
         self.runtimes: Dict[str, SiddhiAppRuntime] = {}

@@ -565,6 +565,59 @@ TELEMETRY_TYPES = [
 ]
 
 
+#: Always-on process-level series: resident set, uptime, and Python GC
+#: tallies.  The GC-amplification finding (egress allocation storms
+#: triggering gen-2 collections) previously had no resident gauge to
+#: correlate against — these render on every scrape, app stats or not.
+PROCESS_TYPES = [
+    ("siddhi_process_rss_bytes", "gauge",
+     "Resident set size of the engine process"),
+    ("siddhi_process_uptime_seconds", "gauge",
+     "Seconds since this process imported the engine"),
+    ("siddhi_gc_collections_total", "counter",
+     "Python GC collections per generation"),
+    ("siddhi_gc_collected_total", "counter",
+     "Objects collected by the Python GC per generation"),
+    ("siddhi_gc_uncollectable_total", "counter",
+     "Uncollectable objects found by the Python GC per generation"),
+]
+
+_PROCESS_START = time.time()
+
+
+def _rss_bytes() -> int:
+    """Resident set in bytes: /proc/self/status VmRSS (kB) where it
+    exists, else getrusage (Linux reports KiB there too)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:   # noqa: BLE001 — exotic platform: report zero
+        return 0
+
+
+def process_lines() -> List[str]:
+    import gc
+    lines = [f"siddhi_process_rss_bytes {_rss_bytes()}",
+             "siddhi_process_uptime_seconds "
+             f"{time.time() - _PROCESS_START:.3f}"]
+    for gen, st in enumerate(gc.get_stats()):
+        lb = f'{{generation="{gen}"}}'
+        lines.append(f"siddhi_gc_collections_total{lb} "
+                     f"{st.get('collections', 0)}")
+        lines.append(f"siddhi_gc_collected_total{lb} "
+                     f"{st.get('collected', 0)}")
+        lines.append(f"siddhi_gc_uncollectable_total{lb} "
+                     f"{st.get('uncollectable', 0)}")
+    return lines
+
+
 class DeviceTelemetry:
     """Host-side holder for the opt-in on-device telemetry blocks.
 
@@ -653,14 +706,18 @@ def prometheus_text(managers: List[StatisticsManager],
     from .profiling import rim_stats
     from .resilience import RESILIENCE_TYPES
     from ..plan.xtenant import XTENANT_TYPES
+    from ..plan.shapes import SHAPES_TYPES, shape_registry
     lines: List[str] = []
     for name, typ, help_ in (_TYPES + RIM_TYPES + LEDGER_TYPES +
                              TELEMETRY_TYPES + RESILIENCE_TYPES +
-                             INGEST_TYPES + TENANT_TYPES + XTENANT_TYPES):
+                             INGEST_TYPES + TENANT_TYPES + XTENANT_TYPES +
+                             SHAPES_TYPES + PROCESS_TYPES):
         lines.append(f"# HELP {name} {help_}")
         lines.append(f"# TYPE {name} {typ}")
     lines.extend(rim_stats().prometheus_lines())
     lines.extend(ledger().prometheus_lines())
+    lines.extend(shape_registry().prometheus_lines())
+    lines.extend(process_lines())
     for sm in managers:
         lines.extend(sm.prometheus_lines())
     if kernel_profiler is not None:

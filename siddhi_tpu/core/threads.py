@@ -33,6 +33,9 @@ ENGINE_THREAD_PREFIXES: Dict[str, str] = {
                           "shutdown() cancels",
     "siddhi-heartbeat": "core/timestamp.py playback idle-time Timer; "
                         "shutdown() cancels and disarms re-arming",
+    "siddhi-prewarm": "plan/shapes.py AOT shape-ladder worker; transient "
+                      "(exits when the ladder queue drains), "
+                      "prewarm_join() waits for idle + thread exit",
 }
 
 

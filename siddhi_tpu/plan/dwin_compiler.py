@@ -267,13 +267,21 @@ class DeviceWindowProcessor(WindowProcessor):
         fn = self._steps.get(key)
         if fn is None:
             from ..core.profiling import wrap_kernel
+            from .shapes import shape_registry
             # NO carry donation here: _step_work keeps a pre-carry
             # reference per work item and _read_work rewinds to it on
             # ring overflow (grow-and-replay), so the input buffers must
             # outlive the step.
             fn = wrap_kernel(
                 f"dwin.{self.kind}.step",
-                jax.jit(build_dwin_step(self._spec()), static_argnums=7))
+                shape_registry().jit(
+                    f"dwin.{self.kind}.step",
+                    {"cap": self.capacity, "T": T, "nf": self.n_f,
+                     "ni": self.n_i, "telem": self.telemetry},
+                    build_dwin_step(self._spec()), static_argnums=7,
+                    # a second (capacity, T) key on a live window is a
+                    # ring grow, not a first build
+                    trigger="build" if not self._steps else "grow"))
             self._steps[key] = fn
         return fn
 

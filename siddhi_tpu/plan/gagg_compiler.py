@@ -276,10 +276,17 @@ class CompiledGroupedAgg:
 
     def _build_step(self):
         from ..core.profiling import wrap_kernel
+        from .shapes import shape_registry
+        # shape-class dims exclude lanes/groups: those grow under the
+        # same jit (a plain retrace), only these facts change the program
         if self.window_kind == "time":
             # no donation: decode's GaggOverflow rewind replays from the
             # chunk's pre-carry, which must survive the step
-            self._step = wrap_kernel("gagg.time.step", jax.jit(
+            self._step = wrap_kernel("gagg.time.step", shape_registry().jit(
+                "gagg.time.step",
+                {"win_ms": self.window_ms, "win": self.window,
+                 "vf": self._n_float, "vi": self._n_int,
+                 "forever": self.want_forever},
                 build_grouped_time_step(
                     self.window_ms, self.window, self.want_forever)))
         else:
@@ -287,7 +294,12 @@ class CompiledGroupedAgg:
             # slabs in place) UNLESS exact int sums are wanted — their
             # bound trips in decode and rewinds to the pre-carry
             donate = () if self._int_sum_needed else (0,)
-            self._step = wrap_kernel("gagg.step", jax.jit(
+            self._step = wrap_kernel("gagg.step", shape_registry().jit(
+                "gagg.step",
+                {"kind": self.window_kind, "win": self.window,
+                 "vf": self._n_float, "vi": self._n_int,
+                 "minmax": self.want_minmax, "forever": self.want_forever,
+                 "donate": bool(donate)},
                 build_grouped_step(
                     self.window, self.want_minmax, self.want_forever),
                 donate_argnums=donate))

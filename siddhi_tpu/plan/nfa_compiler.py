@@ -1586,8 +1586,9 @@ class CompiledPatternNFA:
         Donating the carry (donate=True) forfeits replay symmetrically."""
         return not self._effective_donate()
 
-    def _jit_step(self):
+    def _jit_step(self, trigger: str = "build"):
         from ..core.profiling import wrap_kernel
+        from .shapes import nfa_shape_dims, shape_registry
         batch_of = (lambda carry, block:
                     int(block["__ts"].size) if "__ts" in block else 0)
         B = max(self.batch_b, 1)
@@ -1602,16 +1603,76 @@ class CompiledPatternNFA:
             # so the input carry must survive the step; donate=True
             # (standalone non-replaying drivers) aliases it in place
             donate = (0,) if self._effective_donate() else ()
-            return wrap_kernel("nfa.step",
-                               jax.jit(build_block_step(self.spec),
-                                       donate_argnums=donate),
+            rj = shape_registry().jit(
+                "nfa.step",
+                nfa_shape_dims(self.spec, self.n_partitions, self.batch_b,
+                               donate=bool(donate)),
+                build_block_step(self.spec), trigger=trigger,
+                first_call_hook=self._ladder_hook(donate),
+                prewarm_owner=id(self),
+                donate_argnums=donate)
+            return wrap_kernel("nfa.step", rj,
                                batch_of=batch_of, ticks_of=ticks_of)
         from ..parallel.mesh import jit_engine_step
-        return wrap_kernel(
+        rj = shape_registry().adopt(
             "nfa.mesh_step",
+            nfa_shape_dims(self.spec, self.n_partitions, self.batch_b,
+                           donate=self._effective_donate(),
+                           mesh=self.mesh.size),
             jit_engine_step(self.spec, self.mesh,
                             donate=self._effective_donate()),
-            batch_of=batch_of, ticks_of=ticks_of)
+            trigger=trigger)
+        return wrap_kernel("nfa.mesh_step", rj,
+                           batch_of=batch_of, ticks_of=ticks_of)
+
+    #: carry leaves whose axis 1 is the K (slot) axis — the ones a grow
+    #: widens, so the prewarm ladder widens the same set.
+    _K_AXIS_KEYS = frozenset({
+        "slot_state", "slot_start", "slot_enter", "slot_seq", "captures",
+        "cnt_cur", "cnt_prev", "lmask", "deadline"})
+
+    def _ladder_hook(self, donate):
+        """First-call hook for the engine-path step jit: once the real
+        carry/block shapes are known, enqueue the grow ladder (K*2, K*4)
+        on the prewarm worker so a later ``grow_slots`` re-jit lands on
+        the persistent cache instead of blocking ingest on a compile.
+        Re-armed by every re-jit, so after a grow the ladder extends
+        above the new K."""
+        from .shapes import (LADDER_RUNGS, nfa_shape_dims, prewarm_enabled,
+                             shape_registry)
+
+        def hook(call_args, call_kwargs):
+            if not prewarm_enabled() or self.mesh is not None:
+                return
+            carry, block = call_args[0], call_args[1]
+            # snapshot abstract shapes NOW — the build closures must not
+            # pin live device buffers while queued
+            carry_sds = {k: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+                         for k, v in carry.items()}
+            block_sds = {k: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+                         for k, v in block.items()}
+            K = self.spec.n_slots
+            for m in LADDER_RUNGS:
+                spec2 = self.spec._replace(n_slots=K * m)
+
+                def build(spec2=spec2, K=K, K2=K * m):
+                    c2 = {}
+                    for k, s in carry_sds.items():
+                        shape = tuple(s.shape)
+                        if k in self._K_AXIS_KEYS and len(shape) >= 2 \
+                                and shape[1] == K:
+                            shape = (shape[0], K2) + shape[2:]
+                        c2[k] = jax.ShapeDtypeStruct(shape, s.dtype)
+                    # donation must match the real build — it is part of
+                    # the executable (input aliasing), hence the cache key
+                    return (build_block_step(spec2), (c2, block_sds),
+                            {"donate_argnums": donate})
+                shape_registry().prewarm_submit(
+                    "nfa.step",
+                    nfa_shape_dims(spec2, self.n_partitions, self.batch_b,
+                                   donate=bool(donate)),
+                    build, owner=id(self))
+        return hook
 
     def grow(self, n_partitions: int) -> None:
         """Widen the partition axis (slab growth for keyed partitioning);
@@ -1659,7 +1720,7 @@ class CompiledPatternNFA:
             cat("deadline", 0, (P, pad), np.int32)
         self.carry = self._place_carry(c)
         self.spec = self.spec._replace(n_slots=n_slots)
-        self._step = self._jit_step()
+        self._step = self._jit_step(trigger="grow")
         self._xt_rebucket()
 
     def _xt_rebucket(self) -> None:
@@ -1727,7 +1788,7 @@ class CompiledPatternNFA:
         k = int(self.carry["slot_state"].shape[1])
         if k != self.spec.n_slots:    # snapshot taken after slot growth
             self.spec = self.spec._replace(n_slots=k)
-            self._step = self._jit_step()
+            self._step = self._jit_step(trigger="restart")
         self._xt_rebucket()
 
     def process_block(self, block: Dict[str, np.ndarray]):
@@ -1789,9 +1850,15 @@ class CompiledPatternNFA:
     def _ensure_egress_jit(self):
         if not hasattr(self, "_egress_jit"):
             from ..core.profiling import wrap_kernel
+            from .shapes import shape_registry
+            R = max(self.spec.n_rows, 1)
+            C = max(self.spec.n_caps, 1)
             self._egress_jit = wrap_kernel(
                 "nfa.egress_pack",
-                jax.jit(self._egress_pack_fn(), static_argnums=8))
+                shape_registry().jit(
+                    "nfa.egress_pack",
+                    {"R": R, "C": C, "absent": self.has_absent},
+                    self._egress_pack_fn(), static_argnums=8))
         return self._egress_jit
 
     def egress_dispatch(self, outs):
@@ -2346,19 +2413,25 @@ class CompiledPatternBank:
         profiler().set_live_bytes("nfa.bank_step", nbytes)
 
     def _build_step(self):
-        import jax
         from ..ops.nfa import build_bank_step, build_super_bank_step
         from ..core.profiling import wrap_kernel
+        from .shapes import nfa_shape_dims, shape_registry
         build = build_super_bank_step if self.stacked else build_bank_step
         # replayable banks rewind to the pre-block carry after a slot
         # overflow, so the input carry must survive the step; otherwise
         # donate — XLA aliases the carry slabs in place
         donate = () if self.replayable else (0,)
         B = max(self.nfa.batch_b, 1)
+        dims = nfa_shape_dims(
+            self.nfa.spec, self.nfa.n_partitions, self.nfa.batch_b,
+            donate=bool(donate), ring=self.ring,
+            chunks=self.n_chunks, stacked=self.stacked)
         self._step = wrap_kernel(
             "nfa.bank_step",
-            jax.jit(build(self.nfa.spec, ring=self.ring),
-                    donate_argnums=donate),
+            shape_registry().jit(
+                "nfa.bank_step", dims,
+                build(self.nfa.spec, ring=self.ring),
+                donate_argnums=donate),
             batch_of=lambda carry, block, params:
                 int(block["__ts"].size) if "__ts" in block else 0,
             ticks_of=lambda carry, block, params:

@@ -1575,8 +1575,14 @@ class DeviceFilterRuntime(PipelinedDeviceIngest):
             return ok, outs
 
         from ..core.profiling import wrap_kernel
+        from .shapes import shape_registry
         self._program = wrap_kernel(
-            "filter.program", jax.jit(program),
+            "filter.program",
+            shape_registry().jit(
+                "filter.program",
+                {"filters": len(filters), "outs": len(dev_exprs),
+                 "lanes": len(self.numeric)},
+                program),
             batch_of=lambda cols, ts, valid: int(ts.shape[0]))
 
         # trace now so incompatibilities reject at plan time

@@ -47,8 +47,6 @@ import os
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
-import jax
-
 from ..core.lockwitness import maybe_wrap
 
 XTENANT_ENV = "SIDDHI_TPU_XTENANT"
@@ -94,7 +92,7 @@ def _gang_sig(nfa) -> Tuple:
             int(getattr(nfa, "_egress_cap", 1024)))
 
 
-def _build_gang(nfas: List[Any]):
+def _build_gang(nfas: List[Any], trigger: str = "build"):
     """ONE jitted function stepping every tenant's block against its own
     carry and packing its egress — a single XLA executable, a single
     device launch per bucket flush.  Tenants' condition programs are
@@ -102,6 +100,7 @@ def _build_gang(nfas: List[Any]):
     not a vmap; the bucket cap bounds the unroll width."""
     from ..core.profiling import wrap_kernel
     from ..ops.nfa import build_block_step
+    from .shapes import shape_registry
     steps = [build_block_step(n.spec) for n in nfas]
     packs = [n._egress_pack_fn() for n in nfas]
     caps = [int(getattr(n, "_egress_cap", 1024)) for n in nfas]
@@ -129,7 +128,17 @@ def _build_gang(nfas: List[Any]):
                  if "__ts" in b), default=0)
         return (-(-t // B), B)
 
-    return wrap_kernel("nfa.xstep", jax.jit(gang),
+    # shape-class dims: the bucket's shared shape key (every co-ganged
+    # tenant matches it — see _shape_key) plus the gang's unroll width
+    # and per-tenant egress caps, which are baked into the executable
+    n0 = nfas[0]
+    dims = {"S": len(n0.spec.units), "K": n0.spec.n_slots,
+            "P": n0.n_partitions, "B": max(n0.batch_b, 1),
+            "R": max(n0.spec.n_rows, 1), "C": max(n0.spec.n_caps, 1),
+            "telem": bool(n0.spec.telemetry), "n": len(nfas),
+            "caps": tuple(caps)}
+    rj = shape_registry().jit("nfa.xstep", dims, gang, trigger=trigger)
+    return wrap_kernel("nfa.xstep", rj,
                        batch_of=batch_of, ticks_of=ticks_of), caps
 
 
@@ -204,7 +213,10 @@ class TenantBucket:
         sig = tuple(_gang_sig(n) for n in nfas)
         cached = self._gangs.get(sig)
         if cached is None:
-            cached = self._gangs[sig] = _build_gang(nfas)
+            # a second gang build on a live bucket means membership or a
+            # tenant's shape re-keyed — that is a rebucket, not a build
+            cached = self._gangs[sig] = _build_gang(
+                nfas, trigger="build" if not self._gangs else "rebucket")
         gang, caps = cached
         # per-tenant pre-gang snapshots: the gang never donates, so the
         # planner's grow-and-replay can rewind ONE tenant without

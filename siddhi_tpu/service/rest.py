@@ -309,8 +309,10 @@ class SiddhiService:
             apps[name] = doc
         # process-global surfaces, mirrored from rt.statistics so the
         # three snapshot surfaces (/metrics, rt.statistics, here) agree
+        from ..plan.shapes import shape_registry
         return {"apps": apps, "kernels": profiler().snapshot(),
-                "rim": rim_stats().snapshot()}
+                "rim": rim_stats().snapshot(),
+                "shapes": shape_registry().snapshot()}
 
     def _slo_json(self) -> dict:
         """Per-app SLO posture + stream lag watermarks (the SLO engine's

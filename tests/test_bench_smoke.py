@@ -109,6 +109,17 @@ def test_bench_smoke_runs_clean():
     assert lsm["slo_bundle_code"] == "SLO001"
     assert lsm["slo_waterfall_stages"] > 0
     assert 0.0 <= lsm["overhead_pct"] < 5.0
+    # compile observatory (round 16): a subprocess restart against the
+    # same persistent cache dir hits instead of recompiling, the shape-
+    # class signatures derived in both processes are identical, and the
+    # match payloads are bit-identical (parity asserted inside the smoke)
+    csm = out["coldstart_smoke"]
+    assert csm["cold_ttfm_s"] > csm["warm_ttfm_s"] > 0
+    assert csm["warm_cache_hits"] > 0
+    assert csm["cold_cache_misses"] > 0
+    assert csm["signatures"]
+    assert any(s.startswith("filter.program[") for s in csm["signatures"])
+    assert csm["parity_digest"]
 
 
 def test_fail_on_p99_gate():

@@ -56,7 +56,9 @@ def test_ring_records_ingest_blocks():
     rt.flush()
     ring = flight().ring()
     rt.shutdown()
-    recs = [r for r in ring if r["stream"] == "S"]
+    # compile rows (round 16) interleave with ingest rows on the
+    # same ring — filter to the ingest records for this stream
+    recs = [r for r in ring if r.get("stream") == "S"]
     assert len(recs) == 5
     r = recs[-1]
     assert r["app"] == rt.name and r["batch"] == 1
@@ -137,7 +139,7 @@ def test_watchdog_trip_emits_readable_bundle():
         bundle = flight().bundle(bid)
         assert bundle["detail"]["code"] == "WD001"
         assert bundle["app"] == rt.name
-        assert any(r["stream"] == "cse" for r in bundle["ring"])
+        assert any(r.get("stream") == "cse" for r in bundle["ring"])
         assert "env" in bundle and "config" in bundle
         json.dumps(bundle)         # fully JSON-serializable = readable
         d = os.environ["SIDDHI_TPU_FLIGHT_DIR"]
@@ -277,7 +279,10 @@ def test_rest_incident_surface():
 
         bundle = _req("GET", f"{base}/incidents/{out['id']}/bundle")
         assert bundle["detail"]["note"] == "operator snapshot"
-        assert len(bundle["ring"]) == 20
+        # 20 ingest rows; compile rows (round 16) ride the same ring
+        ingest = [r for r in bundle["ring"] if r.get("stream")]
+        assert len(ingest) == 20
+        assert any("compile" in r for r in bundle["ring"])
         assert any(ln.startswith("siddhi_kernel_")
                    for ln in bundle["metrics"])
         assert bundle["trace"]["traceEvents"]

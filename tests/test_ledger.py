@@ -38,33 +38,55 @@ def _clean(tmp_path, monkeypatch):
 
 # ------------------------------------------------------------------ spans
 
-def test_span_records_exclusive_time():
+class _VirtualClock:
+    """Deterministic stand-in for perf_counter_ns — spans read whatever
+    the test dialed in, so exclusive-time math asserts exact nanoseconds
+    instead of racing the scheduler."""
+
+    def __init__(self):
+        self.ns = 0
+
+    def __call__(self):
+        return self.ns
+
+    def tick(self, ms):
+        self.ns += int(ms * 1_000_000)
+
+
+def test_span_records_exclusive_time(monkeypatch):
+    import siddhi_tpu.core.ledger as ledger_mod
+    clock = _VirtualClock()
+    monkeypatch.setattr(ledger_mod, "_pcns", clock)
     led = LatencyLedger()
     with led.span("dispatch"):
-        time.sleep(0.002)
+        clock.tick(2)
         with led.span("device"):
-            time.sleep(0.005)
-        time.sleep(0.002)
+            clock.tick(5)
+        clock.tick(2)
     ns = led.stage_ns()
     # device gets its own elapsed; dispatch gets only the surrounding
     # host time — NOT dispatch+device double counted
-    assert ns["device"] >= 4_000_000
-    assert 2_000_000 <= ns["dispatch"] < ns["device"]
-    total = ns["dispatch"] + ns["device"]
-    assert total >= 8_000_000
+    assert ns["device"] == 5_000_000
+    assert ns["dispatch"] == 4_000_000
+    assert ns["dispatch"] + ns["device"] == 9_000_000
 
 
-def test_span_nesting_three_deep():
+def test_span_nesting_three_deep(monkeypatch):
+    import siddhi_tpu.core.ledger as ledger_mod
+    clock = _VirtualClock()
+    monkeypatch.setattr(ledger_mod, "_pcns", clock)
     led = LatencyLedger()
     with led.span("dispatch"):
+        clock.tick(1)
         with led.span("decode"):
             with led.span("publish"):
-                time.sleep(0.003)
+                clock.tick(3)
+            clock.tick(1)
     ns = led.stage_ns()
-    assert ns["publish"] >= 2_500_000
-    # outer spans only carry their own overhead, not the child's time
-    assert ns["decode"] < ns["publish"]
-    assert ns["dispatch"] < ns["publish"]
+    # outer spans only carry their own exclusive time, not the child's
+    assert ns["publish"] == 3_000_000
+    assert ns["decode"] == 1_000_000
+    assert ns["dispatch"] == 1_000_000
 
 
 def test_kill_switch_disables_spans_and_blocks(monkeypatch):
@@ -283,7 +305,7 @@ def test_engine_block_produces_full_waterfall():
     last = lg["apps"]["wfapp"]["last_block_ms"]
     assert last.get("device", 0) > 0
     # the flight ring rows carry the per-block waterfall
-    rows = [r for r in flight().ring() if r["app"] == "wfapp"
+    rows = [r for r in flight().ring() if r.get("app") == "wfapp"
             and "ledger" in r]
     assert rows and rows[-1]["ledger"].get("device", 0) > 0
     rt.shutdown()

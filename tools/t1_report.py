@@ -145,6 +145,14 @@ def compare(old, new, ratio=2.0):
             # a digest change silently orphans every saved shard state
             lines.append(f"shards   routing_digest: {od} -> {nd}")
             regressed = True
+    oc, nc = old.get("compile"), new.get("compile")
+    if nc is not None and oc is not None:
+        os_, ns_ = oc.get("seconds_total", 0.0), nc.get("seconds_total", 0.0)
+        if ns_ > max(os_ * ratio, _COMPARE_MIN_S):
+            lines.append(f"compile  probe seconds_total: {os_:.2f}s -> "
+                         f"{ns_:.2f}s "
+                         f"({ns_ / os_ if os_ else float('inf'):.1f}x)")
+            regressed = True
     oe, ne = old.get("engine_lint"), new.get("engine_lint")
     if ne is not None:
         od = oe.get("diagnostics", 0) if oe else 0
@@ -204,6 +212,32 @@ def _shards_summary():
     return {"routing_digest": routing_digest()}
 
 
+def _compile_summary():
+    """Pin the compile-observatory health into the round artifact: one
+    tiny registry-routed probe compile, reported as attributed seconds +
+    persistent-cache traffic.  --compare flags a > 2x compile-seconds
+    growth (above a 1 s floor) — the early-warning for 'every round got
+    slower because every test recompiles more'.  Same import/tolerance
+    pattern as the engine lint."""
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from siddhi_tpu.plan.shapes import shape_registry
+        import jax.numpy as jnp
+        reg = shape_registry()
+        rj = reg.jit("t1.probe", {"n": 32}, lambda x: (x * 2 + 1).sum())
+        rj(jnp.arange(32))
+        tot = reg.totals()
+    except Exception as e:
+        sys.stderr.write(f"[t1_report] compile summary skipped: {e}\n")
+        return None
+    return {"seconds_total": round(tot["compile_seconds"], 4),
+            "cache_hits": tot["cache_hits"],
+            "cache_misses": tot["cache_misses"]}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("log", nargs="?",
@@ -237,6 +271,7 @@ def main(argv=None):
     if args.out:
         report["engine_lint"] = _engine_lint_summary()
         report["shards"] = _shards_summary()
+        report["compile"] = _compile_summary()
         with open(args.out, "w") as f:
             json.dump(report, f, indent=1, sort_keys=True)
             f.write("\n")
