@@ -25,8 +25,21 @@ over siddhi_tpu's own source:
     report = analyze_engine()           # CE0xx/CE1xx, allowlist-aware
     report.raise_if(strict=True)        # the tests/test_engine_lint gate
 
+Persistent-state schema surface (PR 17) — the static checkpoint-
+compatibility layer (SC0xx):
+
+    from siddhi_tpu.analysis import extract_app_schema, audit_declarations
+
+    schema = extract_app_schema(app_text)   # element ids, declarations,
+    schema.dump(); schema.digest()          # routing, layout digests —
+                                            # derived without jax
+    rt.analysis.schema                      # StateSchemaReport on the
+                                            # live runtime (also /stats)
+
 CLI: ``python -m siddhi_tpu.analyze app.siddhi [--json] [--strict]
-[--plan]``; ``python -m siddhi_tpu.analyze --engine`` for the audit.  Everything importable here stays jax-free; only the jaxpr
+[--plan] [--schema]``; ``python -m siddhi_tpu.analyze --engine`` for
+the audit; bare ``--schema`` for the declaration registry + SC002
+audit.  Everything importable here stays jax-free; only the jaxpr
 sanitizer (plan_verify.sanitize_runtime) imports jax, lazily.
 Diagnostic catalog: docs/analysis.md (generated from
 diagnostics.catalog_markdown()).
@@ -39,6 +52,10 @@ from .engine import EngineReport, analyze_engine, static_lock_edges
 from .plan_ir import AutomatonIR, PlanIR, ProgramIR, extract_plan
 from .plan_verify import (PlanReport, attach_plan_analysis, sanitize_step,
                           verify_automaton, verify_plan)
+from .state_schema import (AppStateSchema, StateSchemaReport,
+                           attach_schema_analysis, audit_declarations,
+                           extract_app_schema, extract_runtime_schema,
+                           sample_schema_digests, static_declarations)
 
 __all__ = ["analyze", "AnalysisResult", "Diagnostic", "Severity",
            "CATALOG", "CatalogEntry", "catalog_markdown",
@@ -46,4 +63,8 @@ __all__ = ["analyze", "AnalysisResult", "Diagnostic", "Severity",
            "CostReport", "plan_cost",
            "PlanReport", "verify_plan", "verify_automaton",
            "sanitize_step", "attach_plan_analysis",
-           "EngineReport", "analyze_engine", "static_lock_edges"]
+           "EngineReport", "analyze_engine", "static_lock_edges",
+           "AppStateSchema", "StateSchemaReport",
+           "attach_schema_analysis", "audit_declarations",
+           "extract_app_schema", "extract_runtime_schema",
+           "sample_schema_digests", "static_declarations"]

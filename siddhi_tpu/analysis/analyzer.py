@@ -63,6 +63,10 @@ class AnalysisResult:
     #: attach_plan_analysis after the runtime is built; None when only
     #: source-level analysis ran (e.g. the default CLI path)
     plan: Optional[object] = None
+    #: StateSchemaReport from the persistent-state schema extractor
+    #: (state_schema.py) — set by attach_schema_analysis when the
+    #: runtime is built; None for source-only analysis
+    schema: Optional[object] = None
 
     @property
     def errors(self) -> List[Diagnostic]:

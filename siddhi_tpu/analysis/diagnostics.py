@@ -18,6 +18,9 @@ Families:
               kernel sanitation) — analysis/plan_verify.py
   ``PC0xx`` — static cost-model findings (HBM footprint, FLOP
               estimates, budget gates) — analysis/cost_model.py
+  ``SC0xx`` — persistent-state schema / checkpoint compatibility
+              (restore-time verification + the static registry audit)
+              — analysis/state_schema.py + core/stateschema.py
 
 The full catalog with meanings and fixes is rendered in
 ``docs/analysis.md``; :data:`CATALOG` is its single source of truth and
@@ -440,6 +443,60 @@ CATALOG: Dict[str, CatalogEntry] = {e.code: e for e in [
        "the full hold latency.",
        "Move slow work (I/O, device sync, callbacks) outside the lock; "
        "the bundle names the lock and the holding thread."),
+    _C("SC001", _E, "schema-mismatch-on-restore",
+       "A snapshot's embedded state schema does not match the live "
+       "runtime's: a field, dim, element or declared version differs.  "
+       "The restore was refused BEFORE any carry was touched — the "
+       "message carries the field-level diff that a raw restore would "
+       "have turned into a jax shape error (or silent misread) deep "
+       "inside the step.",
+       "Restore into a runtime built from the same app and config, or "
+       "migrate the snapshot; the diff names every offending slot."),
+    _C("SC002", _W, "unregistered-persistent-state",
+       "A current_state() implementer carries no @persistent_schema "
+       "declaration (or its payload holds keys the declaration does "
+       "not describe) — that state is invisible to the checkpoint "
+       "compatibility verifier and restores unchecked.",
+       "Declare the schema with @persistent_schema on the class that "
+       "defines current_state; update the declaration when the payload "
+       "gains keys."),
+    _C("SC003", _W, "non-portable-payload",
+       "A snapshot payload raw-pickles a class instance outside the "
+       "portable allowlist (plain data + ndarrays).  Such a snapshot "
+       "only restores under the exact same engine build — a refactor "
+       "that renames the class orphans every saved revision.",
+       "Persist plain dicts/lists/ndarrays; encode objects explicitly "
+       "in current_state and rebuild them in restore_state."),
+    _C("SC004", _E, "elastic-dim-off-ladder",
+       "An elastic (grow-ladder) dim in the snapshot — e.g. the NFA "
+       "key-lane capacity K — is not a power-of-two factor away from "
+       "the live value.  Capacities only ever grow by doubling, so an "
+       "off-ladder value means a tampered or foreign snapshot.",
+       "Restore a snapshot taken by the same app (ladder values align "
+       "by construction), or fix the corrupted header."),
+    _C("SC005", _E, "shard-routing-drift",
+       "The snapshot's per-shard sections do not match the runtime: "
+       "different shard count, or the pinned FNV-1a routing digest "
+       "changed.  Key→shard assignment is modular in the shard count, "
+       "so restored keys would land on the wrong shard.",
+       "Restore with the same SIDDHI_TPU_SHARDS the snapshot was taken "
+       "with; never change the routing hash (it is checkpoint ABI)."),
+    _C("SC006", _E, "incremental-chain-gap",
+       "An incremental revision chain is broken at restore: an "
+       "increment's recorded base revision is missing from the store "
+       "or is not the previously applied link.  Replaying over the gap "
+       "would silently restore stale state.",
+       "Restore from the latest intact full revision, or re-persist; "
+       "never delete intermediate _inc revisions without their "
+       "successors."),
+    _C("SC010", _E, "schema-evolution-without-version-bump",
+       "Two snapshots declare the same schema name and version but "
+       "different layout digests — the persisted layout changed "
+       "without bumping the declaration's version, so old revisions "
+       "would be misread as the new layout.",
+       "Bump version= in the @persistent_schema declaration whenever "
+       "the layout changes (and write a migration if old snapshots "
+       "must stay restorable)."),
 ]}
 
 
@@ -503,6 +560,7 @@ _FAMILIES = (
     ("CE0", "Engine concurrency audit"),
     ("CE1", "Engine hot-path lint"),
     ("LW0", "Runtime lock-witness"),
+    ("SC0", "Persistent-state schema"),
 )
 
 

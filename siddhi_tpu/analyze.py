@@ -12,6 +12,11 @@ Usage:
     python -m siddhi_tpu.analyze --engine              # engine
                                                        # self-analysis
                                                        # (CE/LW audit)
+    python -m siddhi_tpu.analyze app.siddhi --schema   # static persistent-
+                                                       # state schema dump
+    python -m siddhi_tpu.analyze --schema              # declaration
+                                                       # registry + SC002
+                                                       # audit
 
 Exit codes: 0 clean (infos allowed), 1 errors (or warnings under
 --strict), 2 usage error.
@@ -85,6 +90,13 @@ def main(argv=None) -> int:
     ap.add_argument("--hbm-budget", type=float, metavar="MB",
                     help="with --plan: emit PC002 when the predicted "
                          "persistent HBM footprint exceeds this budget")
+    ap.add_argument("--schema", action="store_true",
+                    help="with an app: dump its static persistent-state "
+                         "schema (element ids, governing declarations, "
+                         "engine routing, layout digests) — no jax "
+                         "import.  Without an app: print every "
+                         "@persistent_schema declaration in the engine "
+                         "source and run the SC002 audit")
     ap.add_argument("--catalog", action="store_true",
                     help="print the diagnostic catalog and exit")
     ap.add_argument("--catalog-md", action="store_true",
@@ -112,6 +124,29 @@ def main(argv=None) -> int:
                 or (args.strict and report.warnings):
             return 1
         return 0
+    if args.schema and not args.app:
+        # declaration registry + SC002 audit over the engine source —
+        # static, jax-free, no app needed
+        from .analysis.state_schema import (audit_declarations,
+                                            static_declarations)
+        decls = static_declarations()
+        findings = audit_declarations()
+        if args.json:
+            print(json.dumps(
+                {"ok": not findings,
+                 "declarations": {k: d.as_dict()
+                                  for k, d in sorted(decls.items())},
+                 "findings": [{"code": c, "message": m}
+                              for c, m in findings]}, indent=1))
+        else:
+            for k in sorted(decls):
+                d = decls[k]
+                print(f"{d.name:<22} v{d.version}  {d.digest()}  {k}")
+            for c, m in findings:
+                print(f"{c}: {m}")
+            print(f"{len(decls)} declaration(s), "
+                  f"{len(findings)} audit finding(s)")
+        return 1 if findings else 0
     if not args.app:
         ap.print_usage(sys.stderr)
         return 2
@@ -126,6 +161,21 @@ def main(argv=None) -> int:
             print(f"error: {e}", file=sys.stderr)
             return 2
         name = args.app
+
+    if args.schema:
+        from .analysis.state_schema import extract_app_schema
+        try:
+            schema = extract_app_schema(
+                text, engine=None if args.engine in (None, "self")
+                else args.engine)
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(schema.as_dict(), indent=1))
+        else:
+            print(schema.dump(), end="")
+        return 1 if schema.findings else 0
 
     if args.plan:
         try:

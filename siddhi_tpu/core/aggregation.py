@@ -30,6 +30,7 @@ from ..query_api.definition import (DURATION_MS, AggregationDefinition,
 from ..query_api.expression import AttributeFunction, Constant, TimeConstant
 from ..utils.errors import SiddhiAppCreationError, StoreQueryCreationError
 from .event import CURRENT, EventChunk
+from .stateschema import MapOf, Struct, persistent_schema
 
 AGG_TS = "AGG_TIMESTAMP"
 
@@ -62,6 +63,8 @@ class _OutputSpec:
         self.group_idx = group_idx  # index into group key tuple ('group')
 
 
+@persistent_schema("aggregation",
+                   schema=Struct(buckets=MapOf("bucket-store")))
 class AggregationRuntime:
     def __init__(self, ad: AggregationDefinition, app_runtime):
         self.ad = ad

@@ -12,6 +12,7 @@ import threading
 from ..query_api.definition import WindowDefinition
 from .event import CURRENT, EXPIRED, EventChunk
 from .processor import Processor
+from .stateschema import Sub, persistent_schema
 from .window import create_window_processor
 
 
@@ -24,6 +25,8 @@ class _Publisher(Processor):
         self.named_window._publish(chunk)
 
 
+@persistent_schema("named-window", schema=Sub("processor"),
+                   doc="persists exactly its wrapped window processor's state")
 class NamedWindow:
     def __init__(self, definition: WindowDefinition, app_ctx, compile_expr,
                  extension_registry=None):

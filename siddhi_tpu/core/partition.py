@@ -22,6 +22,7 @@ from ..query_api.definition import StreamDefinition
 from ..utils.errors import DefinitionNotExistError, SiddhiAppCreationError
 from .event import EventChunk
 from .query_runtime import QueryRuntime
+from .stateschema import PartitionState, persistent_schema
 from .stream import StreamJunction
 
 
@@ -220,6 +221,7 @@ class _CallbackProxy:
                 qr.add_callback(cb)
 
 
+@persistent_schema("partition", schema=PartitionState())
 class PartitionRuntime:
     def __init__(self, partition: Partition, app_runtime, name: str):
         self.partition = partition

@@ -34,6 +34,7 @@ from ..query_api import (AbsentStreamStateElement, CountStateElement,
 from ..query_api.definition import Attribute, StreamDefinition
 from ..utils.errors import SiddhiAppCreationError
 from .event import CURRENT, EventChunk
+from .stateschema import ListOf, Struct, persistent_schema
 
 Row = Tuple[int, Dict[str, Any]]  # (timestamp, {attr: python value})
 
@@ -575,6 +576,9 @@ class PatternReceiver:
                 self.engine.process_event(self, (ts, data))
 
 
+@persistent_schema("host-pattern",
+                   schema=Struct(store=ListOf("state-event"),
+                                 units=ListOf("unit-state")))
 class StateStreamRuntime:
     """Compiled pattern/sequence input runtime for one query.
 

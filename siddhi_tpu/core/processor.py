@@ -16,8 +16,12 @@ import numpy as np
 
 from ..plan.expr_compiler import CompiledExpr, EvalCtx
 from .event import RESET, TIMER, EventChunk
+from .stateschema import persistent_schema
 
 
+@persistent_schema("processor-base", schema=None,
+                   doc="abstract chain link: the default current_state "
+                       "is the stateless None")
 class Processor:
     def __init__(self):
         self.next: Optional[Processor] = None

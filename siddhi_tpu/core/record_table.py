@@ -28,6 +28,7 @@ from ..query_api.expression import (And, AttributeFunction, Compare, Constant,
                                     Variable, variables_of)
 from ..utils.errors import SiddhiAppCreationError
 from .event import EventChunk, dtype_for
+from .stateschema import persistent_schema
 from .table import STREAM_QUAL, _item, _scalar
 
 
@@ -320,6 +321,8 @@ class _Translator:
 
 # ---------------------------------------------------------------- SPI base
 
+@persistent_schema("record-table", schema=None,
+                   doc="the external store owns its own durability")
 class AbstractRecordTable:
     """Base class for external stores (≙ AbstractRecordTable.java).
 

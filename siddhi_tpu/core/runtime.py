@@ -83,6 +83,10 @@ class SiddhiAppRuntime:
     #: SiddhiManager.create_siddhi_app_runtime; None for runtimes built
     #: directly).  Surfaced by GET /stats on the REST service.
     analysis = None
+    #: StateSchemaReport over the registered snapshot elements (set by
+    #: attach_schema_analysis at creation; None for runtimes built
+    #: directly).  Also rides rt.analysis.schema and GET /stats.
+    state_schema = None
 
     def __init__(self, app: SiddhiApp, siddhi_context: SiddhiContext,
                  app_string: Optional[str] = None):
@@ -790,6 +794,19 @@ class SiddhiManager:
             from ..analysis.plan_verify import attach_plan_analysis
             with trace_span("plan.verify", cat="compile"):
                 attach_plan_analysis(rt)
+        except Exception:   # noqa: BLE001 — advisory pass must never
+            # take down app creation (strict mode excepted below)
+            if strict:
+                rt.shutdown()
+                raise
+        # persistent-state schema report (analysis/state_schema.py):
+        # cheap static description of every registered snapshot element —
+        # rides rt.state_schema / rt.analysis.schema (and GET /stats),
+        # and is the artifact t1_report digests for drift tracking
+        try:
+            from ..analysis.state_schema import attach_schema_analysis
+            with trace_span("schema", cat="compile"):
+                attach_schema_analysis(rt, strict=strict)
         except Exception:   # noqa: BLE001 — advisory pass must never
             # take down app creation (strict mode excepted below)
             if strict:

@@ -25,6 +25,7 @@ from ..query_api.query import Selector
 from .aggregator import AGGREGATORS, is_aggregator
 from .event import CURRENT, EXPIRED, RESET, TIMER, EventChunk
 from .processor import Processor
+from .stateschema import MapOf, Struct, persistent_schema
 
 
 class _AggSpec:
@@ -48,6 +49,8 @@ class _AggSpec:
         return self.cls(self.arg_type)
 
 
+@persistent_schema("selector",
+                   schema=Struct(aggs=MapOf("agg-slots")))
 class QuerySelector(Processor):
     def __init__(self, selector: Selector, input_scope: Scope,
                  input_definition: Optional[AbstractDefinition],

@@ -146,6 +146,10 @@ class SnapshotService:
         # periodic base state, SnapshotService.java:159-205; a content
         # digest over the columnar state plays the role of the op-log)
         self._last_digest: Dict[str, bytes] = {}
+        # last revision saved per app: each incremental envelope records
+        # the revision it was built on top of, so restore can detect a
+        # chain gap (SC006) instead of replaying over it
+        self._last_saved: Dict[str, str] = {}
 
     def register(self, element_id: str, element):
         self._elements[element_id] = element
@@ -155,28 +159,77 @@ class SnapshotService:
 
     # ------------------------------------------------------------ snapshot
 
+    def _routing(self):
+        """The pinned FNV-1a routing digest carried in every envelope —
+        per-shard sections only restore under the same key→shard map."""
+        try:
+            from ..parallel.shards import routing_digest
+            return routing_digest()
+        except Exception:    # noqa: BLE001 — envelope metadata only
+            return None
+
+    def _describe(self, eid: str, state):
+        from .stateschema import describe_element
+        el = self._elements.get(eid)
+        return None if el is None else describe_element(el, state)
+
+    def _verify(self, snap_descs, snap_routing, incremental: bool):
+        """Diff the snapshot's embedded schema against the live runtime
+        and raise a typed SC0xx error BEFORE any restore_state runs.
+        Caller holds the thread barrier."""
+        from ..utils.errors import CannotRestoreStateError
+        from .stateschema import describe_element, verify_compat
+        live = {}
+        for eid, el in self._elements.items():
+            if incremental and eid not in snap_descs:
+                continue       # increments only carry changed elements
+            s = el.current_state()
+            if s is None:
+                continue
+            d = describe_element(el, s)
+            if d is not None:
+                live[eid] = d
+        findings = verify_compat(
+            snap_descs, live, incremental=incremental,
+            snap_routing=snap_routing,
+            live_routing=self._routing() if snap_routing else None)
+        if findings:
+            raise CannotRestoreStateError.from_findings(findings)
+
     def full_snapshot(self, flush: bool = True) -> bytes:
         """ThreadBarrier-locked capture of every element's state
-        (reference SnapshotService.fullSnapshot:97-158)."""
+        (reference SnapshotService.fullSnapshot:97-158), wrapped in the
+        v2 envelope: per-element schema descriptions + routing digest
+        ride next to the state so restore can verify compatibility
+        before touching any carry."""
+        from .stateschema import build_envelope
         if flush and self.pre_snapshot is not None:
             self.pre_snapshot()
         barrier = self.app_ctx.thread_barrier
         barrier.lock()
         try:
-            state = {}
+            state, descs = {}, {}
             for eid, el in self._elements.items():
                 s = el.current_state()
                 if s is not None:
                     state[eid] = s
-            return pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+                    d = self._describe(eid, s)
+                    if d is not None:
+                        descs[eid] = d
+            env = build_envelope(state, descs, self._routing())
+            return pickle.dumps(env, protocol=pickle.HIGHEST_PROTOCOL)
         finally:
             barrier.unlock()
 
     def restore(self, snapshot: bytes):
-        state = _loads(snapshot)
+        from .stateschema import parse_envelope
+        state, descs, routing, incremental, _prev = parse_envelope(
+            _loads(snapshot))
         barrier = self.app_ctx.thread_barrier
         barrier.lock()
         try:
+            if descs is not None:       # legacy pre-schema snapshots skip
+                self._verify(descs, routing, incremental)
             for eid, s in state.items():
                 el = self._elements.get(eid)
                 if el is not None:
@@ -184,16 +237,21 @@ class SnapshotService:
         finally:
             barrier.unlock()
 
-    def incremental_snapshot(self, flush: bool = True) -> bytes:
+    def incremental_snapshot(self, flush: bool = True,
+                             prev: Optional[str] = None) -> bytes:
         """Only elements whose state changed since the last persisted
-        snapshot (full or incremental)."""
+        snapshot (full or incremental).  ``prev`` records the revision
+        this delta was built on top of — the restore chain walker
+        verifies the links and fails typed (SC006) on a gap."""
         import hashlib
+
+        from .stateschema import build_envelope
         if flush and self.pre_snapshot is not None:
             self.pre_snapshot()
         barrier = self.app_ctx.thread_barrier
         barrier.lock()
         try:
-            changed = {}
+            changed, descs = {}, {}
             for eid, el in self._elements.items():
                 s = el.current_state()
                 if s is None:
@@ -203,14 +261,21 @@ class SnapshotService:
                 if self._last_digest.get(eid) != digest:
                     changed[eid] = s
                     self._last_digest[eid] = digest
-            return pickle.dumps({"__incremental__": True, "state": changed},
-                                protocol=pickle.HIGHEST_PROTOCOL)
+                    d = self._describe(eid, s)
+                    if d is not None:
+                        descs[eid] = d
+            env = build_envelope(changed, descs, self._routing(),
+                                 incremental=True, prev=prev)
+            return pickle.dumps(env, protocol=pickle.HIGHEST_PROTOCOL)
         finally:
             barrier.unlock()
 
     def _mark_digests(self, snapshot: bytes):
         import hashlib
-        state = pickle.loads(snapshot)
+
+        from .stateschema import parse_envelope
+        state, _descs, _routing, _inc, _prev = parse_envelope(
+            pickle.loads(snapshot))
         for eid, s in state.items():
             blob = pickle.dumps(s, protocol=pickle.HIGHEST_PROTOCOL)
             self._last_digest[eid] = hashlib.sha256(blob).digest()
@@ -247,14 +312,15 @@ class SnapshotService:
                 if incremental and self._last_digest:
                     revision = f"{now}_{app_name}_inc"
                     self._active_revision = revision
-                    store.save(app_name, revision,
-                               self.incremental_snapshot(flush=False))
+                    store.save(app_name, revision, self.incremental_snapshot(
+                        flush=False, prev=self._last_saved.get(app_name)))
                 else:
                     revision = f"{now}_{app_name}_full"
                     self._active_revision = revision
                     snap = self.full_snapshot(flush=False)
                     self._mark_digests(snap)
                     store.save(app_name, revision, snap)
+                self._last_saved[app_name] = revision
                 return revision
             finally:
                 self._persist_owner = None
@@ -262,41 +328,67 @@ class SnapshotService:
     def restore_revision(self, app_name: str, store: PersistenceStore,
                          revision: str):
         from ..utils.errors import CannotRestoreStateError
+        from .stateschema import parse_envelope
         snap = store.load(app_name, revision)
         if snap is None:
             raise CannotRestoreStateError(f"No revision {revision}")
-        state = _loads(snap)
-        if isinstance(state, dict) and state.get("__incremental__"):
-            # replay: latest full base before this revision, then every
-            # increment up to and including it (numeric-aware ordering)
-            rk = _rev_key(revision)
-            revisions = sorted((r for r in store.revisions(app_name)
-                                if _rev_key(r) <= rk), key=_rev_key)
-            base = None
-            for r in revisions:
-                if r.endswith("_full"):
-                    base = r
-            bk = _rev_key(base) if base is not None else None
-            chain = [r for r in revisions
-                     if bk is None or _rev_key(r) >= bk]
-            barrier = self.app_ctx.thread_barrier
-            barrier.lock()
-            try:
-                for r in chain:
-                    blob = store.load(app_name, r)
-                    if blob is None:
-                        continue
-                    st = _loads(blob)
-                    if isinstance(st, dict) and st.get("__incremental__"):
-                        st = st["state"]
-                    for eid, s in st.items():
-                        el = self._elements.get(eid)
-                        if el is not None:
-                            el.restore_state(s)
-            finally:
-                barrier.unlock()
-        else:
+        _state, _descs, _routing, incremental, _prev = parse_envelope(
+            _loads(snap))
+        if not incremental:
             self.restore(snap)
+            return
+        # replay: latest full base before this revision, then every
+        # increment up to and including it (numeric-aware ordering)
+        rk = _rev_key(revision)
+        revisions = sorted((r for r in store.revisions(app_name)
+                            if _rev_key(r) <= rk), key=_rev_key)
+        base = None
+        for r in revisions:
+            if r.endswith("_full"):
+                base = r
+        bk = _rev_key(base) if base is not None else None
+        chain = [r for r in revisions
+                 if bk is None or _rev_key(r) >= bk]
+        # Load and link-check the WHOLE chain before applying anything:
+        # each increment records the revision it was built on top of, so
+        # a deleted intermediate (which simply vanishes from the
+        # revisions() listing) is a typed SC006 gap instead of a silent
+        # replay of stale state.
+        links, prev_link = [], None
+        for r in chain:
+            blob = store.load(app_name, r)
+            if blob is None:
+                raise CannotRestoreStateError(
+                    f"incremental restore chain for {revision} is "
+                    f"broken: revision {r} vanished from the store "
+                    f"between listing and load", code="SC006")
+            st, descs_r, routing_r, inc_r, prev_r = parse_envelope(
+                _loads(blob))
+            if inc_r and prev_r is not None and prev_r != prev_link:
+                raise CannotRestoreStateError(
+                    f"incremental restore chain for {revision} is "
+                    f"broken: {r} was built on top of revision {prev_r} "
+                    f"but the previous intact link is "
+                    f"{prev_link or '<no full base>'} — an intermediate "
+                    f"revision is missing, and replaying over the gap "
+                    f"would restore stale state", code="SC006")
+            links.append((st, descs_r, routing_r, inc_r))
+            prev_link = r
+        barrier = self.app_ctx.thread_barrier
+        barrier.lock()
+        try:
+            # every link's schema header verifies against the live
+            # runtime before ANY link's state is applied
+            for _st, descs_r, routing_r, inc_r in links:
+                if descs_r is not None:
+                    self._verify(descs_r, routing_r, inc_r)
+            for st, _descs_r, _routing_r, _inc_r in links:
+                for eid, s in st.items():
+                    el = self._elements.get(eid)
+                    if el is not None:
+                        el.restore_state(s)
+        finally:
+            barrier.unlock()
 
     def restore_last_revision(self, app_name: str,
                               store: PersistenceStore) -> Optional[str]:

@@ -23,6 +23,7 @@ from ..query_api.definition import TableDefinition
 from ..query_api.expression import (And, Compare, CompareOp, Expression,
                                     Variable)
 from .event import EventChunk
+from .stateschema import ListOf, MapOf, Struct, persistent_schema
 
 STREAM_QUAL = "__stream__"
 
@@ -48,6 +49,9 @@ class CompiledSetUpdate:
         self.assignments = assignments
 
 
+@persistent_schema("table",
+                   schema=Struct(columns=MapOf("column"),
+                                 timestamps=ListOf("int")))
 class InMemoryTable:
     def __init__(self, definition: TableDefinition):
         self.definition = definition

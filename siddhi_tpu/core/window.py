@@ -25,8 +25,12 @@ from ..plan.expr_compiler import CompiledExpr, EvalCtx
 from ..utils.errors import SiddhiAppCreationError
 from .event import (CURRENT, EXPIRED, RESET, TIMER, EventChunk)
 from .processor import Processor
+from .stateschema import (Chunk, ListOf, MapOf, Opt, Scalar, Struct,
+                          persistent_schema)
 
 
+@persistent_schema("window-buffer",
+                   schema=Struct(buffer=Opt(Chunk())))
 class WindowProcessor(Processor):
     """Base: keeps a columnar buffer; subclasses implement `on_data`."""
 
@@ -95,6 +99,9 @@ class WindowProcessor(Processor):
         self.buffer = _chunk_restore(state["buffer"], self.names)
 
 
+@persistent_schema("window-grouped",
+                   schema=Struct(keys=ListOf("key"),
+                                 inners=ListOf("window-state")))
 class GroupingWindowProcessor(WindowProcessor):
     """Extension base: window state partitioned per group key (reference
     query/processor/stream/window/GroupingWindowProcessor.java — the
@@ -242,6 +249,9 @@ class LengthWindowProcessor(WindowProcessor):
         self.send_next(out)
 
 
+@persistent_schema("window-length-batch",
+                   schema=Struct(buffer=Opt(Chunk()),
+                                 expired_batch=Opt(Chunk())))
 class LengthBatchWindowProcessor(WindowProcessor):
     """Tumbling lengthBatch(n): emits [prev batch EXPIRED, RESET, new batch
     CURRENT] when n events collect (reference LengthBatchWindowProcessor)."""
@@ -441,6 +451,10 @@ class TimeBatchWindowProcessor(WindowProcessor):
         self._emit_due(ts)
 
 
+@persistent_schema("window-hopping",
+                   schema=Struct(buffer=Opt(Chunk()),
+                                 next_emit=Scalar("opt_int"),
+                                 last_emitted=Opt(Chunk())))
 class HopingWindowProcessor(WindowProcessor):
     """Hopping time window: every hop(t2) emit the events of the last
     window(t1) as CURRENT and those that slid out as EXPIRED (reference
@@ -650,6 +664,8 @@ class BatchWindowProcessor(WindowProcessor):
 
 # ===================================================================== session
 
+@persistent_schema("window-session",
+                   schema=Struct(sessions=MapOf("session")))
 class SessionWindowProcessor(WindowProcessor):
     """session(gap [, key_attr [, allowedLatency]]): per-key session batches
     emitted as EXPIRED on gap timeout (reference SessionWindowProcessor)."""
@@ -762,6 +778,9 @@ class SortWindowProcessor(WindowProcessor):
 
 # ===================================================================== frequent
 
+@persistent_schema("window-frequent",
+                   schema=Struct(counts=MapOf("int"),
+                                 latest=MapOf("chunk")))
 class FrequentWindowProcessor(WindowProcessor):
     """frequent(n [, attrs...]): Misra-Gries heavy hitters; evicted events
     emitted EXPIRED (reference FrequentWindowProcessor.java)."""

@@ -29,6 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.event import CURRENT, EXPIRED, RESET, EventChunk, dtype_for
+from ..core.stateschema import (Carry, ListOf, MapOf, Scalar, Struct,
+                                persistent_schema)
 from ..core.window import WindowProcessor, _interleave, _reset_row
 from ..ops.dwin import (C_BATCH, C_EXPBATCH, C_TIME, TS_NONE, DwinSpec,
                         build_dwin_step, make_dwin_carry)
@@ -70,6 +72,18 @@ def _const_ms(p) -> int:
     _reject("window parameters must be constants")
 
 
+@persistent_schema(
+    "device-window", version=1,
+    schema=Struct(dwin=Carry(), base=Scalar("opt_int"),
+                  capacity=Scalar("int"), fill=Scalar("int"),
+                  exp_fill=Scalar("int"), next_emit=Scalar("opt_int"),
+                  window_end=Scalar("opt_int"), hop_ts=ListOf("int"),
+                  hop_prev=ListOf("int"), strs=MapOf("str-dict"),
+                  skey=Scalar("opt_list")),
+    dims={"cap": "free", "wkind": "exact"},
+    doc="ring capacity is adopted by restore (it grows by doubling but "
+        "the snapshot carries the ring itself); the window kind decides "
+        "the carry planes and is plan-fixed")
 class DeviceWindowProcessor(WindowProcessor):
     """One window's state on device (see module docstring)."""
 
@@ -1071,6 +1085,9 @@ class DeviceWindowProcessor(WindowProcessor):
         ts = np.asarray(self.carry["ring_ts"])[0, :fill].astype(np.int64) \
             + (self._base or 0)
         return self._rows_to_chunk(rf, ri, ts, CURRENT)
+
+    def schema_dims(self):
+        return {"cap": int(self.capacity), "wkind": self.kind}
 
     def current_state(self):
         self.flush()

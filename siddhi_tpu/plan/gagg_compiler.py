@@ -35,6 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..query_api import Filter, Query, SingleInputStream, WindowHandler
+from ..core.stateschema import (CarryTuple, MapOf, Scalar, Struct,
+                                persistent_schema)
 from ..query_api.definition import AttrType
 from ..query_api.expression import AttributeFunction, Constant, Variable
 from ..utils.errors import (SiddhiAppCreationError,
@@ -103,6 +105,15 @@ class _SplitSquare:
         return hi if self._part == "hi" else sq - hi
 
 
+@persistent_schema(
+    "gagg-engine", version=1,
+    schema=Struct(carry=CarryTuple(), n_lanes=Scalar("int"),
+                  n_groups=Scalar("int"), window=Scalar("opt_num"),
+                  ts_base=Scalar("opt_int"), gid_map=MapOf("int"),
+                  lane_gids=MapOf("int")),
+    dims={"L": "free", "G": "free", "wkind": "exact"},
+    doc="lane/group capacities are adopted wholesale by restore; the "
+        "window kind (length vs time carry layout) is plan-fixed")
 class CompiledGroupedAgg:
     """One aggregation query over [lane, group, value] device state."""
 
@@ -672,6 +683,10 @@ class CompiledGroupedAgg:
         return ref.type
 
     # ------------------------------------------------------------ snapshot
+
+    def schema_dims(self) -> dict:
+        return {"L": int(self.n_lanes), "G": int(self.n_groups),
+                "wkind": self.window_kind}
 
     def current_state(self) -> dict:
         return {"carry": [np.asarray(a) for a in self.carry],

@@ -24,6 +24,7 @@ import numpy as np
 
 from ..core.aggregation import AggregationRuntime
 from ..core.event import EventChunk
+from ..core.stateschema import MapOf, Struct, persistent_schema
 from ..query_api.definition import DURATION_MS
 
 
@@ -54,6 +55,13 @@ class _Slab:
         self.cap *= 2
 
 
+@persistent_schema(
+    "aggregation", version=1,
+    schema=Struct(buckets=MapOf("bucket-store")),
+    doc="same name/version/schema as the host AggregationRuntime ON "
+        "PURPOSE: _sync() makes the device slab persist the host-format "
+        "bucket payload, so host and device snapshots are mutually "
+        "restorable")
 class DeviceAggregationRuntime(AggregationRuntime):
     """AggregationRuntime with slab-tensor ingest (SURVEY §7.10 /
     core/aggregation.py:17-18's promised ops/ path)."""

@@ -43,6 +43,8 @@ from ..query_api.definition import AttrType
 from ..query_api.expression import (And, Compare, CompareOp, Constant, IsNull,
                                     Not, Or, TimeConstant, Variable,
                                     variables_of)
+from ..core.stateschema import (Carry, ListOf, Scalar, Struct,
+                                persistent_schema)
 from ..utils.errors import SiddhiAppCreationError, SiddhiAppRuntimeException
 from .expr_compiler import EvalCtx, ExprCompiler, Scope
 
@@ -572,6 +574,15 @@ def _prune_chain(low: _Lowering, query) -> Dict[str, Any]:
 PRUNE_ENV = "SIDDHI_TPU_NFA_PRUNE"
 
 
+@persistent_schema(
+    "nfa-engine", version=1,
+    schema=Struct(carry=Carry(), base_ts=Scalar("opt_int"),
+                  n_partitions=Scalar("int"), str_decoder=ListOf("str")),
+    dims={"S": "exact", "K": "ladder", "P": "free",
+          "R": "exact", "C": "exact"},
+    doc="S automaton units and R/C capture geometry are plan-fixed; "
+        "slot capacity K grows by doubling; lane count P is mesh-padded "
+        "and adopted wholesale by restore")
 class CompiledPatternNFA:
     """One pattern query compiled for batched multi-partition execution."""
 
@@ -1750,6 +1761,11 @@ class CompiledPatternNFA:
             return None
         dl = jnp.where(waiting, self.carry["deadline"], np.int32(2 ** 31 - 1))
         return int(jnp.min(dl)) + (self.base_ts or 0)
+
+    def schema_dims(self) -> Dict[str, Any]:
+        return {"S": len(self.spec.units), "K": int(self.spec.n_slots),
+                "P": int(self.n_partitions),
+                "R": int(self.spec.n_rows), "C": int(self.spec.n_caps)}
 
     def current_state(self) -> Dict[str, Any]:
         bucket = getattr(self, "_tenant_bucket", None)

@@ -29,6 +29,7 @@ from ..query_api.query import OutputEventsFor
 from ..utils.errors import (SiddhiAppCreationError,
                             SiddhiAppRuntimeException)
 from ..core.ledger import ledger as _ledger
+from ..core.stateschema import Keyed, persistent_schema
 from ..parallel.shards import build_shards, resolve_shards, split_rows
 from .nfa_compiler import CompiledPatternNFA
 from .pipeline import PipelinedDeviceIngest
@@ -204,14 +205,18 @@ def map_keys_to_lanes(key_lanes: Dict[Any, int], keys: List[Any],
 def _check_shard_count(shards, snap_shards) -> None:
     """Shard-count mismatch on restore is a routing change: key→shard
     assignment is modular in the shard count, so a snapshot taken at S
-    shards only restores into S shards."""
+    shards only restores into S shards.  Raises the typed SC005 error
+    naming expected-vs-found counts and the pinned routing digest (the
+    same diagnostic the envelope verifier emits before restore_state is
+    ever reached — this guard is the defense in depth for snapshots
+    restored through code paths that skip the envelope)."""
     have = len(shards) if shards else 0
     want = len(snap_shards) if snap_shards else 0
     if have != want:
-        raise SiddhiAppRuntimeException(
-            f"sharded snapshot carries {want} shard slab(s) but the "
-            f"runtime has {have} — restore requires the same "
-            f"SIDDHI_TPU_SHARDS the snapshot was taken with")
+        from ..core.stateschema import shard_mismatch_message
+        from ..utils.errors import CannotRestoreStateError
+        raise CannotRestoreStateError(
+            "SC005: " + shard_mismatch_message(have, want), code="SC005")
 
 
 def _scan_fns(e, pred) -> bool:
@@ -271,6 +276,10 @@ class _DeviceIngress:
             f()
 
 
+@persistent_schema(
+    "keyed-pattern", version=1, schema=Keyed("nfa"),
+    doc="per-key NFA lanes: one flat slab or per-shard sections keyed "
+        "by the pinned FNV-1a routing")
 class DevicePatternRuntime:
     """Pattern query running on the batched NFA kernel.
 
@@ -784,6 +793,8 @@ class DevicePatternRuntime:
             self._schedule_absent()
 
 
+@persistent_schema(
+    "keyed-window-agg", version=1, schema=Keyed("cwa"))
 class DeviceWindowedAggRuntime(PipelinedDeviceIngest):
     """Partitioned length-window aggregation on the sliding-window kernel
     (ops/windowed_agg.py): partition keys become group lanes of one ring
@@ -1130,6 +1141,8 @@ class DeviceWindowedAggRuntime(PipelinedDeviceIngest):
             self.key_lanes = KeyLanes(state["key_lanes"])
 
 
+@persistent_schema(
+    "keyed-grouped-agg", version=1, schema=Keyed("cga"))
 class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
     """Aggregation query on the grouped/running device kernel
     (plan/gagg_compiler.CompiledGroupedAgg → ops/grouped_agg): group-by
@@ -1418,6 +1431,9 @@ class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
             self.key_lanes = KeyLanes(state["key_lanes"])
 
 
+@persistent_schema("device-filter", schema=None,
+                   doc="stateless: the deferred mask read needs no "
+                       "replay machinery at all")
 class DeviceFilterRuntime(PipelinedDeviceIngest):
     """Stateless filter/project query as one jitted column program — the
     device replacement for the reference's per-event expression-tree DFS

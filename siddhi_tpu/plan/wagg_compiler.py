@@ -18,6 +18,8 @@ import numpy as np
 
 from ..compiler import SiddhiCompiler
 from ..query_api import Filter, Query, SingleInputStream
+from ..core.stateschema import (CarryTuple, Scalar, Struct,
+                                persistent_schema)
 from ..query_api.definition import AttrType
 from ..query_api.expression import AttributeFunction, Constant, Variable
 from ..utils.errors import SiddhiAppCreationError
@@ -33,6 +35,14 @@ TIME_CAPACITY_START = 64      # initial time-window ring capacity (doubles
                               # on overflow; the caller replays the block)
 
 
+@persistent_schema(
+    "wagg-engine", version=1,
+    schema=Struct(carry=CarryTuple(), n_partitions=Scalar("int"),
+                  window_kind=Scalar("str"), window=Scalar("num"),
+                  ts_base=Scalar("opt_int")),
+    dims={"P": "free", "wkind": "exact"},
+    doc="partition-lane count is adopted by restore; the window kind "
+        "decides the carry tuple class and is plan-fixed")
 class CompiledWindowedAgg:
     """One length-window aggregation query over P group/partition lanes."""
 
@@ -279,6 +289,9 @@ class CompiledWindowedAgg:
             last_ts=old.last_ts,
             overflow=jnp.zeros((P,), bool))
         self._build_step()
+
+    def schema_dims(self) -> dict:
+        return {"P": int(self.n_partitions), "wkind": self.window_kind}
 
     def current_state(self) -> dict:
         return {"carry": [np.asarray(a) for a in self.carry],

@@ -305,6 +305,12 @@ class SiddhiService:
                 plan = getattr(rt.analysis, "plan", None)
                 if plan is not None:
                     doc["plan"] = plan.as_dict()
+            # persistent-state schema report: which declarations govern
+            # each snapshot element, and the app-level layout digest an
+            # operator can diff across deploys (analysis/state_schema)
+            schema = getattr(rt, "state_schema", None)
+            if schema is not None:
+                doc["state_schema"] = schema.as_dict()
             doc["ledger"] = ledger().snapshot(app=name)
             apps[name] = doc
         # process-global surfaces, mirrored from rt.statistics so the

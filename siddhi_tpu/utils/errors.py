@@ -70,8 +70,25 @@ class StoreQueryCreationError(SiddhiAppCreationError):
     pass
 
 
-class CannotRestoreStateError(Exception):
-    pass
+class CannotRestoreStateError(SiddhiAppRuntimeException):
+    """A snapshot could not be restored.  When the restore was refused
+    by the schema verifier (core/stateschema.py), ``code`` names the
+    first SC0xx diagnostic and ``findings`` carries the full
+    (code, message) diff list."""
+
+    def __init__(self, message: str = "", *, code=None, findings=None):
+        self.findings = list(findings or [])
+        self.code = code or (self.findings[0][0] if self.findings else None)
+        if not message and self.findings:
+            message = "; ".join(f"{c}: {m}" for c, m in self.findings)
+        super().__init__(message)
+
+    @classmethod
+    def from_findings(cls, findings, context: str = ""):
+        head = (f"{context}: " if context else "") + \
+            "snapshot is incompatible with this runtime — "
+        body = "; ".join(f"{c}: {m}" for c, m in findings)
+        return cls(head + body, findings=findings)
 
 
 class NoPersistenceStoreError(Exception):

@@ -1,0 +1,67 @@
+"""Golden persistent-state schema dumps for every shipped sample.
+
+The static extractor (analysis/state_schema.py) derives each sample
+app's complete persistent-state layout — element ids, governing
+@persistent_schema declarations, engine routing, layout digests —
+WITHOUT executing any jax.  The stable textual dump is pinned under
+tests/golden/; a refactor that silently moves state (a query dropping
+off the device path, a window changing its carry layout, a schema
+evolving without a version bump) shows up as a reviewable golden diff
+instead of a checkpoint-restore incident.
+
+Regenerate after an INTENTIONAL schema/routing change with:
+
+    REGEN_SCHEMA_GOLDEN=1 python -m pytest tests/test_schema_golden.py
+
+This file deliberately never imports jax: the whole extraction runs on
+the parsed query API + AST-scanned declarations (asserted by the
+jax-free subprocess test in test_state_schema.py).
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from siddhi_tpu.analysis.state_schema import (apps_in_source,  # noqa: E402
+                                              schema_of_variants)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SAMPLES_DIR = os.path.join(ROOT, "samples")
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "golden")
+REGEN = os.environ.get("REGEN_SCHEMA_GOLDEN") == "1"
+
+
+def _sample_files():
+    return sorted(f for f in os.listdir(SAMPLES_DIR) if f.endswith(".py"))
+
+
+@pytest.mark.parametrize("fname", _sample_files())
+def test_sample_schema_matches_golden(fname):
+    apps = apps_in_source(os.path.join(SAMPLES_DIR, fname))
+    assert apps, f"{fname}: no SiddhiQL app string found"
+    for i, variants in enumerate(apps):
+        schema = schema_of_variants(variants)
+        assert not schema.findings, (
+            f"{fname} app #{i} has schema audit findings:\n" +
+            "\n".join(m for _c, m in schema.findings))
+        dump = schema.dump()
+        assert dump.rstrip().endswith(schema.digest())
+        golden = os.path.join(
+            GOLDEN_DIR, f"{fname[:-3]}__app{i}.schema.txt")
+        if REGEN:
+            os.makedirs(GOLDEN_DIR, exist_ok=True)
+            with open(golden, "w") as f:
+                f.write(dump)
+            continue
+        assert os.path.exists(golden), (
+            f"missing golden {os.path.relpath(golden, ROOT)} — run "
+            f"REGEN_SCHEMA_GOLDEN=1 pytest tests/test_schema_golden.py")
+        want = open(golden).read()
+        assert dump == want, (
+            f"{fname} app #{i}: state-schema dump changed.  If the "
+            f"layout/routing change is intentional, bump the affected "
+            f"@persistent_schema version(s) and regenerate with "
+            f"REGEN_SCHEMA_GOLDEN=1.\n--- golden\n{want}\n--- now\n{dump}")

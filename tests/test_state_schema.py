@@ -1,0 +1,531 @@
+"""Persistent-state schema registry + SC0xx checkpoint verifier.
+
+Covers the restore-compatibility contract end to end:
+
+  * the SC002 lint gate — every ``current_state`` definer in the engine
+    source carries its own ``@persistent_schema`` (empty allowlist);
+  * the static AST declaration scan recovers declarations bit-identical
+    (same digests) to the import-time registry;
+  * the v2 snapshot envelope embeds per-element descriptions + the
+    routing digest, and ``restore`` verifies them BEFORE touching any
+    carry — ≥5 distinct mutation classes each raise a typed
+    CannotRestoreStateError with an SC0xx code and a field-level diff,
+    never a raw jax/pickle error;
+  * randomized config round trips: compatible pairs (NFA batch B=4↔B=1,
+    ladder-grown K) restore bit-identically, incompatible pairs (shard
+    count changes) fail typed;
+  * ``analyze --schema`` stays jax-free (subprocess-asserted) and the
+    schema report rides rt.state_schema / rt.analysis.schema / /stats.
+"""
+import os
+import pickle
+import random
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from siddhi_tpu import SiddhiManager, StreamCallback  # noqa: E402
+from siddhi_tpu.core import stateschema as ss  # noqa: E402
+from siddhi_tpu.core.snapshot import (FileSystemPersistenceStore,  # noqa: E402
+                                      InMemoryPersistenceStore)
+from siddhi_tpu.utils.errors import (CannotRestoreStateError,  # noqa: E402
+                                     SiddhiAppRuntimeException)
+
+PATTERN_APP = """
+@app:name('schemaPat')
+define stream S (k string, p double);
+from every e1=S[p > 1.0] -> e2=S[p > e1.p] within 3600 sec
+select e1.k as k, e1.p as p1, e2.p as p2 insert into Out;
+"""
+
+AGG_APP = """
+@app:name('schemaAgg')
+define stream S (k string, p double);
+from S select k, sum(p) as total group by k insert into Out;
+"""
+
+PARTITION_APP = """
+@app:name('schemaPart')
+define stream S (k string, p double);
+partition with (k of S) begin
+  from every e1=S[p > 1.0] -> e2=S[p > e1.p] within 3600 sec
+  select e1.k as k, e2.p as p insert into Out;
+end;
+"""
+
+
+def _rt(app, store=None):
+    m = SiddhiManager()
+    if store is not None:
+        m.set_persistence_store(store)
+    rt = m.create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback("Out", StreamCallback(
+        lambda evs: got.extend(tuple(e.data) for e in evs)))
+    rt.start()
+    return m, rt, got
+
+
+def _envelope(rt):
+    return pickle.loads(rt.snapshot_service.full_snapshot())
+
+
+def _restore(rt, env):
+    rt.snapshot_service.restore(
+        pickle.dumps(env, protocol=pickle.HIGHEST_PROTOCOL))
+
+
+def _pattern_eid(env):
+    eids = [e for e in env["schema"] if e.endswith(":state")]
+    assert eids, sorted(env["schema"])
+    return eids[0]
+
+
+# ================================================================ lint gate
+
+def test_sc002_audit_gate_empty_allowlist():
+    """Tier-1 gate: no current_state definer may ship undeclared.  The
+    allowlist is deliberately empty — a new stateful processor must
+    declare its layout before it can merge."""
+    from siddhi_tpu.analysis.state_schema import audit_declarations
+    findings = audit_declarations(allow=())
+    assert findings == [], "\n".join(m for _c, m in findings)
+
+
+def test_static_scan_matches_runtime_registry():
+    """The AST scan recovers every declaration bit-identically (same
+    name/version/digest) to what the decorators register at import."""
+    from siddhi_tpu.analysis.state_schema import static_declarations
+    import siddhi_tpu.core.aggregation  # noqa: F401
+    import siddhi_tpu.core.named_window  # noqa: F401
+    import siddhi_tpu.core.partition  # noqa: F401
+    import siddhi_tpu.core.pattern  # noqa: F401
+    import siddhi_tpu.core.record_table  # noqa: F401
+    import siddhi_tpu.core.selector  # noqa: F401
+    import siddhi_tpu.core.table  # noqa: F401
+    import siddhi_tpu.core.window  # noqa: F401
+    import siddhi_tpu.plan.dwin_compiler  # noqa: F401
+    import siddhi_tpu.plan.gagg_compiler  # noqa: F401
+    import siddhi_tpu.plan.iagg_compiler  # noqa: F401
+    import siddhi_tpu.plan.nfa_compiler  # noqa: F401
+    import siddhi_tpu.plan.planner  # noqa: F401
+    import siddhi_tpu.plan.wagg_compiler  # noqa: F401
+    static = static_declarations()
+    runtime = ss.registry()
+    assert set(static) == set(runtime)
+    for dotted, decl in static.items():
+        live = runtime[dotted]
+        assert decl.name == live.name, dotted
+        assert decl.version == live.version, dotted
+        assert decl.digest() == live.digest(), dotted
+
+
+# ============================================================= envelope v2
+
+def test_full_snapshot_is_v2_envelope():
+    m, rt, _ = _rt(AGG_APP)
+    try:
+        rt.get_input_handler("S").send(["a", 2.0])
+        env = _envelope(rt)
+        assert env["v"] == ss.SCHEMA_ENVELOPE_VERSION
+        assert set(env) >= {"v", "schema", "routing", "state"}
+        assert set(env["schema"]) == set(env["state"])
+        for eid, d in env["schema"].items():
+            assert d["name"] and d["digest"], eid
+    finally:
+        m.shutdown()
+
+
+def test_legacy_pre_schema_pickle_still_restores():
+    """A bare {eid: state} pickle (pre-envelope format) restores
+    unverified — old checkpoints are not orphaned by the upgrade."""
+    m, rt, got = _rt(AGG_APP)
+    try:
+        rt.get_input_handler("S").send(["a", 2.0])
+        env = _envelope(rt)
+        legacy = pickle.dumps(env["state"],
+                              protocol=pickle.HIGHEST_PROTOCOL)
+        m2, rt2, got2 = _rt(AGG_APP)
+        try:
+            rt2.snapshot_service.restore(legacy)
+            rt2.get_input_handler("S").send(["a", 3.0])
+            assert got2[-1][1] == pytest.approx(5.0)
+        finally:
+            m2.shutdown()
+    finally:
+        m.shutdown()
+
+
+# ====================================================== mutation classes
+# ≥5 distinct incompatibility classes, each a typed SC0xx with a
+# field-level diff — never a raw jax or pickle error.
+
+def test_mutation_version_tamper_is_sc001():
+    m, rt, _ = _rt(PATTERN_APP)
+    try:
+        rt.get_input_handler("S").send(["a", 2.0])
+        env = _envelope(rt)
+        eid = _pattern_eid(env)
+        env["schema"][eid]["version"] = 99
+        with pytest.raises(CannotRestoreStateError) as ei:
+            _restore(rt, env)
+        assert ei.value.code == "SC001"
+        assert "version" in str(ei.value) and eid in str(ei.value)
+    finally:
+        m.shutdown()
+
+
+def test_mutation_digest_tamper_same_version_is_sc010():
+    m, rt, _ = _rt(PATTERN_APP)
+    try:
+        rt.get_input_handler("S").send(["a", 2.0])
+        env = _envelope(rt)
+        eid = _pattern_eid(env)
+        env["schema"][eid]["digest"] = "feedc0ffee00"
+        with pytest.raises(CannotRestoreStateError) as ei:
+            _restore(rt, env)
+        assert ei.value.code == "SC010"
+        assert "version bump" in str(ei.value)
+    finally:
+        m.shutdown()
+
+
+def test_mutation_elastic_dim_off_ladder_is_sc004():
+    m, rt, _ = _rt(PATTERN_APP)
+    try:
+        rt.get_input_handler("S").send(["a", 2.0])
+        env = _envelope(rt)
+        eid = _pattern_eid(env)
+        sub = env["schema"][eid]["sub"]
+        assert sub is not None and "K" in sub["dims"]
+        sub["dims"]["K"] = int(sub["dims"]["K"]) * 3   # 3x is off-ladder
+        with pytest.raises(CannotRestoreStateError) as ei:
+            _restore(rt, env)
+        assert ei.value.code == "SC004"
+        assert "grow ladder" in str(ei.value)
+    finally:
+        m.shutdown()
+
+
+def test_mutation_exact_dim_mismatch_is_sc001():
+    """A snapshot of a structurally different pattern (3 units vs 2)
+    refuses with the dim-level diff."""
+    m, rt, _ = _rt(PATTERN_APP)
+    try:
+        rt.get_input_handler("S").send(["a", 2.0])
+        env = _envelope(rt)
+        eid = _pattern_eid(env)
+        sub = env["schema"][eid]["sub"]
+        sub["dims"]["S"] = int(sub["dims"]["S"]) + 1
+        with pytest.raises(CannotRestoreStateError) as ei:
+            _restore(rt, env)
+        assert ei.value.code == "SC001"
+        assert "fixed by the plan" in str(ei.value)
+    finally:
+        m.shutdown()
+
+
+def test_mutation_missing_and_foreign_elements_are_sc001():
+    m, rt, _ = _rt(PATTERN_APP)
+    try:
+        rt.get_input_handler("S").send(["a", 2.0])
+        env = _envelope(rt)
+        eid = _pattern_eid(env)
+        # snapshot lacks a section the live runtime persists
+        dropped = dict(env, schema=dict(env["schema"]),
+                       state=dict(env["state"]))
+        del dropped["schema"][eid]
+        del dropped["state"][eid]
+        with pytest.raises(CannotRestoreStateError) as ei:
+            _restore(rt, dropped)
+        assert ei.value.code == "SC001"
+        assert "no section" in str(ei.value)
+        # snapshot carries a section for an element this runtime lacks
+        foreign = dict(env, schema=dict(env["schema"]))
+        foreign["schema"]["ghost:state"] = dict(env["schema"][eid])
+        with pytest.raises(CannotRestoreStateError) as ei:
+            _restore(rt, foreign)
+        assert ei.value.code == "SC001"
+        assert "does not exist" in str(ei.value)
+    finally:
+        m.shutdown()
+
+
+def test_mutation_routing_drift_is_sc005():
+    m, rt, _ = _rt(PATTERN_APP)
+    try:
+        rt.get_input_handler("S").send(["a", 2.0])
+        env = _envelope(rt)
+        assert env["routing"]
+        env["routing"] = "0000deadbeef"
+        with pytest.raises(CannotRestoreStateError) as ei:
+            _restore(rt, env)
+        assert ei.value.code == "SC005"
+        assert "routing" in str(ei.value)
+    finally:
+        m.shutdown()
+
+
+def test_sc005_shard_mismatch_message_has_counts_and_digest():
+    from siddhi_tpu.parallel.shards import routing_digest
+    msg = ss.shard_mismatch_message(4, 2)
+    assert "2 shard slab(s)" in msg and "has 4" in msg
+    assert routing_digest() in msg
+
+
+def test_portable_scan_flags_raw_instance_sc003():
+    class Opaque:
+        pass
+    findings = ss.portable_scan({"ok": np.arange(3), "bad": Opaque()})
+    assert [c for c, _m in findings] == ["SC003"]
+    assert "bad" in findings[0][1]
+    assert ss.portable_scan({"xs": [1, 2.5, "s", None, b"b"]}) == []
+
+
+def test_mutation_incremental_chain_gap_is_sc006(tmp_path):
+    store = FileSystemPersistenceStore(str(tmp_path))
+    m, rt, _ = _rt(AGG_APP, store)
+    try:
+        h = rt.get_input_handler("S")
+        h.send(["a", 1.5])
+        rt.persist()                                 # full base
+        h.send(["a", 2.5])
+        inc1 = rt.persist(incremental=True)
+        h.send(["b", 3.5])
+        inc2 = rt.persist(incremental=True)
+        assert inc1.endswith("_inc") and inc2.endswith("_inc")
+        os.remove(os.path.join(str(tmp_path), rt.name, inc1))
+        m2, rt2, _g = _rt(AGG_APP, store)
+        try:
+            with pytest.raises(CannotRestoreStateError) as ei:
+                rt2.restore_revision(inc2)
+            assert ei.value.code == "SC006"
+            assert inc1 in str(ei.value)
+        finally:
+            m2.shutdown()
+    finally:
+        m.shutdown()
+
+
+# ================================================= randomized round trips
+
+def _feed(rt, events):
+    h = rt.get_input_handler("S")
+    for k, p in events:
+        h.send([k, p])
+
+
+def _events(seed, n, keys):
+    rng = random.Random(seed)
+    return [(rng.choice(keys), round(rng.uniform(0.5, 9.5), 3))
+            for _ in range(n)]
+
+
+def _run_config(env_overrides, app, events, snap=None, cont=None):
+    """Build a runtime under ``env_overrides``; either persist after
+    ``events`` (returns snapshot bytes) or restore ``snap`` first and
+    return the outputs produced by ``cont``."""
+    saved = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+    try:
+        m, rt, got = _rt(app)
+        try:
+            if snap is None:
+                _feed(rt, events)
+                return rt.snapshot_service.full_snapshot()
+            rt.snapshot_service.restore(snap)
+            del got[:]
+            _feed(rt, cont)
+            return list(got)
+        finally:
+            m.shutdown()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.mark.parametrize("seed", [7, 23])
+def test_nfa_batch_b4_vs_b1_snapshots_interchange(seed):
+    """B is a consumption width, not a state dim: snapshots taken under
+    SIDDHI_TPU_NFA_BATCH=4 restore into B=1 runtimes (and vice versa)
+    with bit-identical continuation output."""
+    events = _events(seed, 24, ["a", "b"])
+    cont = _events(seed + 1, 12, ["a", "b"])
+    b1, b4 = {"SIDDHI_TPU_NFA_BATCH": "1"}, {"SIDDHI_TPU_NFA_BATCH": "4"}
+    for src, dst in [(b4, b1), (b1, b4)]:
+        snap = _run_config(src, PATTERN_APP, events)
+        base = _run_config(src, PATTERN_APP, [], snap=snap, cont=cont)
+        cross = _run_config(dst, PATTERN_APP, [], snap=snap, cont=cont)
+        assert cross == base, (src, dst)
+
+
+def test_grown_k_snapshot_restores_into_fresh_runtime():
+    """The key-lane capacity K doubles as keys arrive; a snapshot taken
+    after growth restores into a fresh (minimum-K) runtime because the
+    values sit on the same power-of-two ladder."""
+    keys = [f"k{i}" for i in range(40)]       # forces K growth
+    events = [(k, 2.0) for k in keys]
+    cont = [(k, 5.0) for k in keys[:6]]
+    snap = _run_config({}, PARTITION_APP, events)
+    base = _run_config({}, PARTITION_APP, [], snap=snap, cont=cont)
+    cross = _run_config({}, PARTITION_APP, [], snap=snap, cont=cont)
+    assert cross == base
+    assert base, "grown-K restore lost the open pattern instances"
+
+
+@pytest.mark.parametrize("src,dst", [
+    ({"SIDDHI_TPU_SHARDS": "2"}, {}),
+    ({}, {"SIDDHI_TPU_SHARDS": "2"}),
+    ({"SIDDHI_TPU_SHARDS": "2"}, {"SIDDHI_TPU_SHARDS": "3"}),
+])
+def test_incompatible_configs_fail_typed_never_raw(src, dst):
+    """Every incompatible config pair yields a typed SC0xx — a raw jax
+    shape error or pickle error out of restore() is itself a bug."""
+    events = _events(11, 24, [f"k{i}" for i in range(8)])
+    snap = _run_config(src, PARTITION_APP, events)
+    try:
+        _run_config(dst, PARTITION_APP, [], snap=snap, cont=[])
+    except CannotRestoreStateError as e:
+        assert e.code is not None and e.code.startswith("SC0"), e
+        assert "shard" in str(e) or "routing" in str(e) or \
+            "section" in str(e), e
+    else:
+        pytest.fail("restore across shard configs must refuse typed")
+
+
+@pytest.mark.parametrize("seed", [3])
+def test_randomized_tampers_always_fail_typed(seed):
+    """Property sweep: random single-field tampers of the embedded
+    schema header either still verify (no-op tamper) or raise a typed
+    CannotRestoreStateError — never any other exception type."""
+    m, rt, _ = _rt(PATTERN_APP)
+    try:
+        _feed(rt, _events(seed, 16, ["a", "b"]))
+        env = _envelope(rt)
+        eid = _pattern_eid(env)
+        rng = random.Random(seed)
+        for _ in range(12):
+            tam = pickle.loads(pickle.dumps(env))
+            d = tam["schema"][eid]
+            target = rng.choice(["version", "digest", "name",
+                                 "K", "S", "routing"])
+            if target == "version":
+                d["version"] = rng.randint(2, 50)
+            elif target == "digest":
+                d["digest"] = f"{rng.getrandbits(48):012x}"
+            elif target == "name":
+                d["name"] = "some-other-schema"
+            elif target == "routing":
+                tam["routing"] = f"{rng.getrandbits(48):012x}"
+            elif d["sub"] is not None and target in d["sub"]["dims"]:
+                d["sub"]["dims"][target] = \
+                    int(d["sub"]["dims"][target]) * rng.choice([3, 5, 7])
+            try:
+                _restore(rt, tam)
+            except CannotRestoreStateError as e:
+                assert e.code and e.code.startswith("SC0"), e
+                assert e.findings, "typed error must carry the diff"
+    finally:
+        m.shutdown()
+
+
+# ==================================================== report + surfaces
+
+def test_runtime_schema_report_attached():
+    m, rt, _ = _rt(PATTERN_APP)
+    try:
+        rep = rt.state_schema
+        assert rep is not None
+        assert rt.analysis.schema is rep
+        assert len(rep.digest()) == 12
+        assert any(e.endswith(":state") for e in rep.elements)
+        doc = rep.as_dict()
+        assert doc["digest"] == rep.digest()
+        assert doc["elements"]
+        assert rep.findings == []
+    finally:
+        m.shutdown()
+
+
+def test_stats_json_embeds_state_schema():
+    from siddhi_tpu.service.rest import SiddhiService
+    svc = SiddhiService(port=0)
+    try:
+        rt = svc.manager.create_siddhi_app_runtime(
+            "@app:statistics(enable='true') " + AGG_APP)
+        doc = svc._stats_json()
+        app_doc = doc["apps"][rt.name]
+        assert "state_schema" in app_doc
+        assert app_doc["state_schema"]["digest"] == \
+            rt.state_schema.digest()
+        assert app_doc["state_schema"]["elements"]
+    finally:
+        svc.manager.shutdown()
+
+
+def test_persist_restore_keeps_snapshot_verified_roundtrip():
+    """The happy path through the verifier: persist → fresh runtime →
+    restore_last_revision → continuation agrees."""
+    store = InMemoryPersistenceStore()
+    m, rt, _ = _rt(AGG_APP, store)
+    try:
+        rt.get_input_handler("S").send(["a", 2.0])
+        rt.get_input_handler("S").send(["a", 3.0])
+        rt.persist()
+    finally:
+        m.shutdown()
+    m2, rt2, got = _rt(AGG_APP, store)
+    try:
+        rt2.restore_last_revision()
+        rt2.get_input_handler("S").send(["a", 5.0])
+        assert got[-1][1] == pytest.approx(10.0)
+    finally:
+        m2.shutdown()
+
+
+# ============================================================== analyze CLI
+
+def test_analyze_schema_cli_is_jax_free(tmp_path):
+    app = tmp_path / "a.siddhi"
+    app.write_text(PATTERN_APP)
+    code = (
+        "import sys\n"
+        "import siddhi_tpu.analyze as A\n"
+        f"rc = A.main([{str(app)!r}, '--schema'])\n"
+        "assert rc == 0, rc\n"
+        "assert 'jax' not in sys.modules, 'jax leaked into --schema'\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stderr
+    assert not r.stderr.strip(), r.stderr
+
+
+def test_analyze_schema_registry_mode(tmp_path):
+    r = subprocess.run(
+        [sys.executable, "-m", "siddhi_tpu.analyze", "--schema"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stderr
+    assert "0 audit finding(s)" in r.stdout
+    assert "nfa-engine" in r.stdout
+
+
+def test_extract_app_schema_static_dump_stable():
+    from siddhi_tpu.analysis.state_schema import extract_app_schema
+    s1 = extract_app_schema(PATTERN_APP)
+    s2 = extract_app_schema(PATTERN_APP)
+    assert s1.dump() == s2.dump()
+    assert s1.digest() == s2.digest()
+    assert s1.findings == []
+    assert any(e.decl_name == "keyed-pattern" for e in s1.elements)
+    assert "nfa-engine" in s1.versions()

@@ -153,6 +153,27 @@ def compare(old, new, ratio=2.0):
                          f"{ns_:.2f}s "
                          f"({ns_ / os_ if os_ else float('inf'):.1f}x)")
             regressed = True
+    osc, nsc = old.get("schema"), new.get("schema")
+    if osc is not None and nsc is not None:
+        osm, nsm = osc.get("samples", {}), nsc.get("samples", {})
+        for fname in sorted(set(osm) & set(nsm)):
+            by_app = {r.get("app"): r for r in osm[fname]}
+            for row in nsm[fname]:
+                o = by_app.get(row.get("app"))
+                if o is None or o.get("digest") == row.get("digest"):
+                    continue
+                ov, nv = o.get("versions", {}), row.get("versions", {})
+                bumped = any(nv.get(k) != ov.get(k)
+                             for k in set(ov) | set(nv))
+                lines.append(
+                    f"schema   {fname}:{row.get('app')}  "
+                    f"{o.get('digest')} -> {row.get('digest')}"
+                    + ("" if bumped else "  (NO version bump)"))
+                if not bumped:
+                    # a layout change that kept every declaration version
+                    # breaks old checkpoints silently — SC010 at the
+                    # round-artifact level
+                    regressed = True
     oe, ne = old.get("engine_lint"), new.get("engine_lint")
     if ne is not None:
         od = oe.get("diagnostics", 0) if oe else 0
@@ -238,6 +259,25 @@ def _compile_summary():
             "cache_misses": tot["cache_misses"]}
 
 
+def _schema_summary():
+    """Pin the static persistent-state schema of every shipped sample
+    into the round artifact (analysis/state_schema.py — jax-free).
+    --compare flags any per-sample digest change whose declaration
+    versions did NOT move: layout drift without a version bump is the
+    report-level twin of the SC010 restore diagnostic.  Same
+    import/tolerance pattern as the engine lint."""
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    try:
+        from siddhi_tpu.analysis.state_schema import sample_schema_digests
+        samples = sample_schema_digests(os.path.join(root, "samples"))
+    except Exception as e:
+        sys.stderr.write(f"[t1_report] schema summary skipped: {e}\n")
+        return None
+    return {"samples": samples}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("log", nargs="?",
@@ -272,6 +312,7 @@ def main(argv=None):
         report["engine_lint"] = _engine_lint_summary()
         report["shards"] = _shards_summary()
         report["compile"] = _compile_summary()
+        report["schema"] = _schema_summary()
         with open(args.out, "w") as f:
             json.dump(report, f, indent=1, sort_keys=True)
             f.write("\n")
