@@ -1974,6 +1974,139 @@ def bench_smoke():
         "parity_digest": cs_cold["digest"],
     }
 
+    # ---- numeric safety (round 18): the static NS verifier must fire
+    # on a constructed overflow app and stay quiet on the shipped
+    # samples; an armed-NUMGUARD run over a near-overflow int-sum feed
+    # must trip the device sentinel plane with bit-identical outputs;
+    # and the armed sentinel's per-block ingest cost must stay under 5%
+    import gc
+
+    from siddhi_tpu.analysis.ranges import (analyze_numeric,
+                                            sample_numeric_counts)
+    from siddhi_tpu.core.numguard import (NUMGUARD_ENV, numeric_sentinels,
+                                          reset_numguard)
+    ns_rep = analyze_numeric(
+        "@app:rate(1000000) define stream N (v double); "
+        "from N#window.time(5000 sec) select count() as n "
+        "insert into Out;")
+    ns_codes = sorted({d.code for d in ns_rep.findings})
+    assert "NS005" in ns_codes, \
+        f"smoke numeric FAILED: static verifier missed NS005: {ns_codes}"
+    sample_ns = sample_numeric_counts()
+    sample_total = sum(sum(by.values()) for by in sample_ns.values())
+    assert sample_total == 0, \
+        f"smoke numeric FAILED: samples emit NS warnings: {sample_ns}"
+
+    NG_APP = ("@app:name('ngsmoke') @app:playback "
+              "define stream W (sym string, price float, volume long); "
+              "@info(name='q') from W select sym, sum(volume) as tv "
+              "group by sym insert into Out;")
+
+    def _ng_run(armed, feed):
+        if armed:
+            os.environ[NUMGUARD_ENV] = "1"
+        else:
+            os.environ.pop(NUMGUARD_ENV, None)
+        try:
+            m9 = SiddhiManager()
+            rt9 = m9.create_siddhi_app_runtime(NG_APP)
+            rows = []
+            rt9.add_callback("Out", StreamCallback(
+                lambda evs: rows.extend(tuple(e.data) for e in evs)))
+            rt9.start()
+            h9 = rt9.get_input_handler("W")
+            for row, ts in feed:
+                h9.send(list(row), timestamp=ts)
+            rt9.shutdown()
+            return rows
+        finally:
+            os.environ.pop(NUMGUARD_ENV, None)
+
+    ov_feed = [(["A", 1.0, 1_000_000_000], 6_000_000 + i * 10)
+               for i in range(4)]          # running int sum -> 4e9 lane
+    reset_numguard()
+    rows_off = _ng_run(False, ov_feed)
+    rows_on = _ng_run(True, ov_feed)
+    assert rows_on == rows_off, \
+        "smoke numguard FAILED: sentinel plane changed match outputs"
+    guard = numeric_sentinels("ngsmoke", create=False)
+    trips = guard.snapshot()["trips"] if guard else {}
+    assert trips.get("gagg.step:int_near_overflow", 0) > 0, \
+        f"smoke numguard FAILED: overflow feed tripped nothing: {trips}"
+
+    # armed-vs-disarmed ingest cost: NUMGUARD arms at app construction
+    # (the device step signature changes), so unlike the flight/ledger
+    # env flips this measures two prebuilt runtimes with alternating
+    # rounds and compares best-of-3 round walls; rounds ingest via the
+    # columnar send_batch rim — the sentinel-plane fetch is per device
+    # block, so per-event sends would overstate its amortized cost —
+    # and a ~50 ms absolute noise floor keeps scheduler jitter from
+    # failing tier-1
+    ng_n = 256
+    ng_cols = {
+        "sym": np.asarray([f"k{i % 8}" for i in range(ng_n)], object),
+        "price": np.asarray([float(i % 97) for i in range(ng_n)],
+                            np.float32),
+        "volume": np.arange(ng_n, dtype=np.int64) % 89,
+    }
+    ng_ts = 8_000_000 + np.arange(ng_n, dtype=np.int64) * 3
+
+    def _ng_build(armed):
+        if armed:
+            os.environ[NUMGUARD_ENV] = "1"
+        else:
+            os.environ.pop(NUMGUARD_ENV, None)
+        try:
+            mb = SiddhiManager()
+            rtb = mb.create_siddhi_app_runtime(NG_APP)
+            rtb.add_callback("Out", StreamCallback(lambda evs: None))
+            rtb.start()
+            return rtb, rtb.get_input_handler("W")
+        finally:
+            os.environ.pop(NUMGUARD_ENV, None)
+
+    rt_on, h_on = _ng_build(True)
+    rt_off, h_off = _ng_build(False)
+
+    def _ng_round(handler):
+        t0n = time.perf_counter()
+        for _ in range(20):
+            handler.send_batch(dict(ng_cols), timestamps=ng_ts)
+        return time.perf_counter() - t0n
+
+    for _ in range(2):                     # warm/trace both arms
+        _ng_round(h_on)
+        _ng_round(h_off)
+    gc.collect()
+    gc.disable()
+    try:
+        on_walls, off_walls = [], []
+        for _ in range(3):                 # best-of-3, alternating
+            off_walls.append(_ng_round(h_off))
+            on_walls.append(_ng_round(h_on))
+    finally:
+        gc.enable()
+    rt_on.shutdown()
+    rt_off.shutdown()
+    ng_on, ng_off = min(on_walls), min(off_walls)
+    ng_overhead_pct = round(
+        max(0.0, (ng_on - ng_off) / ng_off) * 100, 2)
+    ng_ok = ng_overhead_pct < 5.0 or (ng_on - ng_off) < 0.05
+    print(f"numguard sentinel ingest overhead: on={ng_on*1e3:.3f}ms "
+          f"off={ng_off*1e3:.3f}ms per 20x{ng_n}-event round -> "
+          f"{ng_overhead_pct}%", file=sys.stderr)
+    assert ng_ok, \
+        f"smoke numguard overhead FAILED: {ng_overhead_pct}% >= 5% " \
+        f"(on={ng_on:.4f}s off={ng_off:.4f}s)"
+    reset_numguard()
+    res["numeric_smoke"] = {
+        "static_codes": ns_codes,
+        "sample_findings_total": sample_total,
+        "sentinel_trips": sum(trips.values()),
+        "overhead_pct": ng_overhead_pct,
+        "overhead_abs_ms": round((ng_on - ng_off) * 1e3, 3),
+    }
+
     res["smoke_wall_s"] = round(time.perf_counter() - t_start, 2)
     return res
 
@@ -2283,6 +2416,31 @@ def main():
     if "--smoke" in sys.argv:
         _force_cpu()
         print(json.dumps(bench_smoke()))
+        return
+    # --fail-on-numeric N: exit non-zero when the samples/ sweep of the
+    # static numeric-safety verifier (analysis/ranges.py) emits more
+    # than N warning-level NS findings — the mechanical CI gate of the
+    # round-18 NS catalog.  Standalone and jax-free: it never touches a
+    # backend, so it runs before the backend-availability probe
+    if "--fail-on-numeric" in sys.argv:
+        fail_on_numeric = int(
+            sys.argv[sys.argv.index("--fail-on-numeric") + 1])
+        from siddhi_tpu.analysis.ranges import sample_numeric_counts
+        ns_by_file = {f: by for f, by in
+                      sample_numeric_counts().items() if by}
+        ns_total = sum(sum(by.values()) for by in ns_by_file.values())
+        print(json.dumps({
+            "metric": "numeric-safety findings (samples/ NS sweep)",
+            "value": ns_total, "unit": "warnings",
+            "per_file": ns_by_file,
+            "limit": fail_on_numeric}))
+        if ns_total > fail_on_numeric:
+            print(f"[bench] FAIL: {ns_total} warning-level NS findings "
+                  f"across samples/ exceeds --fail-on-numeric "
+                  f"{fail_on_numeric} — declare @attr:range/@app:rate "
+                  f"(or the compensated-sum remediation) per finding; "
+                  f"see docs/numeric_safety.md", file=sys.stderr)
+            sys.exit(1)
         return
     # device phases: degrade gracefully when the backend is unreachable
     # (BENCH_r05: a raw rc=1 stack trace) — structured skip, exit 0

@@ -36,10 +36,21 @@ compatibility layer (SC0xx):
     rt.analysis.schema                      # StateSchemaReport on the
                                             # live runtime (also /stats)
 
+Numeric-safety surface (PR 18) — the static value-range & precision
+verifier (NS0xx) with SIDDHI_TPU_NUMGUARD runtime sentinels (NS101):
+
+    from siddhi_tpu.analysis import analyze_numeric
+
+    report = analyze_numeric(app_text)      # interval lattice seeded
+    report.counts(); report.dump()          # from @attr:range/@app:rate
+    rt.analysis.numeric                     # plan-grounded refinement
+                                            # (also GET /stats)
+
 CLI: ``python -m siddhi_tpu.analyze app.siddhi [--json] [--strict]
-[--plan] [--schema]``; ``python -m siddhi_tpu.analyze --engine`` for
-the audit; bare ``--schema`` for the declaration registry + SC002
-audit.  Everything importable here stays jax-free; only the jaxpr
+[--plan] [--schema] [--numeric]``; ``python -m siddhi_tpu.analyze
+--engine`` for the audit; bare ``--schema`` for the declaration
+registry + SC002 audit.
+Everything importable here stays jax-free; only the jaxpr
 sanitizer (plan_verify.sanitize_runtime) imports jax, lazily.
 Diagnostic catalog: docs/analysis.md (generated from
 diagnostics.catalog_markdown()).
@@ -50,6 +61,9 @@ from .diagnostics import (CATALOG, CatalogEntry, Diagnostic, Severity,
                           catalog_markdown)
 from .engine import EngineReport, analyze_engine, static_lock_edges
 from .plan_ir import AutomatonIR, PlanIR, ProgramIR, extract_plan
+from .ranges import (Interval, NumericReport, analyze_numeric,
+                     attach_numeric_analysis, collect_attr_ranges,
+                     numeric_pass, sample_numeric_counts, ts32_safe_max)
 from .plan_verify import (PlanReport, attach_plan_analysis, sanitize_step,
                           verify_automaton, verify_plan)
 from .state_schema import (AppStateSchema, StateSchemaReport,
@@ -64,6 +78,9 @@ __all__ = ["analyze", "AnalysisResult", "Diagnostic", "Severity",
            "PlanReport", "verify_plan", "verify_automaton",
            "sanitize_step", "attach_plan_analysis",
            "EngineReport", "analyze_engine", "static_lock_edges",
+           "Interval", "NumericReport", "analyze_numeric",
+           "attach_numeric_analysis", "collect_attr_ranges",
+           "numeric_pass", "sample_numeric_counts", "ts32_safe_max",
            "AppStateSchema", "StateSchemaReport",
            "attach_schema_analysis", "audit_declarations",
            "extract_app_schema", "extract_runtime_schema",

@@ -67,6 +67,11 @@ class AnalysisResult:
     #: (state_schema.py) — set by attach_schema_analysis when the
     #: runtime is built; None for source-only analysis
     schema: Optional[object] = None
+    #: NumericReport from the numeric-safety verifier (ranges.py) — the
+    #: source-level pass sets it at analyze() time; when a runtime is
+    #: built, attach_numeric_analysis replaces it with the plan-grounded
+    #: refinement
+    numeric: Optional[object] = None
 
     @property
     def errors(self) -> List[Diagnostic]:
@@ -162,6 +167,8 @@ def analyze(app: Union[str, SiddhiApp],
     _fault_tolerance_pass(app, sink)
     _ingest_protection_pass(app, sink)
     _slo_pass(app, sink)
+    from .ranges import numeric_pass
+    res.numeric = numeric_pass(app, sink, engine)
     order = {Severity.ERROR: 0, Severity.WARNING: 1, Severity.INFO: 2}
     res.diagnostics = sorted(
         sink.diagnostics,

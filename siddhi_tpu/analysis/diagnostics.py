@@ -21,6 +21,13 @@ Families:
   ``SC0xx`` — persistent-state schema / checkpoint compatibility
               (restore-time verification + the static registry audit)
               — analysis/state_schema.py + core/stateschema.py
+  ``SA09x`` — attribute range / numeric annotation validation
+              (``@attr:range(lo,hi)``, ``@app:rate``)
+  ``NS0xx`` — numeric safety, static half: value-range & precision
+              analysis over the interval lattice — analysis/ranges.py
+  ``NS1xx`` — numeric safety, runtime half: on-device/host-rim
+              overflow & NaN sentinels (SIDDHI_TPU_NUMGUARD)
+              — core/numguard.py
 
 The full catalog with meanings and fixes is rendered in
 ``docs/analysis.md``; :data:`CATALOG` is its single source of truth and
@@ -489,6 +496,85 @@ CATALOG: Dict[str, CatalogEntry] = {e.code: e for e in [
        "Restore from the latest intact full revision, or re-persist; "
        "never delete intermediate _inc revisions without their "
        "successors."),
+    _C("SA090", _E, "invalid-range-annotation",
+       "An @attr:range / @app:rate numeric-safety annotation is "
+       "malformed: wrong arity, a non-numeric bound, an unknown or "
+       "non-numeric attribute, or a non-positive rate.  The numeric "
+       "verifier ignores the annotation and falls back to conservative "
+       "dtype bounds.",
+       "Write @attr:range(attr, lo, hi) with numeric bounds naming a "
+       "numeric attribute of the stream, and @app:rate(events_per_sec) "
+       "with a positive number."),
+    _C("SA091", _E, "inverted-range-bounds",
+       "An @attr:range annotation declares lo > hi — an empty range.  "
+       "The declaration is ignored; the attribute keeps conservative "
+       "dtype bounds.",
+       "Swap the bounds so lo <= hi."),
+    _C("SA092", _W, "range-wider-than-dtype",
+       "An @attr:range annotation declares bounds outside what the "
+       "attribute's dtype can represent (e.g. an int attribute with a "
+       "bound past 2^31).  The range is clamped to the dtype's bounds, "
+       "so the declaration adds no information there.",
+       "Tighten the declared range to the dtype, or widen the "
+       "attribute's type (int -> long, float -> double)."),
+    _C("NS001", _W, "int-overflow-reachable",
+       "Integer arithmetic can exceed its result dtype under the "
+       "declared value ranges: the interval of a +,-,*,sum() over "
+       "int/long lanes escapes int32/int64 bounds, so the computation "
+       "can silently wrap on device (jax int ops wrap, they do not "
+       "raise).",
+       "Tighten @attr:range bounds, widen the attribute to long, or "
+       "shrink the window so the accumulated bound fits."),
+    _C("NS002", _W, "division-by-zero-reachable",
+       "A divisor's value interval contains 0 (division or modulo), so "
+       "a div-by-zero / NaN-propagation path is reachable.  On device "
+       "the result is inf/NaN (float) or an undefined wrapped value "
+       "(int) that silently poisons downstream aggregates.",
+       "Exclude 0 from the divisor's @attr:range, or guard the "
+       "division with a filter / ifThenElse on the divisor."),
+    _C("NS003", _W, "f32-precision-budget-exceeded",
+       "A float32 accumulation's error budget is exceeded: window "
+       "span x rate x max|value| puts the running sum past 2^24 ulp, "
+       "where naive f32 addition starts dropping whole updates.  "
+       "Applies to uncompensated accumulators (incremental-aggregation "
+       "slabs); gagg/wagg running sums are compensated (TwoSum/Kahan) "
+       "and exempt.",
+       "Declare @numeric(sum='compensated') on the aggregation (exact "
+       "compensated slab lanes, parity-proven), tighten @attr:range, "
+       "or shorten the bucket duration."),
+    _C("NS004", _W, "ts32-horizon-wrap",
+       "A window span, `within` bound or absent-pattern gap timer "
+       "approaches the int32 millisecond horizon (~24.8 days; the "
+       "usable half-horizon is ~12.4 days after rebase headroom).  "
+       "Device timestamps ride int32 offsets (ops/ts32.py); a span "
+       "this long can make offset arithmetic wrap or a single ring "
+       "span unrepresentable.",
+       "Shorten the window/within span below ~12 days, or route the "
+       "query to the host engine (@app:engine('host'))."),
+    _C("NS005", _W, "count-lane-saturation",
+       "A count lane (int32: gagg gcnt, wagg cnt, NFA __cnt, slab "
+       "cnt) can reach 2^31 under the declared window span and event "
+       "rate — the counter saturates/wraps and every derived avg "
+       "silently corrupts.",
+       "Shorten the window, lower the declared @app:rate if it "
+       "overstates reality, or route to the host engine."),
+    _C("NS006", _W, "lossy-egress-demotion",
+       "An int/long output attribute whose declared range exceeds "
+       "2^24 rides a float32 lane through the fused-egress slab on "
+       "the device path — values past 2^24 are rounded to the nearest "
+       "representable f32, so exact integers come back perturbed.",
+       "Keep device-path integer outputs within +/-2^24, or accept "
+       "rounding; the host engine (@app:engine('host')) keeps exact "
+       "integers."),
+    _C("NS101", _W, "numeric-sentinel-tripped",
+       "A SIDDHI_TPU_NUMGUARD runtime sentinel observed a numeric "
+       "hazard live: a non-finite float aggregate, an integer "
+       "accumulator inside its overflow guard band, a count lane near "
+       "int32 saturation, or a ts32 rebase with thin headroom.  The "
+       "incident is on the flight bus with the site and reading.",
+       "Treat as confirmation of the static NS0xx finding at that "
+       "site: apply its fix, then re-run with NUMGUARD armed to "
+       "verify the sentinel stays quiet."),
     _C("SC010", _E, "schema-evolution-without-version-bump",
        "Two snapshots declare the same schema name and version but "
        "different layout digests — the persisted layout changed "
@@ -553,6 +639,7 @@ _FAMILIES = (
     ("SA06", "Ingest protection"),
     ("SA07", "Service-level objectives"),
     ("SA08", "Partition shard-out"),
+    ("SA09", "Attribute range declarations"),
     ("SP0", "TPU performance hazards"),
     ("PV00", "Plan verifier — automaton"),
     ("PV01", "Plan verifier — jaxpr kernel sanitizer"),
@@ -561,6 +648,8 @@ _FAMILIES = (
     ("CE1", "Engine hot-path lint"),
     ("LW0", "Runtime lock-witness"),
     ("SC0", "Persistent-state schema"),
+    ("NS0", "Numeric safety — static value-range analysis"),
+    ("NS1", "Numeric safety — runtime sentinels"),
 )
 
 
@@ -606,11 +695,16 @@ class DiagnosticSink:
         self._seen = set()
 
     def emit(self, code: str, message: str, pos: Optional[SourcePos] = None,
-             query: Optional[str] = None, **extra) -> None:
+             query: Optional[str] = None,
+             severity: Optional[Severity] = None, **extra) -> None:
+        """``severity`` overrides the catalog default — the numeric
+        verifier downgrades findings to INFO when the verdict rests only
+        on undeclared conservative dtype bounds (no @attr:range)."""
         key = (code, message, pos.line if pos else -1,
                pos.col if pos else -1, query)
         if key in self._seen:
             return
         self._seen.add(key)
         self.diagnostics.append(
-            Diagnostic(code, message, pos=pos, query=query, extra=extra))
+            Diagnostic(code, message, severity=severity, pos=pos,
+                       query=query, extra=extra))

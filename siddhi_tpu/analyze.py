@@ -17,6 +17,9 @@ Usage:
     python -m siddhi_tpu.analyze --schema              # declaration
                                                        # registry + SC002
                                                        # audit
+    python -m siddhi_tpu.analyze app.siddhi --numeric  # numeric-safety
+                                                       # verifier (NS0xx
+                                                       # value ranges)
 
 Exit codes: 0 clean (infos allowed), 1 errors (or warnings under
 --strict), 2 usage error.
@@ -97,6 +100,12 @@ def main(argv=None) -> int:
                          "import.  Without an app: print every "
                          "@persistent_schema declaration in the engine "
                          "source and run the SC002 audit")
+    ap.add_argument("--numeric", action="store_true",
+                    help="run only the numeric-safety verifier: the "
+                         "NS0xx value-range / precision pass seeded "
+                         "from @attr:range and @app:rate declarations "
+                         "— no jax import; exits 1 on warning-level "
+                         "findings")
     ap.add_argument("--catalog", action="store_true",
                     help="print the diagnostic catalog and exit")
     ap.add_argument("--catalog-md", action="store_true",
@@ -161,6 +170,23 @@ def main(argv=None) -> int:
             print(f"error: {e}", file=sys.stderr)
             return 2
         name = args.app
+
+    if args.numeric:
+        from .analysis.ranges import analyze_numeric
+        try:
+            report = analyze_numeric(
+                text, engine=None if args.engine in (None, "self")
+                else args.engine)
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(report.as_dict(), indent=1))
+        else:
+            print(report.dump(), end="")
+        bad = [d for d in report.findings
+               if d.severity.value != "info" or args.strict]
+        return 1 if bad else 0
 
     if args.schema:
         from .analysis.state_schema import extract_app_schema

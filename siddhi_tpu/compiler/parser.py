@@ -171,6 +171,16 @@ class Parser:
 
     def _ann_value(self) -> str:
         t = self.peek()
+        if t.kind == "OP" and t.text == "-":
+            # signed numeric value, e.g. @attr:range('delta', -500, 500)
+            self.next()
+            t = self.peek()
+            if t.kind in ("INT", "LONG", "FLOAT", "DOUBLE"):
+                self.next()
+                return "-" + t.text
+            raise SiddhiParserException(
+                f"Expected a number after '-' in annotation value, "
+                f"found {t.text!r}", t.line, t.col)
         if t.kind in ("STRING", "INT", "LONG", "FLOAT", "DOUBLE"):
             self.next()
             return t.text if t.kind != "STRING" else t.value

@@ -812,6 +812,20 @@ class SiddhiManager:
             if strict:
                 rt.shutdown()
                 raise
+        # numeric-safety verifier (analysis/ranges.py): re-grounds the
+        # NS0xx value-range verdicts on the compiled plan's dims; the
+        # refined NumericReport rides rt.analysis.numeric (and GET
+        # /stats), cross-validated live by the SIDDHI_TPU_NUMGUARD
+        # sentinels (core/numguard.py)
+        try:
+            from ..analysis.ranges import attach_numeric_analysis
+            with trace_span("numeric", cat="compile"):
+                attach_numeric_analysis(rt)
+        except Exception:   # noqa: BLE001 — advisory pass must never
+            # take down app creation (strict mode excepted below)
+            if strict:
+                rt.shutdown()
+                raise
         if strict and rt.analysis is not None:
             try:
                 rt.analysis.raise_if(strict=True)

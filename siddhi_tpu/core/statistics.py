@@ -702,6 +702,7 @@ def prometheus_text(managers: List[StatisticsManager],
     holders.  Every series family gets its # HELP/# TYPE header exactly
     once, before any samples."""
     from .ledger import ledger
+    from .numguard import NUMERIC_TYPES, all_numeric_sentinels
     from .overload import INGEST_TYPES, TENANT_TYPES
     from .profiling import rim_stats
     from .resilience import RESILIENCE_TYPES
@@ -711,12 +712,16 @@ def prometheus_text(managers: List[StatisticsManager],
     for name, typ, help_ in (_TYPES + RIM_TYPES + LEDGER_TYPES +
                              TELEMETRY_TYPES + RESILIENCE_TYPES +
                              INGEST_TYPES + TENANT_TYPES + XTENANT_TYPES +
-                             SHAPES_TYPES + PROCESS_TYPES):
+                             SHAPES_TYPES + NUMERIC_TYPES + PROCESS_TYPES):
         lines.append(f"# HELP {name} {help_}")
         lines.append(f"# TYPE {name} {typ}")
     lines.extend(rim_stats().prometheus_lines())
     lines.extend(ledger().prometheus_lines())
     lines.extend(shape_registry().prometheus_lines())
+    for ns in all_numeric_sentinels():
+        # numeric sentinels (core/numguard.py, SIDDHI_TPU_NUMGUARD):
+        # process-global registry like the flight recorder
+        lines.extend(ns.prometheus_lines())
     lines.extend(process_lines())
     for sm in managers:
         lines.extend(sm.prometheus_lines())

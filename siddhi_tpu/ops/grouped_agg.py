@@ -116,7 +116,8 @@ def _pair_add(hi, lo, x, ok):
     return jnp.where(ok, hi2, hi), jnp.where(ok, lo3, lo)
 
 
-def build_grouped_step(window: int, want_minmax: bool, want_forever: bool):
+def build_grouped_step(window: int, want_minmax: bool, want_forever: bool,
+                       numguard: bool = False):
     """fn(carry, vals_f [P,T,VF], vals_i [P,T,VI], gids [P,T] i32,
     accepted [P,T]) → (carry, outs): per-event aggregates of the arriving
     event's group after the update — a 13-tuple of [P, T, ...] arrays
@@ -125,7 +126,14 @@ def build_grouped_step(window: int, want_minmax: bool, want_forever: bool):
     discarded host-side.
 
     window == 0 → running (no-window) mode: no eviction, and plain
-    min/max equal the forever lanes."""
+    min/max equal the forever lanes.
+
+    numguard=True (SIDDHI_TPU_NUMGUARD, core/numguard.py) appends a
+    14th output: a [3] int32 sentinel plane [int-sums near the 2^31
+    ceiling, count lanes near 2^31, non-finite float-sum lanes] folded
+    from the post-step carry the kernel already holds — an extra
+    OUTPUT, not a carry leaf, so the persistent schema, the cost model
+    and every match output stay bit-identical with the guard off."""
     W = window
 
     def lane_step(carry, xs):
@@ -185,9 +193,28 @@ def build_grouped_step(window: int, want_minmax: bool, want_forever: bool):
     def step(carry: GroupedAggCarry, vals_f, vals_i, gids, accepted):
         new_c, outs = jax.vmap(per_lane)(tuple(carry), vals_f, vals_i,
                                          gids, accepted)
-        return GroupedAggCarry(*new_c), outs
+        nc = GroupedAggCarry(*new_c)
+        if numguard:
+            outs = outs + (sentinel_plane(nc.fsum_hi, nc.isum_hi,
+                                          nc.isum_lo, nc.gcnt),)
+        return nc, outs
 
     return step
+
+
+def sentinel_plane(fsum_hi, isum_hi, isum_lo, gcnt) -> jnp.ndarray:
+    """[3] int32 NUMGUARD flags folded from accumulator planes a step
+    already produced: [int sums past 90% of 2^31, count lanes past 90%
+    of 2^31, non-finite float-sum lanes].  The int reassembly rides f32
+    (x64 is off under jit) — exactness does not matter for a 0.9x
+    threshold test, only magnitude."""
+    near = jnp.float32(0.9 * INT_EXACT_MAX)
+    isum = (isum_hi.astype(jnp.float32) * _SPLIT +
+            isum_lo.astype(jnp.float32))
+    n_int = jnp.sum(jnp.abs(isum) >= near).astype(jnp.int32)
+    n_cnt = jnp.sum(gcnt.astype(jnp.float32) >= near).astype(jnp.int32)
+    n_fin = jnp.sum(~jnp.isfinite(fsum_hi)).astype(jnp.int32)
+    return jnp.stack([n_int, n_cnt, n_fin])
 
 
 def reassemble_int_sums(sum_hi: np.ndarray, sum_lo: np.ndarray

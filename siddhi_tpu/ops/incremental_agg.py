@@ -17,8 +17,17 @@ pairs only), the device folds the whole batch with one `segment_sum` /
 `segment_min` / `segment_max` per base — no per-event work on the hot path.
 
 Precision note: values ride float32 lanes (TPU-native); exact integer
-conformance is kept for counts (int32 lane).  Int-typed sums above 2^24
-lose precision vs the host cascade's arbitrary-precision ints.
+conformance is kept for counts (int32 lane).  In the default NAIVE mode
+int-typed sums above 2^24 lose precision vs the host cascade's
+arbitrary-precision ints — the static NS003 finding
+(analysis/ranges.py).  ``@numeric(sum='compensated')`` on the
+aggregation definition switches :func:`build_slab_update` to
+COMPENSATED mode: each slab keeps a TwoSum error lane per base column,
+batch partial sums fold in error-free (Knuth TwoSum, the
+ops/grouped_agg.py treatment), and the sync path reads
+``vals + comp`` in float64 — integer sums stay exact to 2^48-scale
+magnitudes at ~one extra f32 slab of state.  Parity is proven in
+tests/test_numguard.py.
 """
 from __future__ import annotations
 
@@ -44,13 +53,27 @@ def init_row(base_fns: List[str]) -> np.ndarray:
     return out
 
 
-def build_slab_update(base_fns: Tuple[str, ...]):
+def _two_sum(a, b):
+    """Error-free transform: a + b = s + err exactly (Knuth TwoSum)."""
+    s = a + b
+    bb = s - a
+    err = (a - (s - bb)) + (b - bb)
+    return s, err
+
+
+def build_slab_update(base_fns: Tuple[str, ...],
+                      compensated: bool = False):
     """→ jitted fn(vals [S, B], cnt [S], seg [n], base_vals [n, B]) →
-    (vals, cnt).  `seg` < 0 marks masked-out rows."""
+    (vals, cnt).  `seg` < 0 marks masked-out rows.
+
+    compensated=True changes the signature to fn(vals, comp, cnt, seg,
+    base_vals) → (vals, comp, cnt): sum/sumsq batch partials fold into
+    the running slab via TwoSum with the rounding error banked in the
+    ``comp`` lane, so ``float64(vals) + float64(comp)`` tracks the true
+    sum far past the f32 2^24 cliff (see module docstring)."""
     base_fns = tuple(base_fns)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def update(vals, cnt, seg, base_vals):
+    def _fold(vals, comp, cnt, seg, base_vals):
         S = vals.shape[0]
         n = seg.shape[0]
         valid = seg >= 0
@@ -58,6 +81,7 @@ def build_slab_update(base_fns: Tuple[str, ...]):
         cnt = cnt + jax.ops.segment_sum(valid.astype(jnp.int32), seg_c,
                                         num_segments=S + 1)[:S]
         cols = []
+        ccols = []
         for b, fn in enumerate(base_fns):
             col = base_vals[:, b]
             cur = vals[:, b]
@@ -65,7 +89,12 @@ def build_slab_update(base_fns: Tuple[str, ...]):
                 v = col * col if fn == "sumsq" else col
                 add = jax.ops.segment_sum(jnp.where(valid, v, 0.0), seg_c,
                                           num_segments=S + 1)[:S]
-                cols.append(cur + add)
+                if comp is not None:
+                    s, err = _two_sum(cur, add)
+                    ccols.append(comp[:, b] + err)
+                    cols.append(s)
+                else:
+                    cols.append(cur + add)
             elif fn == "min":
                 m = jax.ops.segment_min(jnp.where(valid, col, POS_INF),
                                         seg_c, num_segments=S + 1)[:S]
@@ -86,7 +115,22 @@ def build_slab_update(base_fns: Tuple[str, ...]):
                 cols.append(jnp.where(has, lastv, cur))
             else:
                 raise ValueError(f"Unknown base fn {fn}")
-        return jnp.stack(cols, axis=1), cnt
+            if comp is not None and fn not in ("sum", "sumsq"):
+                ccols.append(comp[:, b])   # untouched for non-sum lanes
+        new_vals = jnp.stack(cols, axis=1)
+        if comp is not None:
+            return new_vals, jnp.stack(ccols, axis=1), cnt
+        return new_vals, cnt
+
+    if compensated:
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def update_c(vals, comp, cnt, seg, base_vals):
+            return _fold(vals, comp, cnt, seg, base_vals)
+        return update_c
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def update(vals, cnt, seg, base_vals):
+        return _fold(vals, None, cnt, seg, base_vals)
 
     return update
 

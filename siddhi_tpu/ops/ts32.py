@@ -29,7 +29,8 @@ def shift_clamped(v, delta: int, lo: int) -> jnp.ndarray:
 
 
 def rebase_offsets(src: np.ndarray, valid: np.ndarray, base,
-                   window_ms: int, ring_ts, empty_marker: int):
+                   window_ms: int, ring_ts, empty_marker: int,
+                   sentinels=None, site: str = "ts32"):
     """Shared i64→i32 offset rebase for time-window device rings (used by
     plan/wagg_compiler AND plan/gagg_compiler — one protocol, one place).
 
@@ -71,4 +72,8 @@ def rebase_offsets(src: np.ndarray, valid: np.ndarray, base,
             shifted = shift_clamped(rts, delta, empty_marker + 1)
             new_ring = jnp.where(jnp.asarray(rts == empty_marker),
                                  jnp.int32(empty_marker), shifted)
+        if sentinels is not None:
+            # NUMGUARD witness (core/numguard.py): count the rebase and
+            # report the horizon headroom left after the shift
+            sentinels.note_rebase(site, safe - int(offs[valid].max()))
     return np.where(valid, offs, 0).astype(np.int32), base, new_ring

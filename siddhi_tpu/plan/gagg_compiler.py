@@ -280,6 +280,13 @@ class CompiledGroupedAgg:
         self.n_groups = G_START
         self.gid_map: Dict[Tuple, int] = {}      # (lane, key tuple) → gid
         self._lane_gids: Dict[int, int] = {}     # lane → next local gid
+        # numeric sentinels (core/numguard.py, SIDDHI_TPU_NUMGUARD):
+        # armed at compile time — the device sentinel output is part of
+        # the compiled program, not a runtime toggle
+        from ..core.numguard import numeric_sentinels, numguard_enabled
+        self._numguard = numguard_enabled()
+        self.sentinels = numeric_sentinels(app.name or "?") \
+            if self._numguard else None
         self._build_step()
         self.carry = self._make_carry(n_lanes)
 
@@ -305,14 +312,18 @@ class CompiledGroupedAgg:
             # slabs in place) UNLESS exact int sums are wanted — their
             # bound trips in decode and rewinds to the pre-carry
             donate = () if self._int_sum_needed else (0,)
+            # NUMGUARD (core/numguard.py): the sentinel flag appends a
+            # 14th output, a different compiled program — so it is part
+            # of the shape-class key, like every program-changing fact
             self._step = wrap_kernel("gagg.step", shape_registry().jit(
                 "gagg.step",
                 {"kind": self.window_kind, "win": self.window,
                  "vf": self._n_float, "vi": self._n_int,
                  "minmax": self.want_minmax, "forever": self.want_forever,
-                 "donate": bool(donate)},
+                 "donate": bool(donate), "numguard": self._numguard},
                 build_grouped_step(
-                    self.window, self.want_minmax, self.want_forever),
+                    self.window, self.want_minmax, self.want_forever,
+                    numguard=self._numguard),
                 donate_argnums=donate))
 
     def _make_carry(self, n_lanes: int, n_groups: Optional[int] = None):
@@ -451,7 +462,8 @@ class CompiledGroupedAgg:
                np.asarray(data.timestamps, np.int64))
         offs, base, new_ring = rebase_offsets(
             src, ok, self._ts_base, self.window_ms,
-            self.carry.ring_ts, TS_EMPTY)
+            self.carry.ring_ts, TS_EMPTY,
+            sentinels=self.sentinels, site="gagg.ts32")
         if new_ring is not self.carry.ring_ts:
             # rebase shifts the carried ring: retire in-flight work first
             # so every queued step (and any overflow replay) shares one
@@ -460,7 +472,8 @@ class CompiledGroupedAgg:
                 self.flush_hook()
             offs, base, new_ring = rebase_offsets(
                 src, ok, self._ts_base, self.window_ms,
-                self.carry.ring_ts, TS_EMPTY)
+                self.carry.ring_ts, TS_EMPTY,
+                sentinels=self.sentinels, site="gagg.ts32")
             self.carry = self.carry._replace(ring_ts=new_ring)
         self._ts_base = base
         plane = np.zeros(shape, np.int32)
@@ -596,8 +609,19 @@ class CompiledGroupedAgg:
                     bool(np.asarray(work["post_carry"].overflow).any()):
                 raise GaggOverflow()
             outs_host = [np.asarray(o) for o in work["outs"]]
+        if self._numguard and self.window_kind != "time":
+            # device sentinel plane (14th output — see _build_step)
+            sent, outs_host = outs_host[-1], outs_host[:-1]
+            if self.sentinels is not None:
+                self.sentinels.observe_sentinel_plane("gagg.step", sent)
         (fhi, flo, ihi, ilo, cnt, w_mnf, w_mxf, w_mni, w_mxi,
          a_mnf, a_mxf, a_mni, a_mxi) = outs_host
+        if self.sentinels is not None:
+            # host-rim witness over planes this decode already fetched:
+            # bit-identical by construction (reads only, no compute path
+            # change) — covers the time kernel, which has no device plane
+            self.sentinels.observe_floats("gagg.decode", fhi)
+            self.sentinels.observe_counts("gagg.decode", cnt)
         sel_l, sel_r = lanes32[ok], row[ok]
 
         def pick(a):

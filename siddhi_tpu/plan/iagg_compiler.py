@@ -29,9 +29,11 @@ from ..query_api.definition import DURATION_MS
 
 
 class _Slab:
-    """One duration's device bucket store."""
+    """One duration's device bucket store.  ``compensated=True`` adds a
+    TwoSum error lane per base column (the @numeric(sum='compensated')
+    NS003 remediation — ops/incremental_agg.build_slab_update)."""
 
-    def __init__(self, base_fns, cap=2048):
+    def __init__(self, base_fns, cap=2048, compensated=False):
         import jax.numpy as jnp
 
         from ..ops.incremental_agg import init_row
@@ -42,6 +44,7 @@ class _Slab:
         self.vals = jnp.broadcast_to(jnp.asarray(init_row(base_fns)),
                                      (cap, max(len(base_fns), 1))).copy()
         self.cnt = jnp.zeros((cap,), jnp.int32)
+        self.comp = jnp.zeros_like(self.vals) if compensated else None
 
     def grow(self):
         import jax.numpy as jnp
@@ -52,6 +55,9 @@ class _Slab:
         self.vals = jnp.concatenate([self.vals, extra_v])
         self.cnt = jnp.concatenate(
             [self.cnt, jnp.zeros((self.cap,), jnp.int32)])
+        if self.comp is not None:
+            self.comp = jnp.concatenate(
+                [self.comp, jnp.zeros_like(extra_v)])
         self.cap *= 2
 
 
@@ -80,10 +86,18 @@ class DeviceAggregationRuntime(AggregationRuntime):
                                                     AttrType.OBJECT):
                     raise TypeError(
                         "non-numeric base lane: host cascade only")
+            from ..analysis.ranges import compensated_sum_declared
+            from ..core.numguard import (numeric_sentinels,
+                                         numguard_enabled)
             from ..ops.incremental_agg import build_slab_update
+            self._compensated = compensated_sum_declared(ad)
             self._slabs: Dict[str, _Slab] = {
-                d: _Slab(self.base_fns) for d in self.durations}
-            self._update = build_slab_update(tuple(self.base_fns))
+                d: _Slab(self.base_fns, compensated=self._compensated)
+                for d in self.durations}
+            self._update = build_slab_update(tuple(self.base_fns),
+                                             compensated=self._compensated)
+            self.sentinels = numeric_sentinels(app_runtime.name) \
+                if numguard_enabled() else None
             self._dirty = False
         except Exception:
             # undo the junction subscription super() made, then let the
@@ -141,8 +155,14 @@ class DeviceAggregationRuntime(AggregationRuntime):
                     slab.pair_of.append((b_ts, key))
                 slots[j] = slot
             seg = slots[seg_local].astype(np.int32)
-            slab.vals, slab.cnt = self._update(
-                slab.vals, slab.cnt, jnp.asarray(seg), jnp.asarray(bv))
+            if slab.comp is not None:
+                slab.vals, slab.comp, slab.cnt = self._update(
+                    slab.vals, slab.comp, slab.cnt, jnp.asarray(seg),
+                    jnp.asarray(bv))
+            else:
+                slab.vals, slab.cnt = self._update(
+                    slab.vals, slab.cnt, jnp.asarray(seg),
+                    jnp.asarray(bv))
         self._dirty = True
 
     # ------------------------------------------------------------ sync
@@ -160,6 +180,21 @@ class DeviceAggregationRuntime(AggregationRuntime):
                 continue
             vals = np.asarray(slab.vals[:used])
             cnt = np.asarray(slab.cnt[:used])
+            comp = (np.asarray(slab.comp[:used])
+                    if slab.comp is not None else None)
+            if self.sentinels is not None:
+                # NUMGUARD witness over the slab this sync already
+                # fetched: non-finite accumulators always; the 2^24
+                # precision budget only on NAIVE sum lanes — this is the
+                # live NS003 cross-validation (tests/test_numguard.py)
+                self.sentinels.observe_floats(f"iagg.{dur}", vals)
+                self.sentinels.observe_counts(f"iagg.{dur}", cnt)
+                if comp is None:
+                    sums = [b for b, fn in enumerate(self.base_fns)
+                            if fn in ("sum", "sumsq")]
+                    if sums:
+                        self.sentinels.observe_precision(
+                            f"iagg.{dur}", vals[:, sums])
             store: Dict[Tuple[int, Tuple], List[Any]] = {}
             for s, (b_ts, key) in enumerate(slab.pair_of):
                 row = []
@@ -169,6 +204,11 @@ class DeviceAggregationRuntime(AggregationRuntime):
                     elif fn in ("min", "max") and not np.isfinite(
                             vals[s, b]):
                         row.append(None)       # untouched identity
+                    elif comp is not None and fn in ("sum", "sumsq"):
+                        # compensated lanes: the f64 hi+err sum is the
+                        # true total past the f32 2^24 cliff
+                        row.append(float(np.float64(vals[s, b]) +
+                                         np.float64(comp[s, b])))
                     else:
                         row.append(float(vals[s, b]))
                 store[(b_ts, key)] = row
@@ -181,9 +221,12 @@ class DeviceAggregationRuntime(AggregationRuntime):
         for dur in self.durations:
             slab = _Slab(self.base_fns,
                          cap=max(2048, 1 << (len(self.buckets[dur]) or 1)
-                                 .bit_length()))
+                                 .bit_length()),
+                         compensated=self._compensated)
             vals = np.array(slab.vals)      # mutable host copies
             cnt = np.array(slab.cnt)
+            comp = (np.array(slab.comp)
+                    if slab.comp is not None else None)
             for (b_ts, key), row in self.buckets[dur].items():
                 slot = len(slab.pair_of)
                 slab.slot_of[(b_ts, key)] = slot
@@ -194,8 +237,17 @@ class DeviceAggregationRuntime(AggregationRuntime):
                         cnt[slot] = int(v or 0)
                     elif v is not None:
                         vals[slot, b] = np.float32(v)
+                        if comp is not None and fn in ("sum", "sumsq"):
+                            # bank the f32 rounding residual so a
+                            # restore round-trip keeps compensated
+                            # precision
+                            comp[slot, b] = np.float32(
+                                np.float64(v) -
+                                np.float64(vals[slot, b]))
             slab.vals = jnp.asarray(vals)
             slab.cnt = jnp.asarray(cnt)
+            if comp is not None:
+                slab.comp = jnp.asarray(comp)
             self._slabs[dur] = slab
         self._dirty = False
 

@@ -156,6 +156,11 @@ class CompiledWindowedAgg:
                 jax.devices()[0].platform == "tpu" and \
                 n_partitions % LANES == 0
         self.use_pallas = use_pallas
+        # numeric sentinels (core/numguard.py, SIDDHI_TPU_NUMGUARD):
+        # host-rim witnesses over arrays the retire path already fetches
+        from ..core.numguard import numeric_sentinels, numguard_enabled
+        self.sentinels = numeric_sentinels(app.name or "?") \
+            if numguard_enabled() else None
         self._build_step()
         self.carry = self._make_carry(n_partitions)
 
@@ -341,7 +346,8 @@ class CompiledWindowedAgg:
         base_before = self._ts_base
         offs, self._ts_base, new_ring = rebase_offsets(
             ts_abs.reshape(-1), valid.reshape(-1), self._ts_base,
-            self.window_ms, self.carry.ring_ts, TS_EMPTY)
+            self.window_ms, self.carry.ring_ts, TS_EMPTY,
+            sentinels=self.sentinels, site="wagg.ts32")
         if new_ring is not self.carry.ring_ts:
             # the ring only shifts when a prior base moved by delta
             delta = self._ts_base - (base_before or 0)
@@ -367,6 +373,11 @@ class CompiledWindowedAgg:
             c = np.asarray(self.carry.cnt)
             ring = None               # D2H of the [P, W] ring only if a
             valid = None              # min/max output actually needs it
+        if self.sentinels is not None:
+            # NUMGUARD witness over the arrays fetched above — reads
+            # only, so outputs stay bit-identical with the guard off
+            self.sentinels.observe_floats("wagg.retire", s)
+            self.sentinels.observe_counts("wagg.retire", c)
         out = {}
         for name, kind, _attr in self.outputs:
             if kind == "sum":

@@ -305,12 +305,23 @@ class SiddhiService:
                 plan = getattr(rt.analysis, "plan", None)
                 if plan is not None:
                     doc["plan"] = plan.as_dict()
+                # numeric-safety report: NS0xx value-range verdicts
+                # grounded on the compiled plan (analysis/ranges)
+                numeric = getattr(rt.analysis, "numeric", None)
+                if numeric is not None:
+                    doc["numeric"] = numeric.as_dict()
             # persistent-state schema report: which declarations govern
             # each snapshot element, and the app-level layout digest an
             # operator can diff across deploys (analysis/state_schema)
             schema = getattr(rt, "state_schema", None)
             if schema is not None:
                 doc["state_schema"] = schema.as_dict()
+            # live numeric sentinels (SIDDHI_TPU_NUMGUARD): overflow /
+            # non-finite trip counters the static verdicts predicted
+            from ..core.numguard import numeric_sentinels
+            guard = numeric_sentinels(name, create=False)
+            if guard is not None:
+                doc["numguard"] = guard.snapshot()
             doc["ledger"] = ledger().snapshot(app=name)
             apps[name] = doc
         # process-global surfaces, mirrored from rt.statistics so the

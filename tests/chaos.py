@@ -24,6 +24,9 @@ Pieces:
   * ``VirtualClock`` — manual monotonic clock for CircuitBreaker tests.
   * ``burst_feed`` / ``poison_feed`` / ``backwards_feed`` — seeded event
     generators for the overload/quarantine suite (tests/test_overload.py).
+  * ``wraparound_feed`` — seeded stream-years feed crossing the ts32
+    int32-ms horizon (device rebase under NUMGUARD,
+    tests/test_numguard.py).
   * ``GatedReceiver`` — a junction subscriber whose delivery can be
     wedged (blocked on an Event) to exert real backpressure on @Async
     workers, then released.
@@ -337,6 +340,27 @@ def backwards_feed(n_events: int, seed: int = 0,
         bad = i and i % every == 0
         out.append((["ABC", float(i), i],
                     ts - jump_back_ms if bad else ts))
+    return out
+
+
+def wraparound_feed(n_events: int, seed: int = 0,
+                    start_ts: int = 1_000_000,
+                    span_ms: int = 40 * 86_400_000,
+                    symbols=("A", "B", "C")):
+    """Seeded stream-years feed for the ts32 horizon (NS004 / ROADMAP
+    item 5's scenario factory): ``n_events`` rows spread evenly across
+    ``span_ms`` of stream time (default 40 days — past the ~24.8-day
+    int32-ms horizon, forcing at least one device rebase) with seeded
+    jitter.  Timestamps stay strictly increasing so window semantics
+    are unambiguous for the host oracle.  Returns
+    ``([symbol, price, volume], ts)`` tuples."""
+    rng = random.Random(seed)
+    stride = max(span_ms // max(n_events, 1), 2)
+    out = []
+    ts = start_ts
+    for i in range(n_events):
+        ts += stride + rng.randrange(0, max(stride // 2, 1))
+        out.append(([rng.choice(symbols), float(i % 97), i % 89], ts))
     return out
 
 

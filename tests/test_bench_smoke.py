@@ -120,6 +120,16 @@ def test_bench_smoke_runs_clean():
     assert csm["signatures"]
     assert any(s.startswith("filter.program[") for s in csm["signatures"])
     assert csm["parity_digest"]
+    # numeric safety (round 18): the static verifier fired on the
+    # constructed overflow app, samples/ are NS-clean, the armed
+    # NUMGUARD run tripped the device sentinel plane at bit-identical
+    # outputs, and the sentinel ingest overhead stays bounded (the < 5%
+    # / 50 ms noise-floor bound is asserted inside the smoke itself)
+    nsm = out["numeric_smoke"]
+    assert "NS005" in nsm["static_codes"]
+    assert nsm["sample_findings_total"] == 0
+    assert nsm["sentinel_trips"] > 0
+    assert nsm["overhead_pct"] >= 0.0
 
 
 def test_fail_on_p99_gate():
@@ -163,6 +173,26 @@ def test_fail_on_imbalance_gate():
     row4 = next(r for r in sc["shardscale"] if r["shards"] == 4)
     assert len(row4["shard_keys"]) == 4
     assert sum(row4["shard_keys"]) == 1024
+
+
+def test_fail_on_numeric_gate():
+    """--fail-on-numeric: jax-free samples/ NS sweep — the shipped
+    samples are clean (0 warnings), so limit 0 passes rc 0 and the
+    only way to force the failure arm without dirtying samples/ is an
+    impossible limit of -1."""
+    env = {"JAX_PLATFORMS": "cpu"}
+    res = _run(["--fail-on-numeric", "-1"], env_extra=env, timeout=120)
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "[bench] FAIL" in res.stderr
+    assert "--fail-on-numeric" in res.stderr
+    # the sweep still printed its JSON before the gate tripped
+    ns = json.loads(res.stdout.strip().splitlines()[-1])
+    assert ns["unit"] == "warnings" and ns["value"] == 0
+
+    res = _run(["--fail-on-numeric", "0"], env_extra=env, timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    ns = json.loads(res.stdout.strip().splitlines()[-1])
+    assert ns["value"] == 0 and ns["per_file"] == {}
 
 
 def test_bench_skips_on_unreachable_backend():

@@ -1,0 +1,546 @@
+"""Numeric-safety verifier (analysis/ranges.py): interval-lattice
+property tests, @attr:range / @app:rate seeding (SA09x), every NS0xx
+static verdict positive AND negative, provenance triage, the jax-free
+`analyze --numeric` CLI, and the plan-grounded runtime attach.
+
+The lattice tests are randomized-but-seeded brute-force enumerations:
+every abstract op is checked sound (the result hull covers every
+concrete pairing) over small integer domains, and widening is checked
+to terminate in <= 2 steps (the jump-to-bounds contract the module
+docstring promises)."""
+import json
+import math
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from siddhi_tpu.analysis.diagnostics import CATALOG, Severity  # noqa: E402
+from siddhi_tpu.analysis.ranges import (Interval,  # noqa: E402
+                                        analyze_numeric, ts32_safe_max)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -------------------------------------------------- lattice: soundness
+
+def _rand_interval(rng, span=6):
+    a = rng.randint(-span, span)
+    b = rng.randint(-span, span)
+    return Interval(min(a, b), max(a, b), declared=True)
+
+
+def _points(iv):
+    return range(int(iv.lo), int(iv.hi) + 1)
+
+
+def test_lattice_binary_ops_sound_and_exact_vs_enumeration():
+    """add/sub/mul hulls equal the exact min/max over every concrete
+    pair; join covers both operands."""
+    rng = random.Random(42)
+    for _ in range(300):
+        x, y = _rand_interval(rng), _rand_interval(rng)
+        for name, op, conc in (
+                ("add", x.add(y), lambda a, b: a + b),
+                ("sub", x.sub(y), lambda a, b: a - b),
+                ("mul", x.mul(y), lambda a, b: a * b)):
+            vals = [conc(a, b) for a in _points(x) for b in _points(y)]
+            assert op.lo == min(vals) and op.hi == max(vals), \
+                f"{name}({x}, {y}) -> {op} vs exact " \
+                f"[{min(vals)}, {max(vals)}]"
+        j = x.join(y)
+        for v in list(_points(x)) + list(_points(y)):
+            assert j.contains(v)
+
+
+def test_lattice_unary_ops_exact_vs_enumeration():
+    rng = random.Random(7)
+    for _ in range(200):
+        x = _rand_interval(rng)
+        for op, conc in ((x.neg(), lambda a: -a),
+                         (x.abs_(), abs)):
+            vals = [conc(a) for a in _points(x)]
+            assert op.lo == min(vals) and op.hi == max(vals)
+
+
+def test_lattice_div_sound_when_divisor_excludes_zero():
+    rng = random.Random(13)
+    for _ in range(200):
+        x = _rand_interval(rng)
+        d = _rand_interval(rng)
+        if d.contains_zero:
+            # zero-crossing divisor is the NS002 path: div degrades to
+            # top rather than raising
+            t = x.div(d)
+            assert t.lo == -math.inf and t.hi == math.inf
+            continue
+        q = x.div(d)
+        for a in _points(x):
+            for b in _points(d):
+                assert q.lo <= a / b <= q.hi, (x, d, q, a, b)
+
+
+def test_lattice_mod_sound():
+    rng = random.Random(99)
+    for _ in range(200):
+        x = _rand_interval(rng)
+        d = _rand_interval(rng)
+        m = x.mod(d)
+        for a in _points(x):
+            for b in _points(d):
+                if b == 0:
+                    continue
+                assert m.contains(math.fmod(a, b)), (x, d, m, a, b)
+
+
+def test_lattice_scale_covers_window_accumulation():
+    rng = random.Random(5)
+    for _ in range(200):
+        x = _rand_interval(rng)
+        n = rng.randint(0, 50)
+        s = x.scale(n)
+        # a sum of n terms each within x lands within n*x (plus the
+        # empty-accumulator 0 the engine's identity rows hold)
+        for _ in range(20):
+            total = sum(rng.randint(int(x.lo), int(x.hi))
+                        for _ in range(n))
+            assert s.contains(total), (x, n, s, total)
+        assert s.contains(0)
+
+
+def test_widening_terminates_in_two_steps():
+    """Jump-to-bounds widening: iterating widen over ANY ascending
+    chain reaches a fixpoint in at most 2 applications."""
+    rng = random.Random(21)
+    bounds = Interval(-(1 << 31), (1 << 31) - 1)
+    for _ in range(300):
+        cur = _rand_interval(rng)
+        steps = 0
+        while True:
+            grow = cur.join(_rand_interval(rng, span=40))
+            nxt = cur.widen(grow, bounds)
+            if nxt == cur:
+                break
+            cur = nxt
+            steps += 1
+            assert steps <= 2, f"widening chain did not stabilise: {cur}"
+        assert bounds.lo <= cur.lo <= cur.hi <= bounds.hi
+
+
+def test_interval_invariants_and_provenance():
+    with pytest.raises(ValueError):
+        Interval(3, 1)
+    a = Interval(0, 5, declared=True)
+    b = Interval(1, 2, declared=False)
+    assert not a.add(b).declared        # provenance is AND over leaves
+    assert a.add(Interval(1, 2, declared=True)).declared
+    assert Interval.top().contains(1e300)
+    assert a.as_list() == [0, 5]
+    assert Interval.top().as_list() == [None, None]   # JSON-safe inf
+
+
+def test_ts32_safe_max_mirrors_device_kernel():
+    """ranges.py is jax-free so it MIRRORS ops/ts32.safe_max; the two
+    formulas must never drift."""
+    from siddhi_tpu.ops.ts32 import safe_max
+    for slack in (0, 1, 1000, 86_400_000, (1 << 30)):
+        assert ts32_safe_max(slack) == safe_max(slack), slack
+
+
+# ------------------------------------------- @attr:range seeding (SA09x)
+
+def _codes(app, engine=None):
+    rep = analyze_numeric(app, engine)
+    return rep, {d.code for d in rep.findings}
+
+
+def test_sa090_malformed_range_annotation():
+    rep, codes = _codes("""
+        @attr:range('no_such_attr', 0, 1)
+        define stream S (v int);
+        from S select v as v insert into Out;
+    """)
+    assert "SA090" in codes
+    d = next(d for d in rep.findings if d.code == "SA090")
+    assert d.severity == Severity.ERROR
+    assert d.line >= 1                      # position threaded through
+
+
+def test_sa090_non_numeric_bounds():
+    _, codes = _codes("""
+        @attr:range('v', 'abc', 10)
+        define stream S (v int);
+        from S select v as v insert into Out;
+    """)
+    assert "SA090" in codes
+
+
+def test_sa091_inverted_bounds():
+    rep, codes = _codes("""
+        @attr:range('v', 10, -10)
+        define stream S (v int);
+        from S select v as v insert into Out;
+    """)
+    assert "SA091" in codes
+    assert next(d for d in rep.findings
+                if d.code == "SA091").severity == Severity.ERROR
+
+
+def test_sa092_bounds_wider_than_dtype():
+    _, codes = _codes("""
+        @attr:range('w', 0, 99999999999)
+        define stream S (w int);
+        from S select w as w insert into Out;
+    """)
+    assert "SA092" in codes
+
+
+def test_well_formed_declarations_are_silent():
+    rep, codes = _codes("""
+        @attr:range('v', -500, 500)
+        define stream S (v int);
+        from S select v as v insert into Out;
+    """)
+    assert not codes & {"SA090", "SA091", "SA092"}
+    assert rep.ok
+    assert rep.declared_ranges.get("S.v") == [-500, 500]
+
+
+# --------------------------------------------------- NS verdicts pos/neg
+
+def test_ns001_int_overflow_positive():
+    rep, codes = _codes("""
+        @attr:range('a', 0, 2000000000)
+        define stream S (a int);
+        from S select a + a as b insert into Out;
+    """)
+    assert "NS001" in codes
+    d = next(d for d in rep.findings if d.code == "NS001")
+    assert d.severity == Severity.WARNING   # declared range arms it
+
+
+def test_ns001_negative_bounded_arithmetic():
+    _, codes = _codes("""
+        @attr:range('a', 0, 1000)
+        define stream S (a int);
+        from S select a + a as b insert into Out;
+    """)
+    assert "NS001" not in codes
+
+
+def test_ns002_division_by_zero_crossing_divisor():
+    rep, codes = _codes("""
+        @attr:range('d', -5, 5)
+        define stream S (v double, d double);
+        from S select v / d as q insert into Out;
+    """)
+    assert "NS002" in codes
+    assert next(d for d in rep.findings
+                if d.code == "NS002").severity == Severity.WARNING
+
+
+def test_ns002_negative_divisor_excludes_zero():
+    _, codes = _codes("""
+        @attr:range('d', 1, 5)
+        define stream S (v double, d double);
+        from S select v / d as q insert into Out;
+    """)
+    assert "NS002" not in codes
+
+
+def test_ns003_naive_slab_past_precision_budget():
+    app = """
+        @app:rate(10000)
+        @attr:range('price', 0, 100000)
+        define stream S (price double, symbol string);
+        define aggregation agg
+        from S
+        select symbol, sum(price) as total
+        group by symbol
+        aggregate every sec ... day;
+    """
+    rep, codes = _codes(app)
+    assert "NS003" in codes
+    assert next(d for d in rep.findings
+                if d.code == "NS003").severity == Severity.WARNING
+
+
+@pytest.mark.parametrize("mode", ["compensated", "kahan"])
+def test_ns003_negative_compensated_remediation(mode):
+    """@numeric(sum='compensated') is the documented per-query
+    remediation — it must clear the verdict."""
+    _, codes = _codes(f"""
+        @app:rate(10000)
+        @attr:range('price', 0, 100000)
+        define stream S (price double, symbol string);
+        @numeric(sum='{mode}')
+        define aggregation agg
+        from S
+        select symbol, sum(price) as total
+        group by symbol
+        aggregate every sec ... day;
+    """)
+    assert "NS003" not in codes
+
+
+def test_ns003_negative_host_engine():
+    """The host cascade accumulates arbitrary-precision — no finding."""
+    _, codes = _codes("""
+        @app:rate(10000)
+        @attr:range('price', 0, 100000)
+        define stream S (price double, symbol string);
+        define aggregation agg
+        from S
+        select symbol, sum(price) as total
+        group by symbol
+        aggregate every sec ... day;
+    """, engine="host")
+    assert "NS003" not in codes
+
+
+def test_ns004_within_past_ts32_horizon():
+    rep, codes = _codes("""
+        define stream A (x int); define stream B (x int);
+        from every e1=A -> e2=B within 1728000000 millisec
+        select e1.x as x insert into Out;
+    """)
+    assert "NS004" in codes
+    d = next(d for d in rep.findings if d.code == "NS004")
+    assert d.severity == Severity.WARNING
+
+
+def test_ns004_negative_short_within():
+    _, codes = _codes("""
+        define stream A (x int); define stream B (x int);
+        from every e1=A -> e2=B within 10 sec
+        select e1.x as x insert into Out;
+    """)
+    assert "NS004" not in codes
+
+
+def test_ns004_time_window_span():
+    _, codes = _codes("""
+        define stream S (v double);
+        from S#window.time(30 days) select v as v insert into Out;
+    """)
+    assert "NS004" in codes
+
+
+def test_ns005_count_lane_saturation():
+    rep, codes = _codes("""
+        @app:rate(1000000)
+        define stream S (v double);
+        from S#window.time(5000 sec) select count() as n insert into Out;
+    """)
+    assert "NS005" in codes
+    assert next(d for d in rep.findings
+                if d.code == "NS005").severity == Severity.WARNING
+
+
+def test_ns005_negative_bounded_window():
+    _, codes = _codes("""
+        @app:rate(100)
+        define stream S (v double);
+        from S#window.time(10 sec) select count() as n insert into Out;
+    """)
+    assert "NS005" not in codes
+
+
+def test_ns006_lossy_egress_demotion():
+    rep, codes = _codes("""
+        @app:engine('tpu')
+        @attr:range('v', 0, 100000000)
+        define stream S (v long);
+        from S select v as v insert into Out;
+    """)
+    assert "NS006" in codes
+    assert next(d for d in rep.findings
+                if d.code == "NS006").severity == Severity.WARNING
+
+
+def test_ns006_negative_on_host_engine_and_small_range():
+    _, codes = _codes("""
+        @app:engine('host')
+        @attr:range('v', 0, 100000000)
+        define stream S (v long);
+        from S select v as v insert into Out;
+    """)
+    assert "NS006" not in codes
+    _, codes = _codes("""
+        @app:engine('tpu')
+        @attr:range('v', 0, 1000)
+        define stream S (v long);
+        from S select v as v insert into Out;
+    """)
+    assert "NS006" not in codes
+
+
+def test_catalog_has_every_ns_code():
+    for code in ("NS001", "NS002", "NS003", "NS004", "NS005", "NS006",
+                 "NS101", "SA090", "SA091", "SA092"):
+        assert code in CATALOG, code
+
+
+# -------------------------------------------------- provenance triage
+
+def test_undeclared_bounds_downgrade_to_info():
+    """The same escape without @attr:range rests only on conservative
+    dtype bounds: INFO, and the report stays gate-clean (ok)."""
+    rep = analyze_numeric("""
+        define stream S (a int);
+        from S select a + a as b insert into Out;
+    """)
+    infos = [d for d in rep.findings if d.code == "NS001"]
+    assert infos and all(d.severity == Severity.INFO for d in infos)
+    assert rep.ok
+    assert "conservative dtype bounds" in infos[0].message
+
+
+def test_report_surfaces():
+    rep = analyze_numeric("""
+        @app:rate(1000000)
+        define stream S (v double);
+        from S#window.time(5000 sec) select count() as n insert into Out;
+    """)
+    doc = rep.as_dict()
+    assert doc["source"] == "static"
+    assert doc["rate_eps"] == 1000000
+    assert doc["rate_declared"] is True
+    assert any(f["code"] == "NS005" for f in doc["findings"])
+    json.dumps(doc)                        # REST-safe (no inf/dataclass)
+    text = rep.dump()
+    assert "NS005" in text
+    assert not rep.ok
+    assert rep.counts().get("NS005", 0) >= 1
+
+
+# ----------------------------------------------------- jax-free CLI
+
+def _cli(tmp_path, text, *flags):
+    f = tmp_path / "app.siddhi"
+    f.write_text(text)
+    return subprocess.run(
+        [sys.executable, "-m", "siddhi_tpu.analyze", str(f), "--numeric",
+         *flags],
+        capture_output=True, text=True, cwd=ROOT, timeout=120)
+
+
+DIRTY = """
+@app:rate(1000000)
+define stream S (v double);
+from S#window.time(5000 sec) select count() as n insert into Out;
+"""
+
+CLEAN = """
+@attr:range('v', 0, 100)
+define stream S (v double);
+from S#window.length(10) select v as v insert into Out;
+"""
+
+
+def test_cli_numeric_exit_codes(tmp_path):
+    res = _cli(tmp_path, DIRTY)
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "NS005" in res.stdout
+    res = _cli(tmp_path, CLEAN)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_cli_numeric_json_and_jax_free(tmp_path):
+    f = tmp_path / "app.siddhi"
+    f.write_text(DIRTY)
+    probe = (
+        "import sys, runpy\n"
+        f"sys.argv = ['analyze', {str(f)!r}, '--numeric', '--json']\n"
+        "try:\n"
+        "    runpy.run_module('siddhi_tpu.analyze', run_name='__main__')\n"
+        "except SystemExit as e:\n"
+        "    assert e.code == 1, e.code\n"
+        "assert 'jax' not in sys.modules, 'the --numeric path must stay "
+        "jax-free'\n")
+    res = subprocess.run([sys.executable, "-c", probe],
+                         capture_output=True, text=True, cwd=ROOT,
+                         timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    doc = json.loads(res.stdout)
+    assert any(fi["code"] == "NS005" for fi in doc["findings"])
+
+
+# ------------------------------------------- plan-grounded runtime half
+
+def test_runtime_attach_produces_plan_report():
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.analysis.ranges import attach_numeric_analysis
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:rate(1000000)
+        define stream S (sym string, v double);
+        @info(name='q') from S#window.time(5000 sec)
+        select sym, count() as n group by sym insert into Out;
+    """)
+    try:
+        rep = rt.analysis.numeric
+        assert rep is not None and rep.source == "plan"
+        assert rt.numeric_report is rep
+        assert any(d.code == "NS005" for d in rep.findings)
+        # NS findings were merged into the app-level diagnostics exactly
+        # once (no dup between the source pass and the plan re-ground)
+        ns_keys = [(d.code, d.message) for d in rt.analysis.diagnostics
+                   if d.code.startswith("NS")]
+        assert len(ns_keys) == len(set(ns_keys))
+        # re-attach is idempotent
+        before = [(d.code, d.message) for d in rt.analysis.diagnostics]
+        attach_numeric_analysis(rt)
+        after = [(d.code, d.message) for d in rt.analysis.diagnostics]
+        assert before == after
+    finally:
+        rt.shutdown()
+
+
+def test_runtime_attach_strict_raises():
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.analysis.ranges import attach_numeric_analysis
+    from siddhi_tpu.utils.errors import SiddhiAppValidationException
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:rate(1000000)
+        define stream S (sym string, v double);
+        @info(name='q') from S#window.time(5000 sec)
+        select sym, count() as n group by sym insert into Out;
+    """)
+    try:
+        with pytest.raises(SiddhiAppValidationException):
+            attach_numeric_analysis(rt, strict=True)
+    finally:
+        rt.shutdown()
+
+
+def test_stats_endpoint_carries_numeric_section():
+    import urllib.request
+    from siddhi_tpu.service.rest import SiddhiService
+    svc = SiddhiService(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{svc.port}"
+        app = ("@app:name('nstat') "
+               "@app:statistics(reporter='console', interval='300') "
+               "@app:rate(1000000) "
+               "define stream S (sym string, v double); "
+               "@info(name='q') from S#window.time(5000 sec) "
+               "select sym, count() as n group by sym insert into Out;")
+        req = urllib.request.Request(
+            f"{base}/siddhi/artifact/deploy", data=app.encode(),
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30):
+            pass
+        with urllib.request.urlopen(f"{base}/stats", timeout=30) as r:
+            doc = json.loads(r.read().decode())
+        num = doc["apps"]["nstat"].get("numeric")
+        assert num, f"/stats has no numeric section: {doc['apps']}"
+        assert num["source"] == "plan"
+        assert any(f["code"] == "NS005" for f in num["findings"])
+    finally:
+        svc.stop()

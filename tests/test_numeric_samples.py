@@ -1,0 +1,40 @@
+"""Every SiddhiQL app shipped in samples/ must be clean under the
+numeric-safety verifier at WARNING level (analysis/ranges.py) — the
+NS-family twin of tests/test_samples_analysis.py.  A new sample that
+trips an NS warning either declares its ranges/rates (or the
+compensated-sum remediation), or earns an allowlist entry below with a
+justification.  INFO-level findings (conservative-dtype provenance) are
+the verifier's declared noise floor and stay out of this gate."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from siddhi_tpu.analysis.ranges import sample_numeric_counts  # noqa: E402
+
+SAMPLES_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "samples")
+
+# sample file -> NS codes it is ALLOWED to emit at warning level, each
+# with a written justification (none today: the showcase apps are
+# numerically clean — golden-pinned)
+EXPECTED_NS = {}
+
+
+def test_samples_are_numerically_clean():
+    counts = sample_numeric_counts(SAMPLES_DIR)
+    assert counts, "no samples analyzed"
+    offenders = {}
+    for fname, by_code in sorted(counts.items()):
+        unexpected = set(by_code) - EXPECTED_NS.get(fname, set())
+        if unexpected:
+            offenders[fname] = {c: by_code[c] for c in sorted(unexpected)}
+    assert not offenders, (
+        "samples emit NS warnings not in the allowlist (declare "
+        f"@attr:range/@app:rate or justify an entry): {offenders}")
+
+
+def test_sample_counts_cover_every_sample_file():
+    files = {f for f in os.listdir(SAMPLES_DIR) if f.endswith(".py")}
+    counts = sample_numeric_counts(SAMPLES_DIR)
+    assert set(counts) == files

@@ -1,0 +1,503 @@
+"""Runtime numeric sentinels (core/numguard.py) — the live half of the
+NS0xx verifier.
+
+Covers: the off-by-default env contract, the device sentinel plane
+(ops/grouped_agg.sentinel_plane), bit-identical match outputs with
+NUMGUARD on vs off, NS101 flight-bus incidents (positive, negative and
+the per-site rate limit), the static-NS003 verdict cross-validated by
+an armed sentinel run on a constructed overflow feed (with the
+@numeric(sum='compensated') remediation proven at host parity), the
+static-NS005 count-saturation verdict witnessed through the slab sync
+path, the stream-years ts32 wraparound feed (device == host oracle
+across the rebase with the guard armed), and the Prometheus /
+GET /stats surfaces."""
+import json
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from siddhi_tpu import SiddhiManager, StreamCallback  # noqa: E402
+from siddhi_tpu.core import numguard  # noqa: E402
+from siddhi_tpu.core.flight import flight  # noqa: E402
+from siddhi_tpu.core.numguard import (NUMGUARD_ENV,  # noqa: E402
+                                      NumericSentinels,
+                                      all_numeric_sentinels,
+                                      numeric_sentinels, numguard_enabled,
+                                      reset_numguard)
+
+from chaos import wraparound_feed  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _numguard_isolation(monkeypatch):
+    """Disarmed and empty registry around every test; the flight bus is
+    drained so NS101 assertions see only their own incidents."""
+    monkeypatch.delenv(NUMGUARD_ENV, raising=False)
+    reset_numguard()
+    flight().reset()
+    yield
+    reset_numguard()
+    flight().reset()
+
+
+# ---------------------------------------------------------- off switch
+
+def test_numguard_disabled_by_default():
+    assert numguard_enabled() is False
+
+
+@pytest.mark.parametrize("val,armed", [
+    ("1", True), ("true", True), ("on", True), ("yes", True),
+    ("0", False), ("off", False), ("", False), ("no", False)])
+def test_numguard_env_values(monkeypatch, val, armed):
+    monkeypatch.setenv(NUMGUARD_ENV, val)
+    assert numguard_enabled() is armed
+
+
+def test_engine_holds_no_sentinels_when_disarmed():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+        @app:name('plainrun') @app:playback
+        define stream S (sym string, price float, volume long);
+        @info(name='q') from S#window.length(4)
+        select sym, sum(price) as t group by sym insert into Out;
+    """)
+    rt.start()
+    rt.get_input_handler("S").send(["A", 1.0, 1], timestamp=1_000_000)
+    rt.shutdown()
+    assert numeric_sentinels("plainrun", create=False) is None
+    assert all_numeric_sentinels() == []
+
+
+# ------------------------------------------------------ sentinel plane
+
+def test_sentinel_plane_counts_flags():
+    import jax.numpy as jnp
+    from siddhi_tpu.ops.grouped_agg import sentinel_plane
+    near = int(0.95 * (1 << 31))
+    hi, lo = near // 65536, near % 65536
+    fsum_hi = jnp.asarray([[1.0, jnp.inf], [jnp.nan, 2.0]], jnp.float32)
+    isum_hi = jnp.asarray([[hi, 0], [0, 0]], jnp.int32)
+    isum_lo = jnp.asarray([[lo, 0], [0, 0]], jnp.int32)
+    gcnt = jnp.asarray([[2_000_000_000, 3], [1, 0]], jnp.int32)
+    plane = np.asarray(sentinel_plane(fsum_hi, isum_hi, isum_lo, gcnt))
+    assert plane.tolist() == [1, 1, 2]     # near-int, near-cnt, nonfinite
+
+
+def test_sentinel_plane_all_clear():
+    import jax.numpy as jnp
+    from siddhi_tpu.ops.grouped_agg import sentinel_plane
+    z = jnp.zeros((3, 4), jnp.int32)
+    f = jnp.ones((3, 4), jnp.float32)
+    plane = np.asarray(sentinel_plane(f, z, z, z))
+    assert plane.tolist() == [0, 0, 0]
+
+
+# --------------------------------------------------- sentinel counters
+
+def test_observe_hooks_and_snapshot():
+    s = NumericSentinels("t")
+    assert s.observe_floats("a", np.asarray([1.0, np.inf, np.nan])) == 2
+    assert s.observe_floats("a", np.asarray([1.0, 2.0])) == 0
+    assert s.observe_ints("b", np.asarray([2_000_000_000, 5])) == 1
+    assert s.observe_counts("c", np.asarray([2_100_000_000])) == 1
+    assert s.observe_counts("c", np.asarray([10, 20])) == 0
+    assert s.observe_precision("d", np.asarray([3.4e7, 1.0])) == 1
+    assert s.observe_precision("d", np.asarray([100.0])) == 0
+    s.note_rebase("e", 12345)
+    snap = s.snapshot()
+    assert snap["trips"]["a:nonfinite"] == 2
+    assert snap["trips"]["b:int_near_overflow"] == 1
+    assert snap["trips"]["c:count_near_saturation"] == 1
+    assert snap["trips"]["d:precision_exceeded"] == 1
+    assert snap["trips_total"] == 5
+    assert snap["ts_rebase_total"] == 1
+    assert snap["ts_headroom_ms"] == 12345
+    lines = s.prometheus_lines()
+    assert any(ln.startswith("siddhi_numeric_sentinel_trips_total")
+               for ln in lines)
+    assert any(ln.startswith("siddhi_numeric_precision_exceeded_total")
+               for ln in lines)
+    assert any(ln.startswith("siddhi_numeric_ts_rebase_total")
+               for ln in lines)
+    s.reset()
+    assert s.snapshot()["trips_total"] == 0
+
+
+def test_observe_sentinel_plane_folds_device_flags():
+    s = NumericSentinels("t")
+    assert s.observe_sentinel_plane("g", np.asarray([2, 1, 3])) == 6
+    snap = s.snapshot()
+    assert snap["trips"]["g:int_near_overflow"] == 2
+    assert snap["trips"]["g:count_near_saturation"] == 1
+    assert snap["trips"]["g:nonfinite"] == 3
+    assert s.observe_sentinel_plane("g", np.asarray([0, 0, 0])) == 0
+
+
+# --------------------------------------------------- NS101 flight bus
+
+def test_ns101_incident_emitted_and_rate_limited():
+    s = NumericSentinels("nsapp")
+    for _ in range(6):                     # > MAX_INCIDENTS_PER_SITE
+        s.observe_floats("site.x", np.asarray([np.nan]))
+    incs = [i for i in flight().incidents()
+            if i["kind"] == "numeric_sentinel"]
+    assert len(incs) == numguard.MAX_INCIDENTS_PER_SITE
+    bundle = flight().bundle(incs[-1]["id"])
+    det = bundle["detail"]
+    assert det["code"] == "NS101"
+    assert det["site"] == "site.x" and det["kind"] == "nonfinite"
+    # trips keep counting past the incident cap
+    assert s.snapshot()["trips"]["site.x:nonfinite"] == 6
+
+
+def test_no_ns101_below_thresholds():
+    s = NumericSentinels("quiet")
+    s.observe_floats("a", np.asarray([1.0, 2.0]))
+    s.observe_ints("a", np.asarray([100, -100]))
+    s.observe_counts("a", np.asarray([1000]))
+    s.observe_precision("a", np.asarray([100.0]))
+    assert [i for i in flight().incidents()
+            if i["kind"] == "numeric_sentinel"] == []
+    assert s.snapshot()["trips_total"] == 0
+
+
+# ------------------------------------- bit-identical outputs, on vs off
+
+GAGG_APP = """
+    @app:name('gbit') @app:playback
+    define stream S (sym string, price float, volume long);
+    @info(name='q') from S#window.length(5)
+    select sym, sum(price) as t, sum(volume) as tv, count() as c
+    group by sym insert into Out;
+"""
+
+
+def _run_gagg(armed, app=GAGG_APP, engine=None, feed=None):
+    if armed:
+        os.environ[NUMGUARD_ENV] = "1"
+    else:
+        os.environ.pop(NUMGUARD_ENV, None)
+    try:
+        prefix = f"@app:engine('{engine}') " if engine else ""
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(prefix + app)
+        out = []
+        rt.add_callback("Out", StreamCallback(
+            lambda evs: out.extend(tuple(e.data) for e in evs)))
+        rt.start()
+        h = rt.get_input_handler("S")
+        rows = feed or _feed()
+        for row, ts in rows:
+            h.send(list(row), timestamp=ts)
+        device = any(q.backend == "device"
+                     for q in rt.query_runtimes.values())
+        rt.shutdown()
+        return device, out
+    finally:
+        os.environ.pop(NUMGUARD_ENV, None)
+
+
+def _feed(n=60, seed=4):
+    rng = np.random.default_rng(seed)
+    return [([f"s{rng.integers(0, 3)}",
+              float(np.float32(rng.uniform(1, 100))),
+              int(rng.integers(-1000, 1000))], 1_000_000 + i * 100)
+            for i in range(n)]
+
+
+def test_gagg_outputs_bit_identical_with_numguard_on():
+    dev_off, out_off = _run_gagg(False)
+    dev_on, out_on = _run_gagg(True)
+    assert dev_off and dev_on, "grouped agg did not hit the device path"
+    assert out_on == out_off        # bit-identical, not approx
+    assert len(out_on) > 0
+    # the armed run actually watched: registry holds the app's sentinels
+    assert numeric_sentinels("gbit", create=False) is not None
+
+
+def test_gagg_sentinel_plane_trips_on_overflow_feed():
+    """A constructed near-overflow int-sum feed (|sum| past 90% of the
+    2^31 exact-int ceiling) must trip the DEVICE sentinel plane while
+    outputs stay bit-identical with the guard off."""
+    feed = [(["A", 1.0, 1_000_000_000], 1_000_000 + i * 100)
+            for i in range(4)]             # running int sum -> 4e9 lane
+    app = """
+        @app:name('gov') @app:playback
+        define stream S (sym string, price float, volume long);
+        @info(name='q') from S
+        select sym, sum(volume) as tv group by sym insert into Out;
+    """
+    dev_off, out_off = _run_gagg(False, app=app, feed=feed)
+    dev_on, out_on = _run_gagg(True, app=app, feed=feed)
+    assert dev_on and dev_off
+    assert out_on == out_off
+    guard = numeric_sentinels("gov", create=False)
+    assert guard is not None
+    trips = guard.snapshot()["trips"]
+    assert trips.get("gagg.step:int_near_overflow", 0) > 0, trips
+    incs = [i for i in flight().incidents()
+            if i["kind"] == "numeric_sentinel"]
+    assert incs, "device sentinel trip emitted no NS101 incident"
+
+
+# ------------------------- NS003 cross-validation on an overflow feed
+
+NAIVE_AGG = """
+    @app:name('iaggns') @app:rate(1000)
+    @attr:range('price', 0, 40000000)
+    define stream S (symbol string, price double, ts long);
+    {anno}define aggregation Agg
+    from S
+    select symbol, sum(price) as total
+    group by symbol
+    aggregate by ts every sec ... min;
+"""
+
+AGG_Q = """
+    from Agg within 1496200000000, 1496400000000 per 'seconds'
+    select AGG_TIMESTAMP, symbol, total
+"""
+
+
+def _run_iagg(app, sends, armed):
+    if armed:
+        os.environ[NUMGUARD_ENV] = "1"
+    else:
+        os.environ.pop(NUMGUARD_ENV, None)
+    try:
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(app)
+        rt.start()
+        h = rt.get_input_handler("S")
+        for row in sends:
+            h.send(list(row))              # one chunk per event: the
+        rows = rt.query(AGG_Q)             # running slab takes each +1
+        agg = rt.aggregations["Agg"]
+        rt.shutdown()
+        return sorted([e.data for e in rows]), agg
+    finally:
+        os.environ.pop(NUMGUARD_ENV, None)
+
+
+def _overflow_sends(n_ones=200):
+    base_ts = 1496289950_000
+    sends = [["A", 33554432.0, base_ts]]   # 2^25: past the f32 budget
+    sends += [["A", 1.0, base_ts + 1 + i] for i in range(n_ones)]
+    return sends
+
+
+def test_static_ns003_cross_validated_by_armed_sentinel_run():
+    from siddhi_tpu.analysis.ranges import analyze_numeric
+    from siddhi_tpu.plan.iagg_compiler import DeviceAggregationRuntime
+    app = NAIVE_AGG.format(anno="")
+    # static half: the verifier predicts the precision escape
+    rep = analyze_numeric(app)
+    assert any(d.code == "NS003" for d in rep.findings)
+    # runtime half: the armed sentinel run witnesses it live
+    rows, agg = _run_iagg(app, _overflow_sends(), armed=True)
+    assert isinstance(agg, DeviceAggregationRuntime)
+    assert agg._compensated is False
+    guard = numeric_sentinels("iaggns", create=False)
+    assert guard is not None
+    trips = guard.snapshot()["trips"]
+    assert any(k.startswith("iagg.") and k.endswith("precision_exceeded")
+               for k in trips), trips
+    # and the naive f32 slab really did lose the +1s (the defect NS003
+    # warns about): every increment under the 2^25 spacing vanished
+    total = next(r[2] for r in rows if r[1] == "A")
+    assert total == 33554432.0
+
+
+def test_compensated_remediation_matches_host_oracle_exactly():
+    """@numeric(sum='compensated'): the TwoSum error lane carries the
+    sub-ulp increments, so the device slab equals the host cascade's
+    float64 total EXACTLY past the f32 cliff — and the armed run stays
+    precision-quiet (negative NS101/precision witness)."""
+    from siddhi_tpu.analysis.ranges import analyze_numeric
+    from siddhi_tpu.plan.iagg_compiler import DeviceAggregationRuntime
+    sends = _overflow_sends()
+    comp_app = NAIVE_AGG.format(anno="@numeric(sum='compensated')\n    ")
+    assert not any(d.code == "NS003"
+                   for d in analyze_numeric(comp_app).findings)
+    host_rows, _ = _run_iagg(
+        "@app:engine('host') " + NAIVE_AGG.format(anno=""), sends,
+        armed=False)
+    comp_rows, comp_agg = _run_iagg(comp_app, sends, armed=True)
+    assert isinstance(comp_agg, DeviceAggregationRuntime)
+    assert comp_agg._compensated is True
+    assert comp_rows == host_rows          # exact, past the f32 cliff
+    total = next(r[2] for r in comp_rows if r[1] == "A")
+    assert total == 33554432.0 + 200.0
+    guard = numeric_sentinels("iaggns", create=False)
+    trips = guard.snapshot()["trips"] if guard else {}
+    assert not any(k.endswith("precision_exceeded") for k in trips), trips
+
+
+def test_compensated_survives_persist_restore():
+    """The compensated residual is re-banked on restore, so a snapshot
+    round-trip keeps the exact total (persistent schema unchanged: the
+    host-format buckets dict is what persists)."""
+    from siddhi_tpu import InMemoryPersistenceStore
+    sends = _overflow_sends()
+    comp_app = NAIVE_AGG.format(anno="@numeric(sum='compensated')\n    ")
+    m = SiddhiManager()
+    m.set_persistence_store(InMemoryPersistenceStore())
+    rt = m.create_siddhi_app_runtime(comp_app)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for row in sends[:100]:
+        h.send(list(row))
+    rev = rt.persist()
+    rt.shutdown()
+    rt2 = m.create_siddhi_app_runtime(comp_app)
+    rt2.start()
+    rt2.restore_revision(rev)
+    h2 = rt2.get_input_handler("S")
+    for row in sends[100:]:
+        h2.send(list(row))
+    rows = sorted([e.data for e in rt2.query(AGG_Q)])
+    rt2.shutdown()
+    total = next(r[2] for r in rows if r[1] == "A")
+    assert total == 33554432.0 + 200.0
+
+
+# ------------------------- NS005 cross-validation through the slab sync
+
+def test_static_ns005_cross_validated_by_count_sentinel():
+    """Static NS005 predicts count-lane saturation; the armed witness
+    fires when a slab count lane actually nears 2^31 (reconstructed
+    through the engine's own restore path — feeding 2e9 events is not a
+    test, rewriting the persisted bucket payload is)."""
+    from siddhi_tpu.analysis.ranges import analyze_numeric
+    app = """
+        @app:name('cntns') @app:rate(1000000)
+        define stream S (symbol string, price double, ts long);
+        define aggregation Agg
+        from S
+        select symbol, sum(price) as total, count() as n
+        group by symbol
+        aggregate by ts every sec ... hour;
+    """
+    rep = analyze_numeric(app)
+    assert any(d.code == "NS005" for d in rep.findings)
+    os.environ[NUMGUARD_ENV] = "1"
+    try:
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(app)
+        rt.start()
+        rt.get_input_handler("S").send(["A", 5.0, 1496289950_000])
+        agg = rt.aggregations["Agg"]
+        agg._sync()
+        guard = numeric_sentinels("cntns", create=False)
+        assert guard is not None
+        assert guard.snapshot()["trips"] == {}     # negative: tiny count
+        # saturate the persisted count lane, rebuild, re-witness
+        for dur in agg.durations:
+            for key, row in agg.buckets[dur].items():
+                for b, fn in enumerate(agg.base_fns):
+                    if fn == "count":
+                        row[b] = 2_000_000_000
+        agg._rebuild_slabs()
+        agg._dirty = True
+        agg._sync()
+        trips = guard.snapshot()["trips"]
+        assert any(k.startswith("iagg.") and
+                   k.endswith("count_near_saturation")
+                   for k in trips), trips
+        rt.shutdown()
+    finally:
+        os.environ.pop(NUMGUARD_ENV, None)
+
+
+# ----------------------------------- ts32 wraparound (stream-years feed)
+
+WRAP_APP = """
+    @app:name('wrapns') @app:playback
+    define stream S (sym string, price float, volume long);
+    @info(name='q') from S#window.time(60 sec)
+    select sym, sum(price) as t, count() as c
+    group by sym insert into Out;
+"""
+
+
+def _norm(rows):
+    return [tuple(float(np.float32(v)) if isinstance(v, float) else v
+                  for v in r) for r in rows]
+
+
+def test_wraparound_device_matches_host_oracle_numguard_armed():
+    """Satellite 2: a seeded stream-years feed crosses the int32-ms
+    horizon (>= 1 device rebase); device == host oracle across the
+    wrap, the guard counts the rebases, and outputs stay bit-identical
+    armed vs disarmed."""
+    feed = wraparound_feed(300, seed=11)
+    _, host = _run_gagg(False, app=WRAP_APP, engine="host", feed=feed)
+    dev_hit, dev_off = _run_gagg(False, app=WRAP_APP, feed=feed)
+    reset_numguard()
+    dev_hit_on, dev_on = _run_gagg(True, app=WRAP_APP, feed=feed)
+    assert dev_hit and dev_hit_on, "wrap app did not hit the device path"
+    assert dev_on == dev_off               # guard is observation-only
+    assert _norm(host) == _norm(dev_on)
+    assert len(host) >= 300
+    guard = numeric_sentinels("wrapns", create=False)
+    assert guard is not None
+    snap = guard.snapshot()
+    assert snap["ts_rebase_total"] > 0, \
+        f"40-day feed never rebased the ts32 ring: {snap}"
+    assert snap["ts_headroom_ms"] is not None and \
+        snap["ts_headroom_ms"] > 0
+
+
+# ------------------------------------------------------------ surfaces
+
+def test_prometheus_exposition_carries_numeric_series():
+    from siddhi_tpu.core.statistics import prometheus_text
+    s = numeric_sentinels("promapp")
+    s.observe_floats("x", np.asarray([np.nan]))
+    s.note_rebase("x", 777)
+    text = prometheus_text([])
+    assert "# TYPE siddhi_numeric_sentinel_trips_total counter" in text
+    assert 'siddhi_numeric_nonfinite_total{app="promapp",site="x"} 1' \
+        in text
+    assert 'siddhi_numeric_ts_rebase_total{app="promapp"} 1' in text
+    assert 'siddhi_numeric_ts_headroom_ms{app="promapp"} 777' in text
+
+
+def test_stats_endpoint_carries_numguard_section(monkeypatch):
+    import urllib.request
+    from siddhi_tpu.service.rest import SiddhiService
+    monkeypatch.setenv(NUMGUARD_ENV, "1")
+    svc = SiddhiService(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{svc.port}"
+        app = ("@app:name('ngstat') "
+               "@app:statistics(reporter='console', interval='300') "
+               "define stream S (sym string, price float, volume long); "
+               "@info(name='q') from S#window.length(4) "
+               "select sym, sum(price) as t group by sym "
+               "insert into Out;")
+        req = urllib.request.Request(
+            f"{base}/siddhi/artifact/deploy", data=app.encode(),
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30):
+            pass
+        data = json.dumps([{"data": ["A", 2.5, 1]}]).encode()
+        req = urllib.request.Request(
+            f"{base}/siddhi/apps/ngstat/streams/S", data=data,
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30):
+            pass
+        with urllib.request.urlopen(f"{base}/stats", timeout=30) as r:
+            doc = json.loads(r.read().decode())
+        ng = doc["apps"]["ngstat"].get("numguard")
+        assert ng is not None, f"no numguard section: {doc['apps']}"
+        assert ng["armed"] is True
+        assert ng["trips_total"] == 0      # clean feed, quiet guard
+    finally:
+        svc.stop()

@@ -174,6 +174,19 @@ def compare(old, new, ratio=2.0):
                     # breaks old checkpoints silently — SC010 at the
                     # round-artifact level
                     regressed = True
+    onum, nnum = old.get("numeric"), new.get("numeric")
+    if nnum is not None:
+        # old artifacts predating the NS verifier simply count as 0
+        ot = onum.get("findings_total", 0) if onum else 0
+        nt = nnum.get("findings_total", 0)
+        if nt != ot:
+            lines.append(
+                f"numeric  NS findings: {ot} -> {nt}  (codes: "
+                + (",".join(sorted({c for by in
+                                    nnum.get("samples", {}).values()
+                                    for c in by})) or "-") + ")")
+            if nt > ot:     # new numeric-safety findings are a regression
+                regressed = True
     oe, ne = old.get("engine_lint"), new.get("engine_lint")
     if ne is not None:
         od = oe.get("diagnostics", 0) if oe else 0
@@ -278,6 +291,27 @@ def _schema_summary():
     return {"samples": samples}
 
 
+def _numeric_summary():
+    """Pin the numeric-safety posture of every shipped sample into the
+    round artifact (analysis/ranges.py — jax-free): warning-level NS0xx
+    finding counts per sample plus the total.  --compare treats any
+    growth in the total as a regression (a sample started overflowing,
+    or the verifier got stricter without the samples being annotated).
+    Same import/tolerance pattern as the engine lint."""
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    try:
+        from siddhi_tpu.analysis.ranges import sample_numeric_counts
+        samples = sample_numeric_counts(os.path.join(root, "samples"))
+    except Exception as e:
+        sys.stderr.write(f"[t1_report] numeric summary skipped: {e}\n")
+        return None
+    return {"samples": {f: by for f, by in sorted(samples.items()) if by},
+            "findings_total": sum(sum(by.values())
+                                  for by in samples.values())}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("log", nargs="?",
@@ -310,6 +344,7 @@ def main(argv=None):
     print(render_table(report, top=args.top))
     if args.out:
         report["engine_lint"] = _engine_lint_summary()
+        report["numeric"] = _numeric_summary()
         report["shards"] = _shards_summary()
         report["compile"] = _compile_summary()
         report["schema"] = _schema_summary()
