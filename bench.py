@@ -804,6 +804,95 @@ def bench_engine_absent():
         "engine_absent", "alert-rate arm + trailing `not ... for 3 sec`")
 
 
+def bench_select(n_keys=512, chunk_n=65_536, chunks=4,
+                 repeats=ENGINE_REPEATS, limit=8, having=3_000.0,
+                 seed=7):
+    """SELECT phase (round 19): the query's selection tail — group-by +
+    having + order-by + limit — at high emission rates, the device
+    egress selection kernel (plan/select_compiler.py + ops/select.py)
+    vs the identical app pinned to the per-emission host QuerySelector.
+    Both runs replay the SAME precomputed chunks, exact row parity is
+    asserted in-phase, and the device run must actually route the tail
+    on-device (query_runtimes['q'].selection_route — a silent fallback
+    would still 'pass' on rate alone)."""
+    import gc
+    from siddhi_tpu import SiddhiManager, StreamCallback
+
+    QUERY = ("@info(name='q') from S select sym, sum(price) as total, "
+             "count() as n, max(price) as hi group by sym "
+             f"having total > {having} order by total desc "
+             f"limit {limit} insert into Out;")
+    rng = np.random.default_rng(seed)
+    syms = np.asarray([f"k{i}" for i in range(n_keys)], object)
+    feeds = []
+    t0 = 1_000_000
+    for _ in range(1 + repeats * chunks):       # [0] = warmup / compile
+        feeds.append((
+            {"sym": syms[rng.integers(0, n_keys, chunk_n)],
+             "price": rng.uniform(0, 100, chunk_n).astype(np.float32)},
+            t0 + np.arange(chunk_n, dtype=np.int64) * 2))
+        t0 += chunk_n * 2
+
+    def run(engine):
+        prefix = "@app:playback "
+        if engine:
+            prefix += f"@app:engine('{engine}') "
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(
+            prefix + "define stream S (sym string, price float);\n"
+            + QUERY)
+        rows, emissions = [], [0]
+
+        def on(evs):
+            emissions[0] += 1
+            rows.extend(tuple(e.data) for e in evs)
+        rt.add_callback("Out", StreamCallback(on))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send_batch(*feeds[0])                 # warmup / compile
+        rt.flush()
+        del rows[:]
+        emissions[0] = 0
+        walls = []
+        for rep in range(repeats):
+            t = time.perf_counter()
+            for cols, ts in feeds[1 + rep * chunks:1 + (rep + 1) * chunks]:
+                h.send_batch(cols, timestamps=ts)
+            rt.flush()
+            walls.append(time.perf_counter() - t)
+        route = rt.query_runtimes["q"].selection_route
+        rt.shutdown()
+        gc.collect()
+        rate = chunk_n * chunks / float(np.median(walls))
+        return rate, float(np.sum(walls)), list(rows), emissions[0], route
+
+    rate_h, wall_h, rows_h, em_h, route_h = run("host")
+    rate_d, wall_d, rows_d, em_d, route_d = run(None)
+    assert route_h is not None and route_h["backend"] == "host", route_h
+    assert route_d is not None and route_d["backend"] == "device", \
+        f"selection tail silently fell back to host: {route_d}"
+    # host sums float64, device exact two-float f32 pairs — equal at f32
+    norm = lambda rs: [tuple(float(np.float32(v)) if isinstance(v, float)
+                             else v for v in r) for r in rs]
+    assert norm(rows_h) == norm(rows_d), \
+        f"select parity FAILED: host={rows_h[:4]} dev={rows_d[:4]}"
+    assert len(rows_d) > 0 and em_h == em_d, (len(rows_d), em_h, em_d)
+    return {
+        "select_events_per_sec": rate_d,
+        "select_host_events_per_sec": rate_h,
+        "select_speedup_vs_host": round(rate_d / rate_h, 2),
+        "select_per_emission_device_us": round(wall_d / em_d * 1e6, 1),
+        "select_per_emission_host_us": round(wall_h / em_h * 1e6, 1),
+        "select_emissions": em_d,
+        "select_rows_delivered": len(rows_d),
+        "select_route_sig": route_d.get("sig"),
+        "select_config": (f"{n_keys} keys, running sum+count+max, "
+                          f"having>{having} order by total desc "
+                          f"limit {limit}, {chunks} chunks of {chunk_n}, "
+                          f"median of {repeats}, row parity asserted"),
+    }
+
+
 WF_BLOCKS = 48      # --wf-blocks N overrides
 
 
@@ -2107,6 +2196,22 @@ def bench_smoke():
         "overhead_abs_ms": round((ng_on - ng_off) * 1e3, 3),
     }
 
+    # ---- select: device selection tail (group-by + having + order-by +
+    # limit in the egress kernel) vs the host QuerySelector at a tiny
+    # shape — row parity, device routing, and emission accounting are
+    # asserted inside bench_select itself
+    sel = bench_select(n_keys=16, chunk_n=512, chunks=2, repeats=2,
+                       limit=4, having=100.0)
+    assert sel["select_rows_delivered"] > 0, sel
+    res["select_smoke"] = {
+        "events_per_sec": round(sel["select_events_per_sec"], 1),
+        "host_events_per_sec": round(sel["select_host_events_per_sec"], 1),
+        "per_emission_device_us": sel["select_per_emission_device_us"],
+        "per_emission_host_us": sel["select_per_emission_host_us"],
+        "rows": sel["select_rows_delivered"],
+        "route_sig": sel["select_route_sig"],
+    }
+
     res["smoke_wall_s"] = round(time.perf_counter() - t_start, 2)
     return res
 
@@ -2550,6 +2655,8 @@ def main():
             print(json.dumps(_with_profile(bench_engine_wagg)))
         elif phase == "engine_absent":
             print(json.dumps(_with_profile(bench_engine_absent)))
+        elif phase == "select":
+            print(json.dumps(_with_profile(bench_select)))
         elif phase == "overload":
             print(json.dumps(bench_overload()))
         elif phase == "mtenant":
@@ -2581,6 +2688,7 @@ def main():
     eng = _run_phase("engine")
     eng_wagg = _run_phase("engine_wagg")
     eng_absent = _run_phase("engine_absent")
+    sel = _run_phase("select")
     overload = _run_phase("overload")
     mten = _run_phase("mtenant")
     wf = _run_phase("waterfall")
@@ -2641,6 +2749,11 @@ def main():
            for k, v in eng_wagg.items()},
         **{k: (round(v, 1) if isinstance(v, float) else v)
            for k, v in eng_absent.items()},
+        # device selection tail (round 19): group-by + having +
+        # order-by + limit through the egress kernel vs the identical
+        # app on the host QuerySelector, row parity asserted in-phase
+        **{k: (round(v, 1) if isinstance(v, float) else v)
+           for k, v in sel.items()},
         "jvm_baseline": "unavailable in image (no JVM): vs_baseline is "
                         "the python host oracle, NOT JVM siddhi-core",
         "p99_match_latency_ms": round(p99_ms, 2),

@@ -13,7 +13,7 @@ Passes, in order, per execution element:
   2. unbounded-state detection (passes.state_pass) — SA020..SA022
   3. partition safety (passes.partition_pass) — SA030/SA031
   4. retrace-hazard / host-fallback / precision prediction
-     (passes.perf_pass) — SP001..SP011
+     (passes.perf_pass) — SP001..SP012
   5. app-wide dead code (passes.deadcode_pass) — SA040/SA041
 
 Deliberately imports no jax and never builds a runtime: analyzing a

@@ -266,6 +266,20 @@ CATALOG: Dict[str, CatalogEntry] = {e.code: e for e in [
        "rides an exact-integer companion lane or falls back to host — "
        "either way extra cost the query shape opted into silently.",
        "Keep compared integers under 2^24, or use double attributes."),
+    _C("SP012", _I, "host-selection",
+       "The query's selection tail (having / order-by / limit / offset) "
+       "stays on the host QuerySelector: an atom is not "
+       "device-expressible (string or extension aggregate, exact int64 "
+       "sum, avg/stdDev float64 math, a constant that is not exactly "
+       "two-float32 representable, an input-attribute or group-key "
+       "reference) or the shape pins it (limit/offset over a sliding "
+       "window shares slots with expired rows; order-by/limit inside a "
+       "partition applies per key instance).  The aggregation itself "
+       "may still run on device — only the selection tail pays a "
+       "per-emission host pass.",
+       "Keep having/order-by atoms to count/sum/min/max/…Forever select "
+       "outputs compared against two-float-representable constants, or "
+       "accept the host fallback (value-identical, slower)."),
     # ---- plan verifier: automaton well-formedness ------------------------
     _C("PV001", _E, "dangling-transition",
        "A compiled automaton transition targets a state id that does not "

@@ -5,7 +5,9 @@ Each pass is a pure function over the query_api object model plus the
 :class:`~siddhi_tpu.analysis.scope.SymbolTable`; none of them imports
 jax or touches the planner — the hazard checks *mirror* the planner's
 and nfa_compiler's documented reject/grow conditions statically, so the
-CLI can run them on a laptop with no accelerator stack.
+CLI can run them on a laptop with no accelerator stack.  (The one plan/
+import, plan.select_compiler.classify_selection, is itself jax-free by
+contract — it is the shared static gate, not the compiled plan.)
 
   * state_pass    — SA020 within-less `every`, SA021 PK-less table
                     append, SA022 windowless grouped aggregation
@@ -14,7 +16,9 @@ CLI can run them on a laptop with no accelerator stack.
   * perf_pass     — SP001 slot-ring recompile storms, SP002 keyed-lane
                     growth retraces, SP003 dynamic window params, SP010
                     host pins (mirrors plan/nfa_compiler._reject sites),
-                    SP011 >2^24 integer compares on float32 lanes
+                    SP011 >2^24 integer compares on float32 lanes,
+                    SP012 selection tail (having/order/limit) pinned to
+                    the host QuerySelector with the blocking reason
   * deadcode_pass — SA040 unused streams, SA041 unused attributes
 """
 from __future__ import annotations
@@ -210,6 +214,25 @@ def perf_pass(table: SymbolTable, q: Query, qname: Optional[str],
     if isinstance(ins, StateInputStream):
         _pattern_host_pins(ins, q, qname, sink)
         _int_precision(table, ins, qname, sink)
+
+    # ---- SP012: selection tail (having/order/limit) stays on host.
+    # Queries whose selection compiles to the device egress kernel emit
+    # NOTHING here — the old blanket "having/order-by/limit are
+    # host-only" rejection is gone (plan/select_compiler.py).
+    if isinstance(ins, SingleInputStream):
+        from ..plan.select_compiler import classify_selection
+        d = table.app.stream_definitions.get(ins.stream_id)
+        attr_types = {a.name: a.type for a in d.attributes} \
+            if d is not None else {}
+        dec = classify_selection(q, attr_types, in_partition=in_partition)
+        if dec.active and not dec.device:
+            sink.emit(
+                "SP012",
+                f"selection tail stays on the host QuerySelector: "
+                f"{dec.reason} — group-by aggregation may still run on "
+                f"device, but every emission pays a per-event host "
+                f"selection pass",
+                pos=pos_of(dec.node) or pos_of(q), query=qname)
 
 
 def _single_streams(ins) -> List[SingleInputStream]:

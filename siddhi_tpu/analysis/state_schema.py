@@ -381,7 +381,8 @@ def _window_elements(qname: str, handlers, engine: str,
 
 def _query_elements(q: Query, qname: str, engine: str,
                     device_kinds: Tuple[str, ...],
-                    in_partition: bool) -> List[ElementSchema]:
+                    in_partition: bool,
+                    attr_types: Optional[dict] = None) -> List[ElementSchema]:
     ins = q.input_stream
     els: List[ElementSchema] = []
 
@@ -465,8 +466,18 @@ def _query_elements(q: Query, qname: str, engine: str,
             e.note = "host fallback persists selector + windows"
         els.append(e)
         if engine == "auto":
+            # selection-active queries: the static expressibility gate
+            # (plan/select_compiler) says whether the having/order/limit
+            # tail rides the device egress kernel or definitely engages
+            # the host selector fallback
+            note = "host fallback only"
+            from ..plan.select_compiler import classify_selection
+            dec = classify_selection(q, attr_types or {},
+                                     in_partition=in_partition)
+            if dec.active and not dec.device:
+                note = f"host-pinned selection: {dec.reason}"
             els.append(ElementSchema(f"{qname}:selector", "selector",
-                                     "host", note="host fallback only"))
+                                     "host", note=note))
             els.extend(_window_elements(qname, handlers, "host",
                                         device_kinds))
         return els
@@ -512,12 +523,20 @@ def extract_app_schema(app: Union[str, SiddhiApp],
             f"aggregation:{aid}", "aggregation", "fixed",
             note="host and device ingest share one layout"))
 
+    def _attr_types_for(q: Query) -> dict:
+        ins = q.input_stream
+        sid = getattr(ins, "stream_id", None)
+        d = app.stream_definitions.get(sid) if sid else None
+        return {a.name: a.type for a in d.attributes} \
+            if d is not None else {}
+
     qcount = 0
     for el in app.execution_elements:
         if isinstance(el, Query):
             qname = el.name or f"query_{qcount}"
             els.extend(_query_elements(el, qname, engine, device_kinds,
-                                       in_partition=False))
+                                       in_partition=False,
+                                       attr_types=_attr_types_for(el)))
         elif isinstance(el, Partition):
             pname = f"partition_{qcount}"
             p = ElementSchema(f"partition:{pname}", "partition", "fixed",
@@ -529,7 +548,8 @@ def extract_app_schema(app: Union[str, SiddhiApp],
                     qname = q.name or f"{pname}_query_{qi}"
                     p.children.extend(_query_elements(
                         q, qname, engine, device_kinds,
-                        in_partition=True))
+                        in_partition=True,
+                        attr_types=_attr_types_for(q)))
             els.append(p)
         qcount += 1
 
@@ -680,4 +700,75 @@ def sample_schema_digests(samples_dir: str) -> Dict[str, List[dict]]:
                          "versions": s.versions()})
         if rows:
             out[fname] = rows
+    return out
+
+
+def selection_coverage_of(app_source: str) -> List[dict]:
+    """Per selection-active query of one app, the static routing verdict
+    of the selection tail (having / order-by / limit / offset): device
+    egress kernel or host ``QuerySelector`` with the blocking reason.
+    Never imports jax."""
+    from ..compiler import SiddhiCompiler
+    from ..plan.select_compiler import classify_selection
+    app = SiddhiCompiler.parse(app_source)
+
+    def _attr_types_for(q: Query) -> dict:
+        sid = getattr(q.input_stream, "stream_id", None)
+        d = app.stream_definitions.get(sid) if sid else None
+        return {a.name: a.type for a in d.attributes} \
+            if d is not None else {}
+
+    rows: List[dict] = []
+    qcount = 0
+
+    def _visit(q: Query, qname: str, in_partition: bool) -> None:
+        dec = classify_selection(q, _attr_types_for(q),
+                                 in_partition=in_partition)
+        if not dec.active:
+            return
+        row = {"query": qname,
+               "backend": "device" if dec.device else "host"}
+        if not dec.device:
+            row["reason"] = dec.reason
+        rows.append(row)
+
+    for el in app.execution_elements:
+        if isinstance(el, Query):
+            _visit(el, el.name or f"query_{qcount}", in_partition=False)
+        elif isinstance(el, Partition):
+            for qi, q in enumerate(el.queries):
+                qname = q.name or f"partition_{qcount}_query_{qi}"
+                _visit(q, qname, in_partition=True)
+        qcount += 1
+    return rows
+
+
+def sample_selection_coverage(samples_dir: str) -> Dict[str, dict]:
+    """Per shipped sample, counts of selection-active queries routed to
+    the device egress kernel vs pinned on the host selector — the
+    t1_report artifact rows that let ``--compare`` flag a silent
+    regression from device selection back to host."""
+    out: Dict[str, dict] = {}
+    for fname in sorted(os.listdir(samples_dir)):
+        if not fname.endswith(".py"):
+            continue
+        device = 0
+        host = 0
+        details: List[dict] = []
+        for variants in apps_in_source(os.path.join(samples_dir, fname)):
+            rows = None
+            for text in variants:
+                try:
+                    rows = selection_coverage_of(text)
+                    break
+                except Exception:   # noqa: BLE001 — try the next variant
+                    continue
+            for row in rows or []:
+                if row["backend"] == "device":
+                    device += 1
+                else:
+                    host += 1
+                details.append(row)
+        out[fname] = {"device": device, "host": host,
+                      "queries": details}
     return out

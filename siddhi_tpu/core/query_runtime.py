@@ -120,6 +120,43 @@ class QueryRuntime:
 
     # ------------------------------------------------------------ build
 
+    @property
+    def selection_route(self) -> Optional[Dict]:
+        """Where the query's selection tail (having / order-by / limit /
+        offset) executes.  None when the query has no selection tail;
+        ``{"backend": "device", "sig": ...}`` when plan/select_compiler
+        lowered it into the egress kernel (ops/select.py);
+        ``{"backend": "host", "reason": ...}`` for the documented
+        host-QuerySelector fallback (value-identical, per-emission
+        Python).  Surfaced by service/rest.py stats and
+        tools/t1_report.py coverage artifacts."""
+        from ..plan.select_compiler import (classify_selection,
+                                            selection_active)
+        if not selection_active(self.query.selector):
+            return None
+        route = getattr(self.device_runtime, "selection_route", None)
+        if route is not None:
+            return dict(route)
+        # host route: the static classifier gives the atom-level blocking
+        # reason even when another plan stage (e.g. the dwin hybrid)
+        # overwrote backend_reason
+        reason = None
+        app = getattr(self.app_runtime, "app", None)
+        ins = self.query.input_stream
+        if app is not None and isinstance(ins, SingleInputStream):
+            d = app.stream_definitions.get(ins.stream_id)
+            attr_types = {a.name: a.type for a in d.attributes} \
+                if d is not None else {}
+            dec = classify_selection(
+                self.query, attr_types,
+                in_partition=(self.partition_key is not None or
+                              self._device_key_executors is not None))
+            if dec.active and not dec.device:
+                reason = dec.reason
+        return {"backend": "host",
+                "reason": reason or self.backend_reason or
+                "host query path"}
+
     def _expr_compiler_factory(self) -> Callable[[Scope], ExprCompiler]:
         app = self.app_runtime
         return lambda scope: ExprCompiler(

@@ -1,0 +1,179 @@
+"""Device selection kernel: having mask + order-by + limit on egress.
+
+``build_select_step(program)`` interprets a pure-data
+plan/select_compiler.SelectProgram into a plain-JAX step that runs right
+after the grouped-agg step on the SAME 13 output planes, replacing the
+per-emission host ``QuerySelector`` pass for device-expressible shapes:
+
+  * having atoms compare normalized two-float pairs lexicographically —
+    exactly the host's float64 comparison for every operand kind the
+    compiler admits (float-sum pairs, exact i32 counts/min/max split
+    into pairs without i32 overflow, two-float-representable constants);
+  * order-by replicates the host's numpy loop literally: one stable
+    sort pass per key in reverse spec order, descending = reverse the
+    permutation after a stable ascending sort.  Sort keys (only) are
+    canonicalized first (-0 -> +0, any-NaN pair -> +NaN, inf pairs drop
+    their lo residue) because XLA sorts by bit-level total order while
+    the host argsorts IEEE doubles with NaN last;
+  * rows failing ok/having are stably partitioned to the back, then a
+    static offset rotation and an ``out_count = clip(kept - offset, 0,
+    limit)`` slice bound make limit/offset free on device;
+  * the single-f32-key ascending-limit shape takes ``jax.lax.top_k``
+    over a monotone int32 encoding instead of full sorts — top_k's
+    lower-index-first tie rule IS the host's stable ascending argsort.
+
+Outputs: ``(sel_rows, meta=[out_count, max_cnt], *13 compacted planes)``
+— every array either per-padded-row or tiny, so the whole tuple lands in
+the egress fuser as one device->host slab with no per-emission hop.
+``max_cnt`` is the pre-having maximum group count (the int64-sum decode
+guard must see counts for rows the having mask filtered out).
+
+No jax.jit here: the caller routes compilation through the shape-class
+registry (plan/shapes.py) so prewarm/coldstart cover the kernel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .grouped_agg import _two_sum
+
+# operand plane stems -> index into the 13-tuple grouped-agg output
+# (fhi, flo, ihi, ilo, cnt, w_mnf, w_mxf, w_mni, w_mxi,
+#  all_mnf, all_mxf, all_mni, all_mxi)
+_PLANES = {"wmnf": 5, "wmxf": 6, "wmni": 7, "wmxi": 8,
+           "amnf": 9, "amxf": 10, "amni": 11, "amxi": 12}
+
+_I32_MAX = (1 << 31) - 1
+
+
+def _int_pair(v):
+    """Exact i32 -> normalized two-float32 pair, without the f32-round-
+    trip overflow trap at |v| near 2**31: split at 2**16 in integers,
+    convert both halves exactly, renormalize with two_sum."""
+    up = v >> 16
+    low = v - (up << 16)
+    hi0 = up.astype(jnp.float32) * jnp.float32(65536.0)
+    lo0 = low.astype(jnp.float32)
+    return _two_sum(hi0, lo0)
+
+
+def _const_pair(c: float):
+    """Host-side split of a compiler-verified two-float constant."""
+    import numpy as np
+    chi = np.float32(c)
+    clo = np.float32(np.float64(c) - np.float64(chi))
+    return chi, clo
+
+
+def build_select_step(program):
+    """Returns step(13 grouped-agg planes [P,T,(V)], lanes, rows, okm)
+    -> (sel_rows [n_pad] i32, meta [2] i32, 13 planes compacted to
+    [n_pad,(V)] in selection order).  lanes/rows/okm are the padded
+    emission gather vectors (padding rows carry okm=False and sort to
+    the back, never inside out_count)."""
+    having = program.having
+    order = program.order
+    limit = program.limit
+    offset = program.offset
+
+    def step(fhi, flo, ihi, ilo, cnt, w_mnf, w_mxf, w_mni, w_mxi,
+             a_mnf, a_mxf, a_mni, a_mxi, lanes, rows, okm):
+        planes = (fhi, flo, ihi, ilo, cnt, w_mnf, w_mxf, w_mni, w_mxi,
+                  a_mnf, a_mxf, a_mni, a_mxi)
+        n = lanes.shape[0]
+        em = [a[lanes, rows] for a in planes]
+
+        def operand(o):
+            tag = o[0]
+            if tag == "const":
+                chi, clo = _const_pair(o[1])
+                return (jnp.full((n,), chi, jnp.float32),
+                        jnp.full((n,), clo, jnp.float32))
+            if tag == "cnt":
+                return _int_pair(em[4])
+            if tag == "fpair":
+                hi = em[0][:, o[1]]
+                lo = em[1][:, o[1]]
+                # inf sums carry junk/NaN residues; the represented
+                # value is the hi inf alone
+                lo = jnp.where(jnp.isinf(hi), jnp.float32(0.0), lo)
+                return hi, lo
+            if tag == "f32":
+                v = em[_PLANES[o[1]]][:, o[2]]
+                return v, jnp.zeros_like(v)
+            return _int_pair(em[_PLANES[o[1]]][:, o[2]])    # "i32"
+
+        def cmp(op, a, b):
+            # lexicographic pair compare == exact f64 compare for
+            # normalized pairs; NaN hi makes every ordered compare
+            # False, matching host NaN semantics
+            (h1, l1), (h2, l2) = a, b
+            if op == "lt":
+                return (h1 < h2) | ((h1 == h2) & (l1 < l2))
+            if op == "gt":
+                return (h1 > h2) | ((h1 == h2) & (l1 > l2))
+            if op == "le":
+                return (h1 < h2) | ((h1 == h2) & (l1 <= l2))
+            if op == "ge":
+                return (h1 > h2) | ((h1 == h2) & (l1 >= l2))
+            eq = (h1 == h2) & (l1 == l2)
+            return eq if op == "eq" else ~eq
+
+        def ev(t):
+            k = t[0]
+            if k == "and":
+                return ev(t[1]) & ev(t[2])
+            if k == "or":
+                return ev(t[1]) | ev(t[2])
+            if k == "not":
+                return ~ev(t[1])
+            return cmp(t[1], operand(t[2]), operand(t[3]))
+
+        keep = okm if having is None else (okm & ev(having))
+        max_cnt = jnp.max(jnp.where(okm, em[4], jnp.int32(0)))
+        kept = jnp.sum(keep.astype(jnp.int32))
+
+        if program.topk and limit is not None and 0 < limit < n:
+            # single ascending f32 key: monotone i32 encoding, smallest
+            # ``limit`` rows via top_k, ties broken lower-index-first —
+            # identical to the host's stable ascending argsort prefix
+            v, _ = operand(order[0][0])
+            v = v + jnp.float32(0.0)                       # -0 -> +0
+            v = jnp.where(jnp.isnan(v), jnp.float32(jnp.nan), v)
+            b = jax.lax.bitcast_convert_type(v, jnp.int32)
+            enc = jnp.where(b < 0, b ^ jnp.int32(_I32_MAX), b)
+            enc = jnp.where(keep, enc, jnp.int32(_I32_MAX))
+            _, idx = jax.lax.top_k(-enc, limit)
+            perm = jnp.concatenate(
+                [idx.astype(jnp.int32),
+                 jnp.zeros((n - limit,), jnp.int32)])
+        else:
+            perm = jnp.arange(n, dtype=jnp.int32)
+            for (o, asc) in reversed(order):
+                khi, klo = operand(o)
+                kh = khi[perm] + jnp.float32(0.0)
+                kl = jnp.where(jnp.isinf(kh), jnp.float32(0.0),
+                               klo[perm]) + jnp.float32(0.0)
+                nan = jnp.isnan(kh) | jnp.isnan(kl)
+                kh = jnp.where(nan, jnp.float32(jnp.nan), kh)
+                kl = jnp.where(nan, jnp.float32(0.0), kl)
+                _, _, perm = jax.lax.sort((kh, kl, perm), num_keys=2,
+                                          is_stable=True)
+                if not asc:
+                    perm = perm[::-1]
+            # stable partition: kept rows first, in current order
+            inval = (~keep)[perm].astype(jnp.int32)
+            _, perm = jax.lax.sort((inval, perm), num_keys=1,
+                                   is_stable=True)
+            if offset:
+                perm = jnp.concatenate([perm[offset:], perm[:offset]])
+
+        avail = jnp.maximum(kept - jnp.int32(offset), jnp.int32(0))
+        outc = avail if limit is None else \
+            jnp.minimum(avail, jnp.int32(limit))
+        meta = jnp.stack([outc.astype(jnp.int32),
+                          max_cnt.astype(jnp.int32)])
+        return (perm, meta) + tuple(e[perm] for e in em)
+
+    return step

@@ -117,7 +117,8 @@ class _SplitSquare:
 class CompiledGroupedAgg:
     """One aggregation query over [lane, group, value] device state."""
 
-    def __init__(self, app, query: Query, n_lanes: int = 1):
+    def __init__(self, app, query: Query, n_lanes: int = 1,
+                 keyed: bool = False):
         s = query.input_stream
         assert isinstance(s, SingleInputStream)
         wh = s.window_handler
@@ -265,6 +266,25 @@ class CompiledGroupedAgg:
                 self.outputs.append((oa.rename, "key", e.attribute))
             else:
                 _reject("select supports aggregates plus plain attributes")
+        # selection tail (having / order-by / limit / offset): compiled
+        # into a device egress program when expressible; atoms may pull
+        # in min/max planes the select clause alone didn't want, so this
+        # runs BEFORE _build_step fixes the kernel program
+        from .select_compiler import (SelectionBlocked, compile_selection,
+                                      selection_active)
+        self.selection = None
+        if selection_active(query.selector):
+            try:
+                self.selection = compile_selection(
+                    query.selector, self.outputs, attr_types,
+                    keyed=keyed,
+                    windowed=(self.window != 0))
+            except SelectionBlocked as e:
+                _reject(f"selection tail stays on the host "
+                        f"QuerySelector: {e.reason}")
+            have_agg = have_agg or self.selection.has_agg
+            want_minmax = want_minmax or self.selection.uses_minmax
+            want_forever = want_forever or self.selection.uses_forever
         if not have_agg:
             _reject("no aggregates to run (plain projection is the filter "
                     "path)")
@@ -325,6 +345,13 @@ class CompiledGroupedAgg:
                     self.window, self.want_minmax, self.want_forever,
                     numguard=self._numguard),
                 donate_argnums=donate))
+        if getattr(self, "selection", None) is not None:
+            from ..ops.select import build_select_step
+            p = self.selection
+            self._select = wrap_kernel("select.step", shape_registry().jit(
+                "select.step",
+                {"sig": p.key, "vf": self._n_float, "vi": self._n_int},
+                build_select_step(p)))
 
     def _make_carry(self, n_lanes: int, n_groups: Optional[int] = None):
         g = self.n_groups if n_groups is None else n_groups
@@ -538,6 +565,18 @@ class CompiledGroupedAgg:
         ok_plane[lanes32, row] = ok
         work: Dict[str, Any] = {"data": data, "ok": ok,
                                 "lanes32": lanes32, "row": row}
+        if self.selection is not None:
+            # padded per-emission gather vectors for the select step —
+            # pow2-bucketed like T so chunk-size jitter reuses traces;
+            # padding rows carry ok=False and sort behind out_count
+            n_pad = 1 << max(0, (n - 1).bit_length())
+            lp = np.zeros(n_pad, np.int32)
+            rp = np.zeros(n_pad, np.int32)
+            op = np.zeros(n_pad, bool)
+            lp[:n] = lanes32
+            rp[:n] = row
+            op[:n] = ok
+            work["sel_pad"] = (lp, rp, op)
         if self.window_kind == "time":
             ts_plane = self._ts_offsets(data, lanes32, row, ok, (P, T))
             work["planes"] = (f_plane, i_plane, g_plane, ts_plane,
@@ -557,6 +596,14 @@ class CompiledGroupedAgg:
                    not self._int_sum_needed)
         work["pre_carry"] = None if donated else self.carry
         self.carry, outs = self._step(self.carry, *work["planes"])
+        if self.selection is not None:
+            # chain the egress selection kernel: having mask, ordering
+            # permutation and limit bound computed on device over the 13
+            # grouped planes; the numguard sentinel (14th output) stays
+            # appended behind the select outputs
+            base, tail = outs[:13], outs[13:]
+            outs = tuple(self._select(*base, *work["sel_pad"])) + \
+                tuple(tail)
         fuser = getattr(self, "egress_fuser", None)
         if fuser is not None:
             # outputs (and the time ring's overflow flag, read first in
@@ -614,6 +661,18 @@ class CompiledGroupedAgg:
             sent, outs_host = outs_host[-1], outs_host[:-1]
             if self.sentinels is not None:
                 self.sentinels.observe_sentinel_plane("gagg.step", sent)
+        sel_idx = None
+        if self.selection is not None:
+            # device selection (ops/select.py): sel_rows is the ordering
+            # permutation over chunk rows, meta = [out_count, max_cnt];
+            # the 13 planes arrive already gathered + compacted, so the
+            # selected rows are simply the first out_count entries
+            sel_rows = np.asarray(outs_host[0])
+            meta = np.asarray(outs_host[1])
+            sel_k = int(meta[0])
+            sel_cmax = int(meta[1])
+            sel_idx = sel_rows[:sel_k]
+            outs_host = outs_host[2:]
         (fhi, flo, ihi, ilo, cnt, w_mnf, w_mxf, w_mni, w_mxi,
          a_mnf, a_mxf, a_mni, a_mxi) = outs_host
         if self.sentinels is not None:
@@ -622,21 +681,30 @@ class CompiledGroupedAgg:
             # change) — covers the time kernel, which has no device plane
             self.sentinels.observe_floats("gagg.decode", fhi)
             self.sentinels.observe_counts("gagg.decode", cnt)
-        sel_l, sel_r = lanes32[ok], row[ok]
+        if sel_idx is not None:
+            def pick(a):
+                return a[:sel_k]
+            cnt_max = sel_cmax
+        else:
+            sel_l, sel_r = lanes32[ok], row[ok]
 
-        def pick(a):
-            return a[sel_l, sel_r]
+            def pick(a):
+                return a[sel_l, sel_r]
         counts = pick(cnt).astype(np.int64)
+        if sel_idx is None:
+            cnt_max = int(counts.max(initial=0))
         if self._int_sum_needed and self.window == 0 and \
-                int(counts.max(initial=0)) >= INT_GROUP_MAX:
+                cnt_max >= INT_GROUP_MAX:
             raise SiddhiAppRuntimeException(
                 "device grouped-agg path: a group accumulated >= 2^15 "
                 "events; exact running integer sums exceed the i32 "
                 "partial-sum bound — re-plan with @app:engine('host')")
-        out: Dict[str, Any] = {"mask": ok}
+        out: Dict[str, Any] = {"mask": ok} if sel_idx is None else \
+            {"sel_rows": sel_idx}
         for (name, kind, ref) in self.outputs:
             if kind == "key":
-                out[name] = np.asarray(data.columns[ref])[ok]
+                rows_sel = ok if sel_idx is None else sel_idx
+                out[name] = np.asarray(data.columns[ref])[rows_sel]
                 continue
             if kind == "count":
                 out[name] = counts

@@ -1166,11 +1166,11 @@ class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
         app = qr.app_runtime
         q = qr.query
         sel = q.selector
-        if sel.having is not None or sel.order_by or \
-                sel.limit is not None or sel.offset is not None:
-            raise SiddhiAppCreationError(
-                "device grouped-agg path: having/order-by/limit are "
-                "host-only")
+        # having/order-by/limit no longer reject wholesale: the gagg
+        # compiler lowers expressible selection tails into a device
+        # egress program (plan/select_compiler.py) and rejects — with
+        # the blocking reason — only the shapes the host QuerySelector
+        # must keep
         if getattr(q.output_stream, "events_for",
                    OutputEventsFor.CURRENT) != OutputEventsFor.CURRENT:
             raise SiddhiAppCreationError(
@@ -1191,7 +1191,14 @@ class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
         self.cga = CompiledGroupedAgg(
             app.app, q,
             n_lanes=initial_lanes(app.app, self._shard_want)
-            if self.keyed else 1)
+            if self.keyed else 1,
+            keyed=self.keyed)
+        # surfaced by service/rest.py stats and tools/t1_report.py: did
+        # the selection tail (having/order/limit) compile to device?
+        self.selection_route = None
+        if self.cga.selection is not None:
+            self.selection_route = {"backend": "device",
+                                    "sig": self.cga.selection.key}
         if self.keyed:
             ex = key_executors.get(self.cga.stream_id)
             if ex is None:
@@ -1381,7 +1388,16 @@ class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
     def _emit(self, work, res) -> None:
         from ..core.event import EventChunk
         data = work["data"]
-        ok = res.pop("mask")
+        sel = res.pop("sel_rows", None)
+        if sel is not None:
+            # device selection already masked/ordered/limited the rows;
+            # sel holds chunk-row indices in emission order
+            if len(sel) == 0:
+                return
+            out_ts = np.asarray(data.timestamps)[sel]
+        else:
+            ok = res.pop("mask")
+            out_ts = np.asarray(data.timestamps)[ok]
         names = [o[0] for o in self.cga.outputs]
         cols: Dict[str, np.ndarray] = {}
         for (name, kind, attr) in self.cga.outputs:
@@ -1393,7 +1409,6 @@ class DeviceGroupedAggRuntime(PipelinedDeviceIngest):
                 cols[name] = col
             else:
                 cols[name] = np.asarray(v).astype(dt)
-        out_ts = np.asarray(data.timestamps)[ok]
         self.head.process(EventChunk.from_columns(names, out_ts, cols))
 
     # ------------------------------------------------------------ lifecycle

@@ -316,6 +316,18 @@ class SiddhiService:
             schema = getattr(rt, "state_schema", None)
             if schema is not None:
                 doc["state_schema"] = schema.as_dict()
+            # per-query selection routing: whether the having / order-by
+            # / limit tail runs in the device egress kernel or on the
+            # host QuerySelector (with the blocking reason) — the live
+            # counterpart of the T1 artifact's selection section
+            selection = {
+                qname: route
+                for qname, qrt in getattr(rt, "query_runtimes",
+                                          {}).items()
+                for route in [getattr(qrt, "selection_route", None)]
+                if route is not None}
+            if selection:
+                doc["selection"] = selection
             # live numeric sentinels (SIDDHI_TPU_NUMGUARD): overflow /
             # non-finite trip counters the static verdicts predicted
             from ..core.numguard import numeric_sentinels

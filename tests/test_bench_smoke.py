@@ -130,6 +130,15 @@ def test_bench_smoke_runs_clean():
     assert nsm["sample_findings_total"] == 0
     assert nsm["sentinel_trips"] > 0
     assert nsm["overhead_pct"] >= 0.0
+    # device selection tail (round 19): having + order-by + limit
+    # compiled into the egress kernel — row parity vs the host
+    # QuerySelector and the device routing are asserted inside
+    # bench_select itself; here we pin the artifact shape
+    ssel = out["select_smoke"]
+    assert ssel["rows"] > 0
+    assert ssel["events_per_sec"] > 0
+    assert ssel["host_events_per_sec"] > 0
+    assert ssel["route_sig"].startswith("h1o1l4")
 
 
 def test_fail_on_p99_gate():

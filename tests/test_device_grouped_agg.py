@@ -221,17 +221,38 @@ def test_oversized_int_value_is_data_error():
 
 
 def test_device_rejects_unsupported_to_host():
-    """having falls back from the grouped-agg kernel with a recorded
-    reason.  (lengthBatch and stdDev used to be in this list; batch
-    windows ride the device window path and stdDev lowers onto split
-    sum-of-squares lanes.)"""
+    """Selection shapes the egress select kernel cannot express fall
+    back from the grouped-agg kernel with a recorded reason.  (having
+    on a float-sum output used to be in this list wholesale; it now
+    compiles into the device selection step — plan/select_compiler.py —
+    as lengthBatch and stdDev moved off this list in earlier rounds.)"""
     for frag in (
-            "select sym, sum(price) as t group by sym having t > 10.0",):
+            # exact int64 sums do not fit the two-float compare lanes
+            "select sym, sum(volume) as t group by sym having t > 10",
+            # avg needs float64 division at selection time
+            "select sym, avg(price) as m group by sym having m > 1.0",):
         app = STREAM + f"@info(name='q') from S{'' if frag.startswith('s') else ''}" \
             + ("" if frag.startswith("#") else " ") + frag + \
             " insert into Out;"
         dev_hit, _ = run_app(app, _rows(n=10))
         assert not dev_hit, frag
+    # limit over a sliding window shares selector slots with expired
+    # rows: the dwin hybrid may still own the window buffer, but the
+    # selection tail itself must report the host route
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "@app:playback " + STREAM + "@info(name='q') from "
+        "S#window.length(4) select sym, sum(price) as t group by sym "
+        "limit 2 insert into Out;")
+    route = rt.query_runtimes["q"].selection_route
+    assert route["backend"] == "host", route
+    assert "expired" in route["reason"], route
+    rt.shutdown()
+    # burned-down shape: float-sum having now rides the device path
+    app = STREAM + "@info(name='q') from S select sym, sum(price) as t " \
+        "group by sym having t > 10.0 insert into Out;"
+    dev_hit, _ = run_app(app, _rows(n=10))
+    assert dev_hit, "float-sum having should ride the device select step"
     app = STREAM + "@info(name='q') from S#window.lengthBatch(3) " \
         "select sum(price) as t insert into Out;"
     dev_hit, _ = run_app(app, _rows(n=10))

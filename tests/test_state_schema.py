@@ -179,6 +179,34 @@ def test_mutation_version_tamper_is_sc001():
         m.shutdown()
 
 
+SELECTOR_APP = """
+@app:name('schemaSel') @app:engine('host')
+define stream S (k string, p double);
+@info(name='q')
+from S select k, sum(p) as total group by k having total > 1.0
+order by total desc limit 2 insert into Out;
+"""
+
+
+def test_mutation_selector_version_tamper_is_sc001():
+    """The host QuerySelector's envelope section (``q:selector`` — the
+    selection-tail fallback path of round 19) verifies like every other
+    element: a version tamper is a typed SC001, not a pickle error."""
+    m, rt, _ = _rt(SELECTOR_APP)
+    try:
+        rt.get_input_handler("S").send(["a", 2.0])
+        env = _envelope(rt)
+        assert "q:selector" in env["schema"], sorted(env["schema"])
+        assert env["schema"]["q:selector"]["name"] == "selector"
+        env["schema"]["q:selector"]["version"] = 99
+        with pytest.raises(CannotRestoreStateError) as ei:
+            _restore(rt, env)
+        assert ei.value.code == "SC001"
+        assert "version" in str(ei.value) and "q:selector" in str(ei.value)
+    finally:
+        m.shutdown()
+
+
 def test_mutation_digest_tamper_same_version_is_sc010():
     m, rt, _ = _rt(PATTERN_APP)
     try:

@@ -174,6 +174,24 @@ def compare(old, new, ratio=2.0):
                     # breaks old checkpoints silently — SC010 at the
                     # round-artifact level
                     regressed = True
+    osel, nsel = old.get("selection"), new.get("selection")
+    if osel is not None and nsel is not None:
+        osm, nsm = osel.get("samples", {}), nsel.get("samples", {})
+        for fname in sorted(set(osm) & set(nsm)):
+            od = osm[fname].get("device", 0)
+            nd = nsm[fname].get("device", 0)
+            oh = osm[fname].get("host", 0)
+            nh = nsm[fname].get("host", 0)
+            if (od, oh) == (nd, nh):
+                continue
+            lines.append(f"select   {fname}  device {od} -> {nd}, "
+                         f"host {oh} -> {nh}")
+            if nd < od or nh > oh:
+                # a query that compiled to the device selection kernel
+                # last round now pays the per-emission host pass — the
+                # silent-perf-regression this artifact section exists
+                # to catch
+                regressed = True
     onum, nnum = old.get("numeric"), new.get("numeric")
     if nnum is not None:
         # old artifacts predating the NS verifier simply count as 0
@@ -291,6 +309,29 @@ def _schema_summary():
     return {"samples": samples}
 
 
+def _selection_summary():
+    """Pin the device-selection coverage of every shipped sample into
+    the round artifact (analysis/state_schema.py — jax-free): per
+    sample, how many selection-active queries (having / order-by /
+    limit / offset) compile to the device egress kernel vs stay on the
+    host QuerySelector, with the blocking reason for each host one.
+    --compare treats any device->host slide as a regression.  Same
+    import/tolerance pattern as the engine lint."""
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    try:
+        from siddhi_tpu.analysis.state_schema import \
+            sample_selection_coverage
+        samples = sample_selection_coverage(os.path.join(root, "samples"))
+    except Exception as e:
+        sys.stderr.write(f"[t1_report] selection summary skipped: {e}\n")
+        return None
+    return {"samples": samples,
+            "device_total": sum(v["device"] for v in samples.values()),
+            "host_total": sum(v["host"] for v in samples.values())}
+
+
 def _numeric_summary():
     """Pin the numeric-safety posture of every shipped sample into the
     round artifact (analysis/ranges.py — jax-free): warning-level NS0xx
@@ -348,6 +389,7 @@ def main(argv=None):
         report["shards"] = _shards_summary()
         report["compile"] = _compile_summary()
         report["schema"] = _schema_summary()
+        report["selection"] = _selection_summary()
         with open(args.out, "w") as f:
             json.dump(report, f, indent=1, sort_keys=True)
             f.write("\n")
